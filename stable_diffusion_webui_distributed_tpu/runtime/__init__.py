@@ -1,0 +1,1 @@
+"""Core runtime: config, flags, logging, RNG discipline, dtype policy, interrupt."""

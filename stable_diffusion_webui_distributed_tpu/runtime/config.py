@@ -1,0 +1,148 @@
+"""Config schema + persistence.
+
+Capability parity with the reference's config system
+(/root/reference/scripts/spartan/pmodels.py:4-46 and
+/root/reference/scripts/spartan/world.py:616-722): a pydantic-validated JSON
+file holding the worker registry (here: TPU slices / serving backends), each
+worker's benchmark calibration (avg images-per-minute, ETA error history,
+pixel cap), the shared benchmark payload, and scheduler settings
+(``job_timeout``, enable flags, complementary production, step scaling).
+Includes legacy-format migration and corrupt-file quarantine
+(world.py:632-659 semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from pydantic import BaseModel, Field
+
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+
+logger = get_logger()
+
+#: Benchmark protocol constants (reference: shared.py:63-64).
+WARMUP_SAMPLES = 2
+RECORDED_SAMPLES = 3
+
+
+class BenchmarkPayload(BaseModel):
+    """The fixed calibration workload (reference: shared.py:67-77, pmodels.py:4-10)."""
+
+    prompt: str = "A herd of cows grazing at the bottom of a sunny valley"
+    negative_prompt: str = ""
+    steps: int = 20
+    width: int = 512
+    height: int = 512
+    batch_size: int = 1
+    sampler_name: str = "Euler a"
+
+
+class WorkerModel(BaseModel):
+    """Per-worker (per-slice) persisted state (reference: pmodels.py:12-34).
+
+    In the TPU build a "worker" is a generation backend: the in-process mesh
+    slice (master), another slice of the same pod, or a remote host reachable
+    over the sdapi-compatible control plane. Calibration fields survive
+    restarts so scheduling stays warm (world.py:705-722 semantics).
+    """
+
+    address: str = "localhost"
+    port: int = 7860
+    avg_ipm: Optional[float] = None  # images per minute; None = not benchmarked
+    master: bool = False
+    # ETA mean-percent-error history, most recent last (worker.py:483-490).
+    eta_percent_error: List[float] = Field(default_factory=list)
+    user: Optional[str] = None
+    password: Optional[str] = None
+    tls: bool = False
+    disabled: bool = False
+    # Maximum width*height*batch this worker will accept; 0 = uncapped
+    # (reference: world.py:62-72 pixel-cap guard in Job.add_work).
+    pixel_cap: int = 0
+    # TPU-native extension: which local devices this backend drives
+    # (empty = all visible devices; remote workers leave it empty).
+    device_ids: List[int] = Field(default_factory=list)
+
+
+class ConfigModel(BaseModel):
+    """Root config (reference: pmodels.py:36-46)."""
+
+    workers: List[Dict[str, WorkerModel]] = Field(default_factory=list)
+    benchmark_payload: BenchmarkPayload = Field(default_factory=BenchmarkPayload)
+    # Seconds of predicted stall we tolerate before deferring a worker's
+    # images to faster peers (reference: pmodels.py:42, default 3).
+    job_timeout: int = 3
+    enabled: bool = True
+    enabled_i2i: bool = False
+    # Let slow (deferred) workers produce "bonus" images in their slack time
+    # (reference optimize_jobs step 4, world.py:519-543).
+    complement_production: bool = True
+    # If a complementary worker can't fit one image in the slack window,
+    # give it one image at reduced step count (world.py:547-557).
+    step_scaling: bool = False
+    # TPU-native additions (absent from the reference's schema):
+    model_dir: str = "models"
+    default_model: str = ""
+    mesh_axes: Dict[str, int] = Field(default_factory=dict)  # e.g. {"dp": 4, "tp": 2}
+
+
+def default_config_path() -> str:
+    return os.environ.get("SDTPU_CONFIG", "distributed-config.json")
+
+
+def load_config(path: Optional[str] = None) -> ConfigModel:
+    """Read + validate the JSON config; migrate or quarantine unreadable files.
+
+    Mirrors the reference's ``World.config`` (world.py:616-659): a missing
+    file yields defaults, a legacy ``workers.json``-style list is migrated,
+    and a corrupt file is renamed aside rather than crashing startup.
+    """
+    path = path or default_config_path()
+    if not os.path.exists(path):
+        logger.debug("config %s not found, using defaults", path)
+        return ConfigModel()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        quarantine = f"{path}.corrupt-{int(time.time())}"
+        logger.warning("config %s unreadable (%s); moving to %s", path, e, quarantine)
+        try:
+            os.replace(path, quarantine)
+        except OSError:
+            pass
+        return ConfigModel()
+
+    if isinstance(raw, list):
+        # Legacy format: bare list of worker dicts (world.py:632-649).
+        logger.info("migrating legacy worker-list config %s", path)
+        workers = []
+        for entry in raw:
+            label = entry.pop("label", entry.get("address", "worker"))
+            workers.append({label: WorkerModel(**entry)})
+        return ConfigModel(workers=workers)
+
+    try:
+        return ConfigModel(**raw)
+    except Exception as e:
+        quarantine = f"{path}.invalid-{int(time.time())}"
+        logger.warning("config %s invalid (%s); moving to %s", path, e, quarantine)
+        try:
+            os.replace(path, quarantine)
+        except OSError:
+            pass
+        return ConfigModel()
+
+
+def save_config(cfg: ConfigModel, path: Optional[str] = None) -> None:
+    """Atomically persist the config (reference: world.py:705-722)."""
+    path = path or default_config_path()
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(cfg.model_dump(), f, indent=2)
+    os.replace(tmp, path)
+    logger.debug("config saved to %s", path)

@@ -1,0 +1,37 @@
+"""Dtype policy: bf16 compute, f32 accumulate where it matters.
+
+TPU MXU natively multiplies bf16 with f32 accumulation; we keep params and
+activations in bf16 and pin numerically sensitive pieces (sampler state,
+sigmas, group-norm statistics, final VAE output) to f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.dtype(jnp.float32)   # storage dtype of weights
+    compute_dtype: jnp.dtype = jnp.dtype(jnp.bfloat16)  # matmul/conv dtype
+    sampler_dtype: jnp.dtype = jnp.dtype(jnp.float32)   # latent/sigma math
+
+
+#: Default policy for real TPU runs.
+TPU = Policy()
+#: Full-f32 policy for numerics tests on CPU.
+F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
+
+
+def cast_floating(tree, dtype):
+    """Cast floating leaves of a pytree to ``dtype`` (params → bf16 etc.)."""
+    import jax
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)

@@ -1,0 +1,49 @@
+"""CLI flag registry (capability parity with /root/reference/preload.py:6-38).
+
+The reference registers ``--distributed-*`` flags on the webui argparser at
+preload time. Here the framework owns its own parser; ``add_flags`` can also
+be called on an external parser to embed the framework in a host app.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    group = parser.add_argument_group("distributed")
+    parser.add_argument(
+        "--distributed-config",
+        type=str,
+        default=None,
+        help="path of the distributed config file (reference: preload.py:31-37)",
+    )
+    group.add_argument(
+        "--distributed-debug",
+        action="store_true",
+        help="verbose logging + debug-only controls (reference: preload.py:27)",
+    )
+    group.add_argument(
+        "--distributed-skip-verify-remotes",
+        action="store_true",
+        help="disable TLS certificate verification for remote workers "
+        "(reference: preload.py:19-23)",
+    )
+    # TPU-native flags (no reference equivalent):
+    group.add_argument(
+        "--mesh",
+        type=str,
+        default=None,
+        help='mesh axis spec, e.g. "dp=4,tp=2" (default: all devices on dp)',
+    )
+    group.add_argument(
+        "--model-dir", type=str, default=None, help="checkpoint directory"
+    )
+    group.add_argument("--listen", type=str, default="127.0.0.1", help="API bind host")
+    group.add_argument("--port", type=int, default=7860, help="API bind port")
+    return parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="sdtpu")
+    return add_flags(parser)

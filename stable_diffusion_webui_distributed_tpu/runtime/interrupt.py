@@ -1,0 +1,102 @@
+"""Interrupt + progress plumbing for compiled denoise loops.
+
+The reference interrupts in-flight work by polling a master-side flag every
+0.5 s while the HTTP call runs and POSTing ``/interrupt`` to remotes
+(/root/reference/scripts/spartan/worker.py:440-448, world.py:173-179). Under
+XLA the denoise loop is a compiled ``lax.scan`` — the host can't reach into
+it. We reproduce the same user-visible semantics by *chunking*: the sampler
+loop runs ``chunk`` steps per device dispatch, and between dispatches the
+host checks :class:`InterruptFlag` and reports progress. With step counts of
+20-50 and chunks of 4-5 steps the check granularity on TPU is well under the
+reference's 0.5 s poll.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+class InterruptFlag:
+    """Thread-safe interrupt latch shared by API server, UI, and executors."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def interrupt(self) -> None:
+        self._event.set()
+
+    def clear(self) -> None:
+        self._event.clear()
+
+    @property
+    def interrupted(self) -> bool:
+        return self._event.is_set()
+
+
+@dataclass
+class Progress:
+    """Live progress for the ``/sdapi/v1/progress`` endpoint (reference consumes
+    webui's progress API; worker.py:192-203 lists the surface)."""
+
+    job: str = ""
+    sampling_step: int = 0
+    sampling_steps: int = 0
+    started_at: float = 0.0
+    interrupted: bool = False
+
+    @property
+    def fraction(self) -> float:
+        if self.sampling_steps <= 0:
+            return 0.0
+        return min(1.0, self.sampling_step / self.sampling_steps)
+
+    def eta_seconds(self) -> Optional[float]:
+        if self.sampling_step <= 0 or self.started_at <= 0:
+            return None
+        elapsed = time.time() - self.started_at
+        rate = elapsed / self.sampling_step
+        return rate * (self.sampling_steps - self.sampling_step)
+
+
+class GenerationState:
+    """Process-wide generation state: one interrupt flag + progress record.
+
+    Equivalent role to webui's ``shared.state`` that the reference reads
+    (worker.py:444-448) — the single rendezvous between UIs/API handlers and
+    the executor.
+    """
+
+    def __init__(self) -> None:
+        self.flag = InterruptFlag()
+        self.progress = Progress()
+        self._listeners: List[Callable[[Progress], None]] = []
+        self._lock = threading.Lock()
+
+    def begin(self, job: str, steps: int) -> None:
+        with self._lock:
+            self.flag.clear()
+            self.progress = Progress(
+                job=job, sampling_steps=steps, started_at=time.time()
+            )
+
+    def step(self, completed_steps: int) -> None:
+        with self._lock:
+            self.progress.sampling_step = completed_steps
+            self.progress.interrupted = self.flag.interrupted
+            for cb in self._listeners:
+                cb(self.progress)
+
+    def finish(self) -> None:
+        with self._lock:
+            self.progress.sampling_step = self.progress.sampling_steps
+
+    def add_listener(self, cb: Callable[[Progress], None]) -> None:
+        with self._lock:
+            self._listeners.append(cb)
+
+
+#: Default process-wide state (servers may create their own).
+STATE = GenerationState()

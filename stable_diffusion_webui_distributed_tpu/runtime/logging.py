@@ -1,0 +1,136 @@
+"""Observability stack: branded console logging, rotating file log, GUI ring buffer.
+
+Capability parity with the reference's observability layer
+(/root/reference/scripts/spartan/shared.py:16-60): a single ``"distributed"``
+logger fans out to (1) a Rich console handler with a branded prefix, (2) a
+10 MB x 2 rotating file, and (3) an in-memory ring buffer that UIs poll for
+live status. The ring buffer here is thread-safe (the reference's plain list
+is mutated cross-thread without locks; we fix that).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import logging.handlers
+import os
+import threading
+from typing import Deque, List
+
+LOGGER_NAME = "distributed"
+#: Number of messages the GUI ring buffer retains (reference: shared.py:44 keeps 16).
+RING_CAPACITY = 16
+
+_lock = threading.Lock()
+_configured = False
+
+
+class RingBufferHandler(logging.Handler):
+    """In-memory ring buffer of formatted log lines for status UIs.
+
+    Mirrors the reference's ``GuiHandler`` (shared.py:43-59) which keeps the
+    last 16 messages for the Status tab textbox.
+    """
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        super().__init__()
+        self._buf: Deque[str] = collections.deque(maxlen=capacity)
+        self._buf_lock = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = self.format(record)
+        except Exception:  # pragma: no cover - formatting failure
+            self.handleError(record)
+            return
+        with self._buf_lock:
+            self._buf.append(msg)
+
+    def dump(self) -> List[str]:
+        """Return the buffered lines, oldest first."""
+        with self._buf_lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._buf_lock:
+            self._buf.clear()
+
+
+_ring_handler = RingBufferHandler()
+
+
+def get_ring_buffer() -> RingBufferHandler:
+    """The process-wide ring buffer handler (for status endpoints/UIs)."""
+    return _ring_handler
+
+
+def configure(
+    debug: bool = False,
+    log_dir: str | None = None,
+    use_rich: bool = True,
+) -> logging.Logger:
+    """Configure the 'distributed' logger. Idempotent.
+
+    Parameters mirror the reference's ``--distributed-debug`` flag
+    (shared.py:16) and its ``distributed.log`` rotating file (shared.py:33-36).
+    """
+    global _configured
+    logger = logging.getLogger(LOGGER_NAME)
+    with _lock:
+        if _configured:
+            logger.setLevel(logging.DEBUG if debug else logging.INFO)
+            return logger
+
+        logger.setLevel(logging.DEBUG if debug else logging.INFO)
+        logger.propagate = False
+
+        fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s", "%H:%M:%S")
+
+        console: logging.Handler
+        if use_rich:
+            try:
+                from rich.logging import RichHandler
+
+                class BrandedRichHandler(RichHandler):
+                    """Rich console handler with a branded prefix (shared.py:19-30)."""
+
+                    def emit(self, record: logging.LogRecord) -> None:
+                        record.msg = f"[sdtpu] {record.msg}"
+                        super().emit(record)
+
+                console = BrandedRichHandler(show_path=False, show_time=True)
+            except Exception:  # pragma: no cover - rich unavailable
+                console = logging.StreamHandler()
+                console.setFormatter(fmt)
+        else:
+            console = logging.StreamHandler()
+            console.setFormatter(fmt)
+        logger.addHandler(console)
+
+        if log_dir is None:
+            log_dir = os.environ.get("SDTPU_LOG_DIR", ".")
+        try:
+            file_handler = logging.handlers.RotatingFileHandler(
+                os.path.join(log_dir, "distributed.log"),
+                maxBytes=10 * 1024 * 1024,
+                backupCount=1,
+            )
+            file_handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+            )
+            logger.addHandler(file_handler)
+        except OSError:  # pragma: no cover - unwritable dir
+            pass
+
+        _ring_handler.setFormatter(fmt)
+        logger.addHandler(_ring_handler)
+
+        _configured = True
+        return logger
+
+
+def get_logger() -> logging.Logger:
+    """Return the framework logger, configuring defaults on first use."""
+    if not _configured:
+        configure(debug=os.environ.get("SDTPU_DEBUG", "") not in ("", "0"))
+    return logging.getLogger(LOGGER_NAME)

@@ -1,0 +1,104 @@
+"""Seed discipline.
+
+The reference preserves per-image seed continuity across workers by offsetting
+each job's starting seed by the number of images assigned before it
+(/root/reference/scripts/distributed.py:297-305: ``seed += prior_images`` when
+``subseed_strength == 0``, else ``subseed += prior_images``). We reproduce the
+same *user-visible contract* — image ``i`` of a batch depends only on
+``(seed + i)`` — with JAX PRNG keys: image ``i``'s initial latent noise is
+``normal(key(seed + i))``, so any contiguous sub-batch [lo, hi) of a request
+can be generated on any shard/slice and produce bitwise-identical latents.
+
+Subseed (variation seed) support mirrors webui semantics: the init noise is
+``slerp(subseed_strength, noise(subseed + i), noise(seed + i))``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def key_for_image(seed, image_index) -> jax.Array:
+    """PRNG key for image ``image_index`` of a request seeded with ``seed``.
+
+    Accepts traced values: seeds stay *data*, not compile-time constants, so
+    one compiled pipeline serves every seed.
+    """
+    seed = jnp.asarray(seed, jnp.uint32)
+    idx = jnp.asarray(image_index, jnp.uint32)
+    return _key_from_seed(seed + idx)
+
+
+def _key_from_seed(seed: jax.Array) -> jax.Array:
+    # jax.random.PRNGKey is not traceable pre-0.4; key_from_seed via fold_in is.
+    base = jax.random.key(0)
+    return jax.random.fold_in(base, seed.astype(jnp.uint32))
+
+
+def noise_for_image(
+    seed,
+    subseed,
+    subseed_strength,
+    image_index,
+    shape: Sequence[int],
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Initial latent noise for one image, with variation-seed blending.
+
+    With ``subseed_strength == 0`` this is exactly ``N(key(seed+i))``; the
+    reference's seed-offset arithmetic (distributed.py:297-305) falls out of
+    the ``+ image_index`` term.
+    """
+    main = jax.random.normal(key_for_image(seed, image_index), shape, dtype)
+
+    def blended(_):
+        sub = jax.random.normal(key_for_image(subseed, image_index), shape, dtype)
+        return slerp(jnp.asarray(subseed_strength, dtype), main, sub)
+
+    strength = jnp.asarray(subseed_strength, dtype)
+    return jax.lax.cond(strength > 0, blended, lambda _: main, operand=None)
+
+
+def batch_noise(
+    seed,
+    subseed,
+    subseed_strength,
+    start_index,
+    batch_size: int,
+    shape: Sequence[int],
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Noise for a contiguous sub-batch starting at global image ``start_index``.
+
+    This is the sharding-safe primitive: a job assigned images
+    [start, start+batch) calls this and gets latents identical to a
+    single-host run — seed-exact gallery merging for free.
+    """
+    idx = jnp.arange(batch_size, dtype=jnp.uint32) + jnp.asarray(start_index, jnp.uint32)
+    return jax.vmap(
+        lambda i: noise_for_image(seed, subseed, subseed_strength, i, shape, dtype)
+    )(idx)
+
+
+def slerp(t: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Spherical linear interpolation between noise tensors (webui semantics)."""
+    a_flat = a.reshape(-1)
+    b_flat = b.reshape(-1)
+    a_norm = a_flat / (jnp.linalg.norm(a_flat) + 1e-12)
+    b_norm = b_flat / (jnp.linalg.norm(b_flat) + 1e-12)
+    dot = jnp.clip(jnp.dot(a_norm, b_norm), -1.0, 1.0)
+    theta = jnp.arccos(dot)
+    sin_theta = jnp.sin(theta)
+
+    def lerp(_):
+        return (1.0 - t) * a + t * b
+
+    def true_slerp(_):
+        wa = jnp.sin((1.0 - t) * theta) / sin_theta
+        wb = jnp.sin(t * theta) / sin_theta
+        return wa * a + wb * b
+
+    return jax.lax.cond(jnp.abs(sin_theta) < 1e-6, lerp, true_slerp, operand=None)

@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX initializes.
+
+Multi-chip behavior (shard_map/pjit over a Mesh) is tested without TPU
+hardware per the standard JAX recipe: 8 virtual CPU devices via XLA_FLAGS.
+"""
+
+import os
+
+# Must be set before jax (or anything importing jax) is imported.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "tp"))

@@ -1,0 +1,123 @@
+"""Core runtime tests: config persistence/migration, RNG seed contract, logging."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.runtime import rng
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    BenchmarkPayload,
+    ConfigModel,
+    WorkerModel,
+    load_config,
+    save_config,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+    configure,
+    get_ring_buffer,
+)
+
+
+class TestConfig:
+    def test_defaults_match_reference_schema(self):
+        cfg = ConfigModel()
+        # Reference defaults: pmodels.py:42 job_timeout=3; shared.py:67-77 payload.
+        assert cfg.job_timeout == 3
+        bp = cfg.benchmark_payload
+        assert bp.prompt.startswith("A herd of cows")
+        assert (bp.width, bp.height, bp.steps, bp.batch_size) == (512, 512, 20, 1)
+
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cfg.json")
+        cfg = ConfigModel(
+            workers=[{"slice0": WorkerModel(address="10.0.0.2", avg_ipm=12.5)}],
+            job_timeout=7,
+        )
+        save_config(cfg, path)
+        loaded = load_config(path)
+        assert loaded.job_timeout == 7
+        assert loaded.workers[0]["slice0"].avg_ipm == 12.5
+
+    def test_missing_file_yields_defaults(self, tmp_path):
+        cfg = load_config(str(tmp_path / "nope.json"))
+        assert cfg == ConfigModel()
+
+    def test_corrupt_file_quarantined(self, tmp_path):
+        path = str(tmp_path / "cfg.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        cfg = load_config(path)
+        assert cfg == ConfigModel()
+        assert not os.path.exists(path)  # moved aside
+        assert any("corrupt" in p for p in os.listdir(tmp_path))
+
+    def test_legacy_list_migration(self, tmp_path):
+        path = str(tmp_path / "workers.json")
+        with open(path, "w") as f:
+            json.dump([{"label": "gpu1", "address": "host1", "port": 7861}], f)
+        cfg = load_config(path)
+        assert cfg.workers[0]["gpu1"].address == "host1"
+
+
+class TestRng:
+    """The seed contract: image i depends only on (seed + i) — the reference's
+    seed-offset fan-out (distributed.py:297-305) reproduced exactly."""
+
+    def test_subbatch_equals_full_batch(self):
+        shape = (4, 8, 8)
+        full = rng.batch_noise(123, 0, 0.0, 0, 6, shape)
+        part = rng.batch_noise(123, 0, 0.0, 4, 2, shape)
+        np.testing.assert_array_equal(np.asarray(full[4:6]), np.asarray(part))
+
+    def test_offset_seed_equivalence(self):
+        # Worker B starting at index 3 of seed 100 == fresh request seeded 103.
+        shape = (2, 4, 4)
+        a = rng.batch_noise(100, 0, 0.0, 3, 1, shape)
+        b = rng.batch_noise(103, 0, 0.0, 0, 1, shape)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_different_seeds_differ(self):
+        shape = (2, 4, 4)
+        a = rng.noise_for_image(1, 0, 0.0, 0, shape)
+        b = rng.noise_for_image(2, 0, 0.0, 0, shape)
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_subseed_blend(self):
+        shape = (2, 4, 4)
+        base = rng.noise_for_image(1, 999, 0.0, 0, shape)
+        blended = rng.noise_for_image(1, 999, 0.5, 0, shape)
+        pure_sub = rng.noise_for_image(999, 0, 0.0, 0, shape)
+        assert not np.array_equal(np.asarray(base), np.asarray(blended))
+        assert not np.array_equal(np.asarray(pure_sub), np.asarray(blended))
+        # strength 0 reproduces the base exactly
+        again = rng.noise_for_image(1, 999, 0.0, 0, shape)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(again))
+
+    def test_jittable_with_traced_seed(self):
+        import jax
+
+        f = jax.jit(lambda s: rng.noise_for_image(s, 0, 0.0, 0, (2, 2)))
+        a, b = f(jnp.uint32(5)), f(jnp.uint32(6))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_slerp_endpoints(self):
+        a = jnp.ones((8,))
+        b = -jnp.ones((8,)) + 0.1
+        np.testing.assert_allclose(np.asarray(rng.slerp(0.0, a, b)), np.asarray(a), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rng.slerp(1.0, a, b)), np.asarray(b), atol=1e-5)
+
+
+class TestLogging:
+    def test_ring_buffer(self):
+        logger = configure(debug=True, use_rich=False)
+        ring = get_ring_buffer()
+        ring.clear()
+        for i in range(20):
+            logger.info("msg %d", i)
+        lines = ring.dump()
+        assert len(lines) == 16  # capacity parity with shared.py:44
+        assert lines[-1].endswith("msg 19")
+        assert lines[0].endswith("msg 4")
