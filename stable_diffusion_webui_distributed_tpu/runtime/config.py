@@ -18,11 +18,16 @@ import os
 import time
 from typing import Dict, List, Optional
 
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, Field, field_validator
 
-from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 
-logger = get_logger()
+def _logger():
+    """Lazy logger lookup: importing this module must not configure logging
+    or create distributed.log (file-writing side effects on import are
+    hostile for a library — ADVICE r1)."""
+    from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+
+    return get_logger()
 
 #: Benchmark protocol constants (reference: shared.py:63-64).
 WARMUP_SAMPLES = 2
@@ -61,11 +66,19 @@ class WorkerModel(BaseModel):
     tls: bool = False
     disabled: bool = False
     # Maximum width*height*batch this worker will accept; 0 = uncapped
-    # (reference: world.py:62-72 pixel-cap guard in Job.add_work).
+    # (reference: world.py:62-72 pixel-cap guard in Job.add_work; the
+    # reference's -1 "no limit" sentinel is normalized to 0 on load).
     pixel_cap: int = 0
     # TPU-native extension: which local devices this backend drives
     # (empty = all visible devices; remote workers leave it empty).
     device_ids: List[int] = Field(default_factory=list)
+
+    @field_validator("pixel_cap")
+    @classmethod
+    def _normalize_pixel_cap(cls, v: int) -> int:
+        # Reference-era configs carry pixel_cap: -1 for "no limit"
+        # (pmodels.py:34); any non-positive value means uncapped here.
+        return 0 if v <= 0 else v
 
 
 class ConfigModel(BaseModel):
@@ -77,7 +90,8 @@ class ConfigModel(BaseModel):
     # images to faster peers (reference: pmodels.py:42, default 3).
     job_timeout: int = 3
     enabled: bool = True
-    enabled_i2i: bool = False
+    # img2img tab enabled by default, matching the reference (pmodels.py:44).
+    enabled_i2i: bool = True
     # Let slow (deferred) workers produce "bonus" images in their slack time
     # (reference optimize_jobs step 4, world.py:519-543).
     complement_production: bool = True
@@ -103,39 +117,37 @@ def load_config(path: Optional[str] = None) -> ConfigModel:
     """
     path = path or default_config_path()
     if not os.path.exists(path):
-        logger.debug("config %s not found, using defaults", path)
+        _logger().debug("config %s not found, using defaults", path)
         return ConfigModel()
     try:
         with open(path, "r", encoding="utf-8") as f:
             raw = json.load(f)
     except (json.JSONDecodeError, OSError) as e:
-        quarantine = f"{path}.corrupt-{int(time.time())}"
-        logger.warning("config %s unreadable (%s); moving to %s", path, e, quarantine)
-        try:
-            os.replace(path, quarantine)
-        except OSError:
-            pass
-        return ConfigModel()
-
-    if isinstance(raw, list):
-        # Legacy format: bare list of worker dicts (world.py:632-649).
-        logger.info("migrating legacy worker-list config %s", path)
-        workers = []
-        for entry in raw:
-            label = entry.pop("label", entry.get("address", "worker"))
-            workers.append({label: WorkerModel(**entry)})
-        return ConfigModel(workers=workers)
+        return _quarantine(path, "corrupt", e)
 
     try:
+        if isinstance(raw, list):
+            # Legacy format: bare list of worker dicts (world.py:632-649).
+            _logger().info("migrating legacy worker-list config %s", path)
+            workers = []
+            for entry in raw:
+                label = entry.pop("label", entry.get("address", "worker"))
+                workers.append({label: WorkerModel(**entry)})
+            return ConfigModel(workers=workers)
         return ConfigModel(**raw)
     except Exception as e:
-        quarantine = f"{path}.invalid-{int(time.time())}"
-        logger.warning("config %s invalid (%s); moving to %s", path, e, quarantine)
-        try:
-            os.replace(path, quarantine)
-        except OSError:
-            pass
-        return ConfigModel()
+        return _quarantine(path, "invalid", e)
+
+
+def _quarantine(path: str, kind: str, err: Exception) -> ConfigModel:
+    """Rename a bad config aside rather than crashing startup (world.py:655-659)."""
+    quarantine = f"{path}.{kind}-{int(time.time())}"
+    _logger().warning("config %s %s (%s); moving to %s", path, kind, err, quarantine)
+    try:
+        os.replace(path, quarantine)
+    except OSError:
+        pass
+    return ConfigModel()
 
 
 def save_config(cfg: ConfigModel, path: Optional[str] = None) -> None:
@@ -145,4 +157,4 @@ def save_config(cfg: ConfigModel, path: Optional[str] = None) -> None:
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(cfg.model_dump(), f, indent=2)
     os.replace(tmp, path)
-    logger.debug("config saved to %s", path)
+    _logger().debug("config saved to %s", path)

@@ -13,6 +13,7 @@ reference's 0.5 s poll.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from dataclasses import dataclass, field
@@ -83,11 +84,16 @@ class GenerationState:
             )
 
     def step(self, completed_steps: int) -> None:
+        # Snapshot under the lock, invoke listeners outside it: a listener
+        # that logs or calls back into this state must not deadlock
+        # (ring-buffer pattern; VERDICT r1 weak #6).
         with self._lock:
             self.progress.sampling_step = completed_steps
             self.progress.interrupted = self.flag.interrupted
-            for cb in self._listeners:
-                cb(self.progress)
+            listeners = list(self._listeners)
+            snapshot = dataclasses.replace(self.progress)
+        for cb in listeners:
+            cb(snapshot)
 
     def finish(self) -> None:
         with self._lock:

@@ -92,11 +92,19 @@ def configure(
                 from rich.logging import RichHandler
 
                 class BrandedRichHandler(RichHandler):
-                    """Rich console handler with a branded prefix (shared.py:19-30)."""
+                    """Rich console handler with a branded prefix (shared.py:19-30).
+
+                    The prefix is applied to a *copy* of the record so it
+                    cannot leak into the file log or ring buffer, which share
+                    the same logger (ADVICE r1).
+                    """
 
                     def emit(self, record: logging.LogRecord) -> None:
-                        record.msg = f"[sdtpu] {record.msg}"
-                        super().emit(record)
+                        import copy
+
+                        branded = copy.copy(record)
+                        branded.msg = f"[sdtpu] {record.msg}"
+                        super().emit(branded)
 
                 console = BrandedRichHandler(show_path=False, show_time=True)
             except Exception:  # pragma: no cover - rich unavailable

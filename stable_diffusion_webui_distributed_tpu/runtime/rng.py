@@ -9,8 +9,12 @@ same *user-visible contract* — image ``i`` of a batch depends only on
 ``normal(key(seed + i))``, so any contiguous sub-batch [lo, hi) of a request
 can be generated on any shard/slice and produce bitwise-identical latents.
 
-Subseed (variation seed) support mirrors webui semantics: the init noise is
-``slerp(subseed_strength, noise(subseed + i), noise(seed + i))``.
+Subseed (variation seed) support mirrors webui semantics exactly
+(distributed.py:297-305): the *main* seed advances with the image index only
+when ``subseed_strength == 0``; with strength > 0 the base seed is fixed for
+every image of the request and only the subseed advances, so a variation
+batch explores the neighbourhood of ONE base noise. The init noise is
+``slerp(strength, noise(seed [+ i if strength==0]), noise(subseed + i))``.
 """
 
 from __future__ import annotations
@@ -48,17 +52,21 @@ def noise_for_image(
 ) -> jax.Array:
     """Initial latent noise for one image, with variation-seed blending.
 
-    With ``subseed_strength == 0`` this is exactly ``N(key(seed+i))``; the
-    reference's seed-offset arithmetic (distributed.py:297-305) falls out of
-    the ``+ image_index`` term.
+    With ``subseed_strength == 0`` this is exactly ``N(key(seed+i))``. With
+    strength > 0 the base seed does NOT advance with the image index — only
+    the subseed does (reference: distributed.py:297-305, mirroring webui's
+    ``all_seeds``/``all_subseeds`` arithmetic) — so every image of a
+    variation batch perturbs the same base noise.
     """
-    main = jax.random.normal(key_for_image(seed, image_index), shape, dtype)
+    strength = jnp.asarray(subseed_strength, dtype)
+    idx = jnp.asarray(image_index, jnp.uint32)
+    main_idx = jnp.where(strength > 0, jnp.uint32(0), idx)
+    main = jax.random.normal(key_for_image(seed, main_idx), shape, dtype)
 
     def blended(_):
-        sub = jax.random.normal(key_for_image(subseed, image_index), shape, dtype)
-        return slerp(jnp.asarray(subseed_strength, dtype), main, sub)
+        sub = jax.random.normal(key_for_image(subseed, idx), shape, dtype)
+        return slerp(strength, main, sub)
 
-    strength = jnp.asarray(subseed_strength, dtype)
     return jax.lax.cond(strength > 0, blended, lambda _: main, operand=None)
 
 

@@ -61,6 +61,66 @@ class TestConfig:
         cfg = load_config(path)
         assert cfg.workers[0]["gpu1"].address == "host1"
 
+    def test_legacy_list_with_bad_entry_quarantined(self, tmp_path):
+        # A non-dict entry in a legacy list must quarantine, not crash
+        # (ADVICE r1: migration was outside the try/except).
+        path = str(tmp_path / "workers.json")
+        with open(path, "w") as f:
+            json.dump([{"label": "ok", "address": "host1"}, "not-a-dict"], f)
+        cfg = load_config(path)
+        assert cfg == ConfigModel()
+        assert any("invalid" in p for p in os.listdir(tmp_path))
+
+    def test_reference_format_config_accepted(self, tmp_path):
+        # A reference-era distributed-config.json carries worker fields this
+        # schema doesn't define (`state`) and the -1 pixel_cap sentinel
+        # (reference pmodels.py:12-34). It must load, not quarantine
+        # (VERDICT r1 weak #5).
+        path = str(tmp_path / "cfg.json")
+        ref_cfg = {
+            "workers": [
+                {
+                    "laptop": {
+                        "address": "192.168.1.3",
+                        "port": 7860,
+                        "avg_ipm": 4.2,
+                        "master": False,
+                        "eta_percent_error": [1.5, -2.0],
+                        "user": None,
+                        "password": None,
+                        "tls": False,
+                        "state": 1,
+                        "disabled": False,
+                        "pixel_cap": -1,
+                    }
+                }
+            ],
+            "benchmark_payload": {
+                "prompt": "A herd of cows grazing at the bottom of a sunny valley",
+                "negative_prompt": "",
+                "steps": 20,
+                "width": 512,
+                "height": 512,
+                "batch_size": 1,
+            },
+            "job_timeout": 3,
+            "enabled": True,
+            "enabled_i2i": True,
+            "complement_production": True,
+            "step_scaling": False,
+        }
+        with open(path, "w") as f:
+            json.dump(ref_cfg, f)
+        cfg = load_config(path)
+        assert os.path.exists(path)  # not quarantined
+        w = cfg.workers[0]["laptop"]
+        assert w.avg_ipm == 4.2
+        assert w.pixel_cap == 0  # -1 sentinel normalized to uncapped
+
+    def test_defaults_parity_with_reference(self):
+        cfg = ConfigModel()
+        assert cfg.enabled_i2i is True  # reference pmodels.py:44
+
 
 class TestRng:
     """The seed contract: image i depends only on (seed + i) — the reference's
@@ -95,6 +155,27 @@ class TestRng:
         # strength 0 reproduces the base exactly
         again = rng.noise_for_image(1, 999, 0.0, 0, shape)
         np.testing.assert_array_equal(np.asarray(base), np.asarray(again))
+
+    def test_variation_batch_shares_base_noise(self):
+        # webui/reference contract (distributed.py:297-305): with
+        # subseed_strength > 0 the base seed does NOT advance per image —
+        # only the subseed does. Images at different indices must converge
+        # to the SAME base noise as strength -> 0.
+        shape = (2, 4, 4)
+        eps = 1e-4
+        near0_idx0 = rng.noise_for_image(7, 99, eps, 0, shape)
+        near0_idx3 = rng.noise_for_image(7, 99, eps, 3, shape)
+        base = rng.noise_for_image(7, 99, 0.0, 0, shape)
+        np.testing.assert_allclose(
+            np.asarray(near0_idx0), np.asarray(base), atol=1e-2
+        )
+        np.testing.assert_allclose(
+            np.asarray(near0_idx3), np.asarray(base), atol=1e-2
+        )
+        # while at real strength the subseed component still varies by index
+        s_idx0 = rng.noise_for_image(7, 99, 0.5, 0, shape)
+        s_idx3 = rng.noise_for_image(7, 99, 0.5, 3, shape)
+        assert not np.array_equal(np.asarray(s_idx0), np.asarray(s_idx3))
 
     def test_jittable_with_traced_seed(self):
         import jax
