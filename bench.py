@@ -1,0 +1,103 @@
+"""Benchmark: BASELINE config #1 on the real TPU chip.
+
+Protocol is the reference's own self-benchmark
+(/root/reference/scripts/spartan/worker.py:506-575, shared.py:63-77):
+the fixed "herd of cows" payload — SD 1.5 txt2img, 512x512, 20 steps,
+Euler a, batch 1 — measured as 2 warmup + 3 recorded samples, metric
+images-per-minute (ipm = batch / (seconds/60), worker.py:522-533).
+
+Weights are zero-initialized SD 1.5 architecture: throughput is
+weight-value-independent (same graphs, same FLOPs), and the image has no
+network egress to fetch trained checkpoints.
+
+Prints exactly ONE JSON line on stdout. ``vs_baseline`` compares against a
+nominal 30 ipm — the ballpark a single CUDA sdwui worker of the reference's
+era sustains on this payload (the reference publishes no numbers at all,
+BASELINE.md; its ipm is measured per-installation).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+NOMINAL_SINGLE_GPU_IPM = 30.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from stable_diffusion_webui_distributed_tpu.models.configs import SD15
+    from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        BenchmarkPayload,
+        WARMUP_SAMPLES,
+        RECORDED_SAMPLES,
+    )
+
+    dev = jax.devices()[0]
+    print(f"bench: device={dev.device_kind} platform={dev.platform}",
+          file=sys.stderr)
+
+    family = SD15
+    zeros = lambda mod, *args: jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: mod.init(jax.random.key(0), *args)))["params"]
+
+    from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
+    from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+    from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+
+    t0 = time.time()
+    ids = jnp.zeros((1, 77), jnp.int32)
+    params = {
+        "text_encoder": zeros(CLIPTextModel(family.text_encoder), ids),
+        "text_encoder_2": None,
+        "unet": zeros(
+            UNet(family.unet),
+            jnp.zeros((2, 64, 64, 4)), jnp.ones((2,)),
+            jnp.zeros((2, 77, family.unet.cross_attention_dim))),
+        "vae": zeros(
+            VAE(family.vae),
+            jnp.zeros((1, 512, 512, 3)), jax.random.key(1)),
+    }
+    print(f"bench: zero-init params in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    engine = Engine(family, params, policy=dtypes.TPU, model_name="sd15-bench")
+
+    bp = BenchmarkPayload()  # the reference's fixed calibration workload
+    payload = GenerationPayload(
+        prompt=bp.prompt, negative_prompt=bp.negative_prompt, steps=bp.steps,
+        width=bp.width, height=bp.height, batch_size=bp.batch_size,
+        sampler_name=bp.sampler_name, seed=1,
+    )
+
+    samples = []
+    for i in range(WARMUP_SAMPLES + RECORDED_SAMPLES):
+        t0 = time.time()
+        result = engine.txt2img(payload)
+        elapsed = time.time() - t0
+        assert len(result.images) == bp.batch_size
+        kind = "warmup" if i < WARMUP_SAMPLES else "sample"
+        print(f"bench: {kind} {i}: {elapsed:.2f}s", file=sys.stderr)
+        if i >= WARMUP_SAMPLES:
+            samples.append(elapsed)
+
+    avg = sum(samples) / len(samples)
+    ipm = bp.batch_size / (avg / 60.0)
+    print(json.dumps({
+        "metric": "sd15_512x512_20step_euler_a_ipm",
+        "value": round(ipm, 2),
+        "unit": "images/min",
+        "vs_baseline": round(ipm / NOMINAL_SINGLE_GPU_IPM, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Flax model zoo: CLIP text encoders, UNet, VAE — the compute substrate.
+
+The reference delegates all of this to each node's AUTOMATIC1111 webui over
+HTTP (/root/reference/scripts/spartan/worker.py:432-435 calls
+``/sdapi/v1/txt2img``; the UNet/CLIP/VAE live in upstream webui). This
+framework has no external substrate: the full diffusion stack is implemented
+here as Flax modules compiled by XLA, designed TPU-first (NHWC layouts, bf16
+matmuls with f32-pinned normalization, static shapes, scan-friendly loops).
+"""
+
+from stable_diffusion_webui_distributed_tpu.models.configs import (  # noqa: F401
+    CLIPTextConfig,
+    ModelFamily,
+    SDModelConfig,
+    UNetConfig,
+    VAEConfig,
+    SD15,
+    SDXL_BASE,
+    TINY,
+)

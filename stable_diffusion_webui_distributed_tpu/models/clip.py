@@ -1,0 +1,135 @@
+"""CLIP / OpenCLIP text encoders in Flax.
+
+Replaces the text-conditioning stage the reference outsources to each sdwui
+node (the ``prompt``/``negative_prompt`` fields of the payloads built at
+/root/reference/scripts/distributed.py:239-265 are encoded by webui's bundled
+CLIP on every worker). TPU-first choices: one fused QKV projection per layer
+(bigger MXU matmuls than three separate GEMMs), bf16 compute with f32
+layer-norm statistics, static 77-token sequence length (no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.configs import CLIPTextConfig
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu":
+        return nn.gelu
+    raise ValueError(f"unknown activation {name}")
+
+
+class CLIPAttention(nn.Module):
+    cfg: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        # Fused QKV: one (hidden, 3*hidden) matmul keeps the MXU busy.
+        qkv = nn.Dense(3 * c.hidden_size, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        out = jax.nn.dot_product_attention(
+            q, k, v, bias=mask.astype(q.dtype), scale=1.0 / head_dim**0.5
+        )
+        out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
+        return nn.Dense(c.hidden_size, dtype=self.dtype, name="out_proj")(out)
+
+
+class CLIPLayer(nn.Module):
+    cfg: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        c = self.cfg
+        # Pre-LN transformer; layer norms in f32 for stable statistics.
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CLIPAttention(c, dtype=self.dtype, name="attn")(h, mask)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(c.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        h = _act(c.hidden_act)(h)
+        h = nn.Dense(c.hidden_size, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class CLIPTextModel(nn.Module):
+    """Causal text transformer.
+
+    ``__call__`` returns ``(context, pooled)``:
+
+    - ``context``: the hidden states fed to UNet cross-attention, taken
+      ``skip`` layers before the end (``skip=0`` → final-LN output, the SD1.5
+      default; ``skip=1`` → penultimate layer, webui's "clip skip 2" and the
+      SDXL convention).
+    - ``pooled``: the EOS-position embedding of the *final* layer (after
+      final LN), passed through ``text_projection`` when configured — SDXL's
+      micro-conditioning input.
+    """
+
+    cfg: CLIPTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,            # (B, T) int32
+        skip: Optional[int] = None,
+        eos_index: Optional[jax.Array] = None,  # (B,) position of EOS token
+    ):
+        c = self.cfg
+        skip = c.default_skip if skip is None else skip
+        B, T = input_ids.shape
+
+        tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,
+                       name="token_embedding")(input_ids)
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.01),
+            (c.max_length, c.hidden_size),
+        )
+        x = tok + pos[None, :T].astype(self.dtype)
+
+        causal = jnp.triu(jnp.full((T, T), -1e9), k=1)[None, None]
+
+        hidden = None
+        for i in range(c.num_layers):
+            x = CLIPLayer(c, dtype=self.dtype, name=f"layer_{i}")(x, causal)
+            if i == c.num_layers - 1 - skip:
+                hidden = x
+        assert hidden is not None, f"skip={skip} exceeds depth {c.num_layers}"
+
+        final_ln = nn.LayerNorm(dtype=jnp.float32, name="final_ln")
+        final = final_ln(x)
+        if skip == 0:
+            context = final
+        elif c.layernorm_skipped:
+            # webui SD1.x clip-skip: earlier hidden state re-normalized by
+            # the (shared) final LayerNorm.
+            context = final_ln(hidden)
+        else:
+            context = hidden  # raw penultimate (SDXL/sgm convention)
+
+        if eos_index is None:
+            eos_index = jnp.argmax(input_ids, axis=-1)  # EOS has max token id
+        pooled = jnp.take_along_axis(
+            final, eos_index[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        if c.projection_dim:
+            pooled = nn.Dense(c.projection_dim, use_bias=False,
+                              dtype=self.dtype, name="text_projection")(pooled)
+        return context.astype(self.dtype), pooled.astype(self.dtype)

@@ -1,0 +1,442 @@
+"""Checkpoint conversion: SD single-file (ldm) state dicts -> Flax param trees.
+
+webui nodes in the reference deployment load ``*.safetensors`` single-file
+checkpoints by name (synced across workers via ``/sdapi/v1/options``,
+/root/reference/scripts/spartan/worker.py:646-688). This module lets the same
+files drive the TPU framework: it maps the ldm key layout —
+``model.diffusion_model.*`` (UNet), ``first_stage_model.*`` (VAE),
+``cond_stage_model.transformer.*`` / ``conditioner.embedders.*`` (text
+encoders) — onto this package's Flax modules, fusing separate q/k/v
+projections into the single QKV matmuls the TPU modules use.
+
+Layout transforms (torch -> flax):
+  Linear  (O, I)        -> kernel (I, O)
+  Conv2d  (O, I, kh, kw) -> kernel (kh, kw, I, O)
+  1x1 Conv used as Linear -> kernel (I, O)
+  GroupNorm/LayerNorm weight -> scale
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.models.configs import (
+    CLIPTextConfig,
+    ModelFamily,
+    UNetConfig,
+    VAEConfig,
+)
+
+Array = np.ndarray
+StateDict = Dict[str, Array]
+
+
+class MissingKeys(KeyError):
+    """Raised with the full list of absent checkpoint keys."""
+
+
+class _Puller:
+    """Tracks which checkpoint keys were consumed; reports leftovers."""
+
+    def __init__(self, sd: StateDict):
+        self.sd = sd
+        self.used: set = set()
+        self.missing: List[str] = []
+
+    def take(self, key: str) -> Array:
+        if key not in self.sd:
+            self.missing.append(key)
+            return np.zeros((1,), np.float32)
+        self.used.add(key)
+        return np.asarray(self.sd[key])
+
+    def has(self, key: str) -> bool:
+        return key in self.sd
+
+    def finish(self, scope: str) -> None:
+        if self.missing:
+            raise MissingKeys(
+                f"{scope}: {len(self.missing)} keys absent, first 10: "
+                f"{self.missing[:10]}"
+            )
+
+
+def _linear(p: _Puller, key: str, bias: bool = True) -> Dict[str, Array]:
+    w = p.take(f"{key}.weight")
+    if w.ndim == 4:  # 1x1 conv used as linear (SD1.x proj_in/out, VAE attn)
+        w = w[:, :, 0, 0]
+    out = {"kernel": w.T}
+    if bias:
+        out["bias"] = p.take(f"{key}.bias")
+    return out
+
+
+def _conv(p: _Puller, key: str) -> Dict[str, Array]:
+    w = p.take(f"{key}.weight")
+    return {"kernel": w.transpose(2, 3, 1, 0), "bias": p.take(f"{key}.bias")}
+
+
+def _norm(p: _Puller, key: str) -> Dict[str, Array]:
+    return {"scale": p.take(f"{key}.weight"), "bias": p.take(f"{key}.bias")}
+
+
+def _gn(p: _Puller, key: str) -> Dict[str, Dict[str, Array]]:
+    return {"gn": _norm(p, key)}
+
+
+def _fused(mats: Sequence[Array], biases: Optional[Sequence[Array]] = None):
+    """Concatenate separate projection weights into one fused kernel."""
+    out = {"kernel": np.concatenate([m.T for m in mats], axis=1)}
+    if biases is not None:
+        out["bias"] = np.concatenate(list(biases))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Text encoders
+# --------------------------------------------------------------------------
+
+def convert_clip_hf(sd: StateDict, cfg: CLIPTextConfig, prefix: str) -> Dict:
+    """HF ``text_model`` layout (SD1.x ``cond_stage_model.transformer``,
+    SDXL ``conditioner.embedders.0.transformer``)."""
+    p = _Puller(sd)
+    out: Dict = {
+        "token_embedding": {
+            "embedding": p.take(f"{prefix}.embeddings.token_embedding.weight")
+        },
+        "position_embedding": p.take(
+            f"{prefix}.embeddings.position_embedding.weight"
+        ),
+        "final_ln": _norm(p, f"{prefix}.final_layer_norm"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}.encoder.layers.{i}"
+        qw = p.take(f"{lp}.self_attn.q_proj.weight")
+        kw = p.take(f"{lp}.self_attn.k_proj.weight")
+        vw = p.take(f"{lp}.self_attn.v_proj.weight")
+        qb = p.take(f"{lp}.self_attn.q_proj.bias")
+        kb = p.take(f"{lp}.self_attn.k_proj.bias")
+        vb = p.take(f"{lp}.self_attn.v_proj.bias")
+        out[f"layer_{i}"] = {
+            "ln1": _norm(p, f"{lp}.layer_norm1"),
+            "ln2": _norm(p, f"{lp}.layer_norm2"),
+            "attn": {
+                "qkv": _fused([qw, kw, vw], [qb, kb, vb]),
+                "out_proj": _linear(p, f"{lp}.self_attn.out_proj"),
+            },
+            "fc1": _linear(p, f"{lp}.mlp.fc1"),
+            "fc2": _linear(p, f"{lp}.mlp.fc2"),
+        }
+    if cfg.projection_dim:
+        # HF keeps text_projection outside text_model, on the wrapper.
+        parent = prefix.rsplit(".text_model", 1)[0]
+        out["text_projection"] = {
+            "kernel": p.take(f"{parent}.text_projection.weight").T
+        }
+    p.finish(f"clip[{prefix}]")
+    return out
+
+
+def convert_clip_openai(sd: StateDict, cfg: CLIPTextConfig, prefix: str) -> Dict:
+    """OpenCLIP ``model`` layout (SDXL ``conditioner.embedders.1.model``):
+    fused ``in_proj_weight``, ``resblocks`` naming, raw ``text_projection``."""
+    p = _Puller(sd)
+    out: Dict = {
+        "token_embedding": {"embedding": p.take(f"{prefix}.token_embedding.weight")},
+        "position_embedding": p.take(f"{prefix}.positional_embedding"),
+        "final_ln": _norm(p, f"{prefix}.ln_final"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}.transformer.resblocks.{i}"
+        out[f"layer_{i}"] = {
+            "ln1": _norm(p, f"{lp}.ln_1"),
+            "ln2": _norm(p, f"{lp}.ln_2"),
+            "attn": {
+                "qkv": {
+                    "kernel": p.take(f"{lp}.attn.in_proj_weight").T,
+                    "bias": p.take(f"{lp}.attn.in_proj_bias"),
+                },
+                "out_proj": _linear(p, f"{lp}.attn.out_proj"),
+            },
+            "fc1": _linear(p, f"{lp}.mlp.c_fc"),
+            "fc2": _linear(p, f"{lp}.mlp.c_proj"),
+        }
+    if cfg.projection_dim:
+        # open_clip stores text_projection as (width, embed_dim), applied as
+        # x @ proj -> already (I, O): no transpose.
+        out["text_projection"] = {"kernel": p.take(f"{prefix}.text_projection")}
+    p.finish(f"openclip[{prefix}]")
+    return out
+
+
+# --------------------------------------------------------------------------
+# UNet
+# --------------------------------------------------------------------------
+
+def _res_block(p: _Puller, key: str, has_skip: bool) -> Dict:
+    out = {
+        "norm1": _gn(p, f"{key}.in_layers.0"),
+        "conv1": _conv(p, f"{key}.in_layers.2"),
+        "time_proj": _linear(p, f"{key}.emb_layers.1"),
+        "norm2": _gn(p, f"{key}.out_layers.0"),
+        "conv2": _conv(p, f"{key}.out_layers.3"),
+    }
+    if has_skip:
+        w = p.take(f"{key}.skip_connection.weight")
+        out["skip"] = {"kernel": w.transpose(2, 3, 1, 0),
+                       "bias": p.take(f"{key}.skip_connection.bias")}
+    return out
+
+
+def _transformer(p: _Puller, key: str, depth: int) -> Dict:
+    out: Dict = {
+        "norm": _gn(p, f"{key}.norm"),
+        "proj_in": _linear(p, f"{key}.proj_in"),
+        "proj_out": _linear(p, f"{key}.proj_out"),
+    }
+    for d in range(depth):
+        bp = f"{key}.transformer_blocks.{d}"
+        qw = p.take(f"{bp}.attn1.to_q.weight")
+        kw = p.take(f"{bp}.attn1.to_k.weight")
+        vw = p.take(f"{bp}.attn1.to_v.weight")
+        out[f"block_{d}"] = {
+            "ln1": _norm(p, f"{bp}.norm1"),
+            "ln2": _norm(p, f"{bp}.norm2"),
+            "ln3": _norm(p, f"{bp}.norm3"),
+            "attn1": {
+                "qkv": _fused([qw, kw, vw]),
+                "out_proj": _linear(p, f"{bp}.attn1.to_out.0"),
+            },
+            "attn2": {
+                "q": {"kernel": p.take(f"{bp}.attn2.to_q.weight").T},
+                "kv": _fused([
+                    p.take(f"{bp}.attn2.to_k.weight"),
+                    p.take(f"{bp}.attn2.to_v.weight"),
+                ]),
+                "out_proj": _linear(p, f"{bp}.attn2.to_out.0"),
+            },
+            "geglu": {"proj": _linear(p, f"{bp}.ff.net.0.proj")},
+            "ff_out": _linear(p, f"{bp}.ff.net.2"),
+        }
+    return out
+
+
+def convert_unet(sd: StateDict, cfg: UNetConfig,
+                 prefix: str = "model.diffusion_model") -> Dict:
+    """ldm UNet layout -> :class:`~...models.unet.UNet` params.
+
+    Replays the ldm module-numbering scheme (input_blocks gain an index per
+    res/downsample entry, output_blocks append upsample to the level's last
+    block) so the mapping is generated from the config, not hard-coded.
+    """
+    p = _Puller(sd)
+    out: Dict = {
+        "time_fc1": _linear(p, f"{prefix}.time_embed.0"),
+        "time_fc2": _linear(p, f"{prefix}.time_embed.2"),
+        "conv_in": _conv(p, f"{prefix}.input_blocks.0.0"),
+        "norm_out": _gn(p, f"{prefix}.out.0"),
+        "conv_out": _conv(p, f"{prefix}.out.2"),
+    }
+    if cfg.addition_embed_dim:
+        out["add_fc1"] = _linear(p, f"{prefix}.label_emb.0.0")
+        out["add_fc2"] = _linear(p, f"{prefix}.label_emb.0.2")
+
+    levels = list(zip(cfg.block_out_channels, cfg.down_blocks))
+    n = 1
+    prev_ch = cfg.block_out_channels[0]
+    for level, (ch, depth) in enumerate(levels):
+        for i in range(cfg.layers_per_block):
+            key = f"{prefix}.input_blocks.{n}"
+            out[f"down_{level}_res_{i}"] = _res_block(p, f"{key}.0",
+                                                      has_skip=prev_ch != ch)
+            if depth is not None:
+                out[f"down_{level}_attn_{i}"] = _transformer(p, f"{key}.1", depth)
+            prev_ch = ch
+            n += 1
+        if level < len(levels) - 1:
+            out[f"down_{level}_ds"] = {
+                "conv": _conv(p, f"{prefix}.input_blocks.{n}.0.op")
+            }
+            n += 1
+
+    out["mid_res_0"] = _res_block(p, f"{prefix}.middle_block.0", has_skip=False)
+    mid_idx = 1
+    if cfg.mid_block_depth is not None:
+        out["mid_attn"] = _transformer(p, f"{prefix}.middle_block.1",
+                                       cfg.mid_block_depth)
+        mid_idx = 2
+    out["mid_res_1"] = _res_block(p, f"{prefix}.middle_block.{mid_idx}",
+                                  has_skip=False)
+
+    n = 0
+    for level in reversed(range(len(levels))):
+        ch, depth = levels[level]
+        for i in range(cfg.layers_per_block + 1):
+            key = f"{prefix}.output_blocks.{n}"
+            # concat skip always changes channel count -> always has skip conv
+            out[f"up_{level}_res_{i}"] = _res_block(p, f"{key}.0", has_skip=True)
+            idx = 1
+            if depth is not None:
+                out[f"up_{level}_attn_{i}"] = _transformer(p, f"{key}.1", depth)
+                idx = 2
+            if i == cfg.layers_per_block and level > 0:
+                out[f"up_{level}_us"] = {
+                    "conv": _conv(p, f"{key}.{idx}.conv")
+                }
+            n += 1
+
+    p.finish("unet")
+    return out
+
+
+# --------------------------------------------------------------------------
+# VAE
+# --------------------------------------------------------------------------
+
+def _vae_res(p: _Puller, key: str, has_skip: bool) -> Dict:
+    out = {
+        "norm1": _gn(p, f"{key}.norm1"),
+        "conv1": _conv(p, f"{key}.conv1"),
+        "norm2": _gn(p, f"{key}.norm2"),
+        "conv2": _conv(p, f"{key}.conv2"),
+    }
+    if has_skip:
+        out["skip"] = _linear(p, f"{key}.nin_shortcut")
+        out["skip"]["kernel"] = out["skip"]["kernel"][None, None] \
+            if out["skip"]["kernel"].ndim == 2 else out["skip"]["kernel"]
+    return out
+
+
+def _vae_attn(p: _Puller, key: str) -> Dict:
+    q = p.take(f"{key}.q.weight")[:, :, 0, 0]
+    k = p.take(f"{key}.k.weight")[:, :, 0, 0]
+    v = p.take(f"{key}.v.weight")[:, :, 0, 0]
+    return {
+        "norm": _gn(p, f"{key}.norm"),
+        "qkv": _fused([q, k, v], [
+            p.take(f"{key}.q.bias"),
+            p.take(f"{key}.k.bias"),
+            p.take(f"{key}.v.bias"),
+        ]),
+        "out_proj": _linear(p, f"{key}.proj_out"),
+    }
+
+
+def convert_vae(sd: StateDict, cfg: VAEConfig,
+                prefix: str = "first_stage_model") -> Dict:
+    p = _Puller(sd)
+    enc: Dict = {
+        "conv_in": _conv(p, f"{prefix}.encoder.conv_in"),
+        "mid_res_0": _vae_res(p, f"{prefix}.encoder.mid.block_1", False),
+        "mid_attn": _vae_attn(p, f"{prefix}.encoder.mid.attn_1"),
+        "mid_res_1": _vae_res(p, f"{prefix}.encoder.mid.block_2", False),
+        "norm_out": _gn(p, f"{prefix}.encoder.norm_out"),
+        "conv_out": _conv(p, f"{prefix}.encoder.conv_out"),
+        "quant_conv": _conv(p, f"{prefix}.quant_conv"),
+    }
+    prev = cfg.block_out_channels[0]
+    for level, ch in enumerate(cfg.block_out_channels):
+        for i in range(cfg.layers_per_block):
+            enc[f"down_{level}_res_{i}"] = _vae_res(
+                p, f"{prefix}.encoder.down.{level}.block.{i}",
+                has_skip=(i == 0 and prev != ch))
+        prev = ch
+        if level < len(cfg.block_out_channels) - 1:
+            enc[f"down_{level}_ds"] = _conv(
+                p, f"{prefix}.encoder.down.{level}.downsample.conv")
+
+    dec: Dict = {
+        "post_quant_conv": _conv(p, f"{prefix}.post_quant_conv"),
+        "conv_in": _conv(p, f"{prefix}.decoder.conv_in"),
+        "mid_res_0": _vae_res(p, f"{prefix}.decoder.mid.block_1", False),
+        "mid_attn": _vae_attn(p, f"{prefix}.decoder.mid.attn_1"),
+        "mid_res_1": _vae_res(p, f"{prefix}.decoder.mid.block_2", False),
+        "norm_out": _gn(p, f"{prefix}.decoder.norm_out"),
+        "conv_out": _conv(p, f"{prefix}.decoder.conv_out"),
+    }
+    prev = cfg.block_out_channels[-1]
+    for level in reversed(range(len(cfg.block_out_channels))):
+        ch = cfg.block_out_channels[level]
+        for i in range(cfg.layers_per_block + 1):
+            dec[f"up_{level}_res_{i}"] = _vae_res(
+                p, f"{prefix}.decoder.up.{level}.block.{i}",
+                has_skip=(i == 0 and prev != ch))
+        prev = ch
+        if level > 0:
+            dec[f"up_{level}_us"] = _conv(
+                p, f"{prefix}.decoder.up.{level}.upsample.conv")
+
+    p.finish("vae")
+    return {"encoder": enc, "decoder": dec}
+
+
+# --------------------------------------------------------------------------
+# Whole-checkpoint entry points
+# --------------------------------------------------------------------------
+
+def convert_ldm(sd: StateDict, family: ModelFamily) -> Dict[str, Optional[Dict]]:
+    """Convert a full single-file state dict for ``family``; returns params
+    per component: ``{"text_encoder", "text_encoder_2", "unet", "vae"}``."""
+    is_xl = family.text_encoder_2 is not None
+    if is_xl:
+        te = convert_clip_hf(sd, family.text_encoder,
+                             "conditioner.embedders.0.transformer.text_model")
+        te2 = convert_clip_openai(sd, family.text_encoder_2,
+                                  "conditioner.embedders.1.model")
+    else:
+        # SDXL-refiner-style single encoder also lives under embedders.0.
+        if any(k.startswith("conditioner.embedders.0.model.") for k in sd):
+            te = convert_clip_openai(sd, family.text_encoder,
+                                     "conditioner.embedders.0.model")
+        else:
+            te = convert_clip_hf(sd, family.text_encoder,
+                                 "cond_stage_model.transformer.text_model")
+        te2 = None
+    return {
+        "text_encoder": te,
+        "text_encoder_2": te2,
+        "unet": convert_unet(sd, family.unet),
+        "vae": convert_vae(sd, family.vae),
+    }
+
+
+def load_safetensors(path: str) -> StateDict:
+    """Read a ``.safetensors`` file to a numpy state dict (no torch needed)."""
+    from safetensors import safe_open
+
+    out: StateDict = {}
+    with safe_open(path, framework="np") as f:
+        for k in f.keys():
+            t = f.get_tensor(k)
+            if t.dtype == np.float16:
+                t = t.astype(np.float32)
+            out[k] = t
+    return out
+
+
+def load_checkpoint(path: str, family: ModelFamily) -> Dict[str, Optional[Dict]]:
+    """Load + convert a single-file checkpoint (.safetensors or torch .ckpt)."""
+    if path.endswith(".safetensors"):
+        sd = load_safetensors(path)
+    else:
+        import torch
+
+        raw = torch.load(path, map_location="cpu", weights_only=True)
+        raw = raw.get("state_dict", raw)
+        sd = {k: v.float().numpy() for k, v in raw.items()
+              if hasattr(v, "numpy")}
+    return convert_ldm(sd, family)
+
+
+def detect_family(sd: StateDict) -> str:
+    """Guess the model family from checkpoint keys (webui does the same when
+    a user drops in an arbitrary checkpoint)."""
+    if "conditioner.embedders.1.model.text_projection" in sd or any(
+        k.startswith("conditioner.embedders.1.") for k in sd
+    ):
+        return "sdxl-base"
+    if any(k.startswith("conditioner.embedders.0.model.") for k in sd):
+        return "sdxl-refiner"
+    return "sd15"

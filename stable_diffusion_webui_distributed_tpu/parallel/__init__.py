@@ -1,0 +1,14 @@
+"""Parallelism: batch-DP sharding, Megatron-style TP rules, multi-host init.
+
+The reference's single strategy — split the image batch across workers
+proportional to speed (/root/reference/scripts/spartan/world.py:111-115,
+418-601) — maps here to sharding the batch axis of every tensor over the
+mesh's ``dp`` axis and letting XLA emit ICI collectives. Tensor parallelism
+(``tp``) is an addition the reference has no counterpart for.
+"""
+
+from stable_diffusion_webui_distributed_tpu.parallel.sharding import (  # noqa: F401
+    shard_params,
+    place_batch,
+    tp_spec_for,
+)

@@ -1,0 +1,16 @@
+"""Generation pipeline: payload -> plan -> compile -> denoise -> decode.
+
+Replaces the reference's remote ``/sdapi/v1/txt2img``/``img2img`` calls
+(/root/reference/scripts/spartan/worker.py:421-443) with an in-process,
+XLA-compiled path. The payload schema mirrors the sdapi request body the
+reference builds from ``p.__dict__`` (distributed.py:239-265) so existing
+clients translate 1:1.
+"""
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (  # noqa: F401
+    GenerationPayload,
+    GenerationResult,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import (  # noqa: F401
+    Engine,
+)

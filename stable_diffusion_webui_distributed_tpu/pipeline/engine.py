@@ -1,0 +1,495 @@
+"""The generation engine: compiled txt2img / img2img / hires-fix.
+
+This is the TPU rebirth of what each remote sdwui process does when the
+reference POSTs ``/sdapi/v1/txt2img`` (/root/reference/scripts/spartan/
+worker.py:421-443): encode prompts, denoise with the named sampler, decode,
+return base64 PNGs with per-image seeds/infotext.
+
+Key properties:
+- **Seed-exact sharding:** ``generate_range(payload, start, count)`` produces
+  images [start, start+count) of the request bitwise-identically whether run
+  on one device or split across many — the TPU equivalent of the reference's
+  seed fan-out (distributed.py:297-305). All stochasticity is keyed by
+  (request seed + global image index); batch position never enters.
+- **Chunked interrupt:** the denoise loop runs ``chunk_size`` steps per
+  device dispatch; between dispatches the host checks the interrupt flag and
+  reports progress — the compiled-loop version of the reference's 0.5 s
+  interrupt poll (worker.py:440-448).
+- **Compile caching:** jitted stages are cached per (resolution, batch,
+  steps, sampler) bucket; the same compiled function serves every prompt,
+  seed, and CFG value at that bucket (they are data, not constants).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
+from stable_diffusion_webui_distributed_tpu.models.configs import ModelFamily
+from stable_diffusion_webui_distributed_tpu.models.unet import UNet, make_added_cond
+from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+from stable_diffusion_webui_distributed_tpu.models.tokenizer import load_tokenizer
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    GenerationResult,
+    array_to_b64png,
+    b64png_to_array,
+    build_infotext,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes, rng
+from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
+from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
+from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
+
+
+def _fix_seed(seed: int) -> int:
+    """-1 -> fresh random seed (webui fix_seed semantics; the reference
+    records the fixed value before fan-out, distributed.py:252-254)."""
+    if seed is None or int(seed) == -1:
+        import secrets
+
+        return secrets.randbelow(2**32)
+    return int(seed) % 2**32
+
+
+class Engine:
+    """One loaded model family + its compiled stages on the local device(s)."""
+
+    def __init__(
+        self,
+        family: ModelFamily,
+        params: Dict[str, Any],
+        tokenizer=None,
+        policy: dtypes.Policy = dtypes.F32,
+        model_name: str = "",
+        state: Optional[interrupt_mod.GenerationState] = None,
+        chunk_size: int = 5,
+        schedule: Optional[sched.NoiseSchedule] = None,
+    ):
+        self.family = family
+        self.policy = policy
+        self.model_name = model_name or family.name
+        self.state = state or interrupt_mod.STATE
+        self.chunk_size = max(1, chunk_size)
+        self.schedule = schedule or sched.sd_schedule(
+            prediction_type=family.prediction_type
+        )
+        self.tokenizer = tokenizer or load_tokenizer(
+            None, family.text_encoder.vocab_size
+        )
+
+        cast = lambda t: dtypes.cast_floating(t, policy.param_dtype)
+        self.params = {k: (cast(v) if v is not None else None)
+                       for k, v in params.items()}
+
+        cd = policy.compute_dtype
+        self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
+        self.text_encoder_2 = (
+            CLIPTextModel(family.text_encoder_2, dtype=cd)
+            if family.text_encoder_2 else None
+        )
+        self.unet = UNet(family.unet, dtype=cd)
+        self.vae = VAE(family.vae, dtype=cd)
+
+        self._cache: Dict[Tuple, Callable] = {}
+        self._cache_lock = threading.Lock()
+
+    # -- compiled stage factories ------------------------------------------
+
+    def _cached(self, key: Tuple, build: Callable[[], Callable]) -> Callable:
+        with self._cache_lock:
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = build()
+                self._cache[key] = fn
+        return fn
+
+    def _encode_fn(self) -> Callable:
+        """(ids, ids2, clip_skip static) -> (context, pooled)."""
+
+        def build():
+            def encode(ids, ids2, skip):
+                # skip=0 -> model default (None); webui clip_skip N maps to N-1.
+                skip_arg = skip if skip else None
+                ctx, pooled = self.text_encoder.apply(
+                    {"params": self.params["text_encoder"]}, ids,
+                    skip=skip_arg,
+                )
+                if self.text_encoder_2 is not None:
+                    ctx2, pooled2 = self.text_encoder_2.apply(
+                        {"params": self.params["text_encoder_2"]}, ids2,
+                        skip=skip_arg,
+                    )
+                    ctx = jnp.concatenate(
+                        [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)],
+                        axis=-1,
+                    )
+                    pooled = pooled2
+                return ctx.astype(jnp.float32), pooled.astype(jnp.float32)
+
+            return jax.jit(encode, static_argnums=(2,))
+
+        return self._cached(("encode",), build)
+
+    def _make_denoise_fn(self, ctx_u, ctx_c, cfg_scale, added_u, added_c):
+        """Closure: x0-prediction denoiser with classifier-free guidance."""
+        unet_params = {"params": self.params["unet"]}
+        v_pred = self.schedule.prediction_type == "v_prediction"
+
+        def denoise(x, sigma):
+            B = x.shape[0]
+            c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
+            t = self.schedule.sigma_to_t(sigma)
+            xin = (x * c_in).astype(x.dtype)
+            both = jnp.concatenate([xin, xin], axis=0)
+            tb = jnp.full((2 * B,), t, jnp.float32)
+            ctx = jnp.concatenate([
+                jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
+                jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
+            ], axis=0)
+            if added_u is not None:
+                added = jnp.concatenate([
+                    jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
+                    jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
+                ], axis=0)
+                out = self.unet.apply(unet_params, both, tb, ctx, added)
+            else:
+                out = self.unet.apply(unet_params, both, tb, ctx)
+            out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
+            guided = out_u + cfg_scale * (out_c - out_u)
+            if v_pred:
+                c_skip = 1.0 / (sigma**2 + 1.0)
+                c_out = sigma / jnp.sqrt(sigma**2 + 1.0)
+                return x * c_skip - guided * c_out
+            return x - sigma * guided
+
+        return denoise
+
+    def _chunk_fn(self, sampler_name: str, steps: int, width: int,
+                  height: int, batch: int, length: int,
+                  masked: bool) -> Callable:
+        """Compiled scan over ``length`` sampler steps starting at a traced
+        index. Cache key excludes prompt/seed/cfg — those are data."""
+        spec = kd.resolve_sampler(sampler_name)
+        key = ("chunk", sampler_name, steps, width, height, batch, length,
+               masked, self.family.name)
+
+        def build():
+            sigmas = kd.build_sigmas(spec, self.schedule, steps)
+
+            def run_chunk(carry, start, ctx_u, ctx_c, cfg, image_keys,
+                          added_u, added_c, mask_lat, init_lat):
+                denoise = self._make_denoise_fn(
+                    ctx_u, ctx_c, cfg, added_u, added_c)
+                base_step = kd.make_sampler_step(
+                    spec, denoise, sigmas, image_keys)
+
+                def step(carry, i):
+                    carry2, _ = base_step(carry, i)
+                    if masked:
+                        # inpaint: keep unmasked regions pinned to the init
+                        # latent re-noised to the *next* sigma level.
+                        def renoise(k):
+                            return jax.random.normal(
+                                jax.random.fold_in(k, 1_000_000 + i),
+                                init_lat.shape[1:], jnp.float32)
+
+                        noise = jax.vmap(renoise)(image_keys)
+                        pinned = init_lat + noise * sigmas[i + 1]
+                        x = mask_lat * carry2.x + (1 - mask_lat) * pinned
+                        carry2 = carry2._replace(x=x)
+                    return carry2, ()
+
+                idx = start + jnp.arange(length)
+                carry, _ = jax.lax.scan(step, carry, idx)
+                return carry
+
+            return jax.jit(run_chunk)
+
+        return self._cached(key, build)
+
+    def _decode_fn(self, width: int, height: int, batch: int) -> Callable:
+        key = ("decode", width, height, batch, self.family.name)
+
+        def build():
+            scale = self.family.vae.scaling_factor
+
+            def decode(latents):
+                imgs = self.vae.apply(
+                    {"params": self.params["vae"]}, latents / scale,
+                    method=VAE.decode)
+                return jnp.clip(imgs * 0.5 + 0.5, 0.0, 1.0)
+
+            return jax.jit(decode)
+
+        return self._cached(key, build)
+
+    def _encode_image_fn(self, width: int, height: int, batch: int) -> Callable:
+        key = ("img-encode", width, height, batch, self.family.name)
+
+        def build():
+            scale = self.family.vae.scaling_factor
+
+            def encode(images):
+                mean, _ = self.vae.apply(
+                    {"params": self.params["vae"]}, images * 2.0 - 1.0,
+                    method=VAE.encode)
+                return mean.astype(jnp.float32) * scale
+
+            return jax.jit(encode)
+
+        return self._cached(key, build)
+
+    # -- prompt conditioning -----------------------------------------------
+
+    def encode_prompts(self, payload: GenerationPayload):
+        tok = self.tokenizer
+        ids_c = jnp.asarray(tok([payload.prompt]))
+        ids_u = jnp.asarray(tok([payload.negative_prompt]))
+        skip = int(payload.clip_skip or 0)
+        enc = self._encode_fn()
+        ctx_c, pooled_c = enc(ids_c, ids_c, skip)
+        ctx_u, pooled_u = enc(ids_u, ids_u, skip)
+        return (ctx_u, ctx_c), (pooled_u, pooled_c)
+
+    def _added_cond(self, pooled_u, pooled_c, width, height):
+        ucfg = self.family.unet
+        if not ucfg.addition_embed_dim:
+            return None, None
+        time_ids = jnp.asarray(
+            [[height, width, 0, 0, height, width]], jnp.float32)
+        au = make_added_cond(pooled_u, time_ids, ucfg.addition_time_embed_dim)
+        ac = make_added_cond(pooled_c, time_ids, ucfg.addition_time_embed_dim)
+        return au, ac
+
+    # -- generation ---------------------------------------------------------
+
+    def generate_range(
+        self,
+        payload: GenerationPayload,
+        start_index: int = 0,
+        count: Optional[int] = None,
+        job: str = "txt2img",
+    ) -> GenerationResult:
+        """Produce images [start_index, start_index+count) of the request.
+
+        This is the worker-side unit of the batch-DP split: the scheduler
+        assigns each backend a contiguous range, exactly as the reference
+        assigns each HTTP worker a sub-batch plus a seed offset
+        (distributed.py:284-319)."""
+        payload = payload.model_copy()
+        payload.seed = _fix_seed(payload.seed)
+        payload.subseed = _fix_seed(payload.subseed)
+        count = payload.total_images if count is None else count
+        if payload.init_images:
+            return self._run_img2img(payload, start_index, count, job)
+        return self._run_txt2img(payload, start_index, count, job)
+
+    def txt2img(self, payload: GenerationPayload) -> GenerationResult:
+        return self.generate_range(payload, 0, None, "txt2img")
+
+    def img2img(self, payload: GenerationPayload) -> GenerationResult:
+        return self.generate_range(payload, 0, None, "img2img")
+
+    # -- internals -----------------------------------------------------------
+
+    def _latent_hw(self, width, height):
+        f = self.family.vae_scale_factor
+        return height // f, width // f
+
+    def _image_keys(self, payload, start, batch):
+        idx = jnp.arange(batch, dtype=jnp.uint32) + jnp.uint32(start)
+        if payload.subseed_strength > 0:
+            # Variation batches: the base key is fixed (see runtime/rng.py).
+            return jax.vmap(
+                lambda i: rng.key_for_image(payload.seed, jnp.uint32(0))
+            )(idx)
+        return jax.vmap(
+            lambda i: rng.key_for_image(payload.seed, i))(idx)
+
+    def _denoise(self, payload, x, image_keys, conds, pooleds, width, height,
+                 start_step, steps, job):
+        return self._denoise_range(payload, x, image_keys, conds, pooleds,
+                                   width, height, start_step, steps, job,
+                                   None, None)
+
+    def _denoise_range(self, payload, x, image_keys, conds, pooleds,
+                       width, height, start_step, steps, job,
+                       mask_lat, init_lat):
+        """Host-side chunk loop with interrupt/progress between dispatches
+        (compiled-loop version of the reference's 0.5 s poll,
+        worker.py:440-448)."""
+        (ctx_u, ctx_c) = conds
+        au, ac = self._added_cond(*pooleds, width, height)
+        batch = x.shape[0]
+        cfg = jnp.float32(payload.cfg_scale)
+        masked = mask_lat is not None
+        mask_arg = mask_lat if masked else jnp.float32(0)
+        init_arg = init_lat if masked else jnp.float32(0)
+        carry = kd.init_carry(x)
+        self.state.begin(job, steps - start_step)
+        done = 0
+        pos = start_step
+        while pos < steps:
+            if self.state.flag.interrupted:
+                break
+            length = min(self.chunk_size, steps - pos)
+            fn = self._chunk_fn(payload.sampler_name, steps, width, height,
+                                batch, length, masked=masked)
+            carry = fn(carry, jnp.int32(pos), ctx_u, ctx_c, cfg, image_keys,
+                       au, ac, mask_arg, init_arg)
+            pos += length
+            done += length
+            self.state.step(done)
+        self.state.finish()
+        return carry.x
+
+    def _start_sigma(self, spec, steps):
+        sigmas = kd.build_sigmas(spec, self.schedule, steps)
+        return sigmas
+
+    def _run_txt2img(self, payload, start, count, job,
+                     width=None, height=None) -> GenerationResult:
+        width = width or payload.width
+        height = height or payload.height
+        h, w = self._latent_hw(width, height)
+        C = self.family.unet.in_channels
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
+
+        conds, pooleds = self.encode_prompts(payload)
+        out = GenerationResult(parameters=payload.model_dump())
+
+        # Generate in groups of batch_size so the compiled batch dim is
+        # stable across n_iter (reference batches the same way).
+        group = max(1, payload.batch_size)
+        pos = start
+        remaining = count
+        while remaining > 0 and not self.state.flag.interrupted:
+            n = min(group, remaining)
+            noise = rng.batch_noise(
+                payload.seed, payload.subseed, payload.subseed_strength,
+                pos, n, (h, w, C))
+            x = noise.astype(jnp.float32) * sigmas[0]
+            keys = self._image_keys(payload, pos, n)
+            latents = self._denoise(
+                payload, x, keys, conds, pooleds, width, height,
+                0, payload.steps, job)
+            out_w, out_h = width, height
+            if payload.enable_hr:
+                latents, out_w, out_h = self._hires_pass(
+                    payload, latents, keys, conds, pooleds, job)
+            self._append_decoded(out, payload, latents, pos, n, out_w, out_h)
+            pos += n
+            remaining -= n
+        return out
+
+    def _hires_pass(self, payload, latents, image_keys, conds, pooleds, job):
+        """Latent-space hires fix: bilinear latent upscale, re-noise to the
+        strength point, second denoise pass at the target resolution
+        (webui's "Latent" upscaler; reference ETA semantics at
+        worker.py:205-228). No VAE/PNG roundtrip between passes."""
+        if payload.hr_resize_x and payload.hr_resize_y:
+            tw, th = payload.hr_resize_x, payload.hr_resize_y
+        else:
+            tw = int(payload.width * payload.hr_scale)
+            th = int(payload.height * payload.hr_scale)
+        f = self.family.vae_scale_factor
+        tw, th = (tw // f) * f, (th // f) * f
+        steps2 = payload.hr_second_pass_steps or payload.steps
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas2 = kd.build_sigmas(spec, self.schedule, steps2)
+        t_enc = int(min(payload.denoising_strength, 0.999) * steps2)
+        start2 = steps2 - t_enc
+
+        n, _, _, C = latents.shape
+        up = jax.image.resize(latents, (n, th // f, tw // f, C), "bilinear")
+        # Fresh per-image noise for the second pass, disjoint from both the
+        # init-noise stream and the sampler's ancestral stream.
+        def hr_noise(k):
+            return jax.random.normal(
+                jax.random.fold_in(k, 2_000_000), up.shape[1:], jnp.float32)
+
+        noise = jax.vmap(hr_noise)(image_keys)
+        x = up + noise * sigmas2[start2]
+
+        hires = payload.model_copy()
+        hires.steps = steps2
+        latents2 = self._denoise_range(
+            hires, x, image_keys, conds, pooleds, tw, th,
+            start2, steps2, job + "+hr", None, None)
+        return latents2, tw, th
+
+    def _run_img2img(self, payload, start, count, job) -> GenerationResult:
+        width, height = payload.width, payload.height
+        h, w = self._latent_hw(width, height)
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
+        # webui: t_enc = int(min(strength, 0.999) * steps)
+        t_enc = int(min(payload.denoising_strength, 0.999) * payload.steps)
+        start_step = payload.steps - t_enc
+
+        init = b64png_to_array(payload.init_images[0]).astype(np.float32) / 255.0
+        init = _resize_image(init, width, height)
+        conds, pooleds = self.encode_prompts(payload)
+
+        mask_lat = None
+        if payload.mask is not None:
+            m = b64png_to_array(payload.mask).astype(np.float32) / 255.0
+            m = _resize_image(m, width, height)[..., :1]
+            mask_lat = jnp.asarray(
+                np.asarray(jax.image.resize(m, (h, w, 1), "bilinear")) > 0.5,
+                jnp.float32)[None]
+
+        out = GenerationResult(parameters=payload.model_dump())
+        group = max(1, payload.batch_size)
+        pos, remaining = start, count
+        while remaining > 0 and not self.state.flag.interrupted:
+            n = min(group, remaining)
+            enc = self._encode_image_fn(width, height, n)
+            init_lat = enc(jnp.asarray(init)[None].repeat(n, axis=0))
+            noise = rng.batch_noise(
+                payload.seed, payload.subseed, payload.subseed_strength,
+                pos, n, init_lat.shape[1:])
+            x = init_lat + noise.astype(jnp.float32) * sigmas[start_step]
+            keys = self._image_keys(payload, pos, n)
+            latents = self._denoise_range(
+                payload, x, keys, conds, pooleds, width, height,
+                start_step, payload.steps, job, mask_lat, init_lat)
+            self._append_decoded(out, payload, latents, pos, n, width, height)
+            pos += n
+            remaining -= n
+        return out
+
+    def _append_decoded(self, out, payload, latents, pos, n, width, height):
+        decode = self._decode_fn(width, height, n)
+        imgs = np.asarray(decode(latents))
+        imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
+        for j in range(n):
+            i = pos + j
+            seed_i = payload.seed + (0 if payload.subseed_strength > 0 else i)
+            sub_i = payload.subseed + i
+            out.images.append(array_to_b64png(imgs[j]))
+            out.seeds.append(int(seed_i))
+            out.subseeds.append(int(sub_i))
+            out.prompts.append(payload.prompt)
+            out.negative_prompts.append(payload.negative_prompt)
+            out.infotexts.append(build_infotext(
+                payload, int(seed_i), int(sub_i), self.model_name,
+                width, height))
+            out.worker_labels.append("")
+
+
+def _resize_image(img: np.ndarray, width: int, height: int) -> np.ndarray:
+    """Host-side image resize to the requested generation size."""
+    if img.shape[0] == height and img.shape[1] == width:
+        return img
+    import jax.image
+
+    return np.asarray(jax.image.resize(
+        jnp.asarray(img), (height, width, img.shape[2]), "bilinear"))

@@ -1,0 +1,145 @@
+"""Request/response schema, sdapi-v1 compatible.
+
+Field names and defaults follow the REST payload the reference constructs
+and posts to each worker (/root/reference/scripts/distributed.py:239-265 and
+worker.py:352-418): a webui client can hit this framework unchanged. Images
+travel as base64 PNG strings both directions, exactly like the reference
+(pil_to_64 at worker.py:45-48, decode at distributed.py:103-106).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+
+class GenerationPayload(BaseModel):
+    """txt2img/img2img request (sdapi superset; unknown fields preserved)."""
+
+    prompt: str = ""
+    negative_prompt: str = ""
+    seed: int = -1
+    subseed: int = -1
+    subseed_strength: float = 0.0
+    steps: int = 20
+    width: int = 512
+    height: int = 512
+    batch_size: int = 1
+    n_iter: int = 1
+    cfg_scale: float = 7.0
+    sampler_name: str = "Euler a"
+    clip_skip: int = 0  # 0 = model default; webui's setting is clip_skip-1
+
+    # img2img
+    init_images: List[str] = Field(default_factory=list)  # base64 PNG
+    denoising_strength: float = 0.75
+    mask: Optional[str] = None          # base64 PNG, white = repaint
+    inpainting_fill: int = 1            # 0 fill, 1 original (webui enum)
+    mask_blur: int = 4
+
+    # hires fix (txt2img two-pass; reference ETA models it at worker.py:205-228)
+    enable_hr: bool = False
+    hr_scale: float = 2.0
+    hr_second_pass_steps: int = 0       # 0 = same as steps
+    hr_upscaler: str = "Latent"
+    hr_resize_x: int = 0
+    hr_resize_y: int = 0
+
+    # model / misc
+    override_settings: Dict[str, Any] = Field(default_factory=dict)
+    styles: List[str] = Field(default_factory=list)
+    # alwayson scripts payload (ControlNet etc.), keyed by script title —
+    # same shape the reference packs at distributed.py:199-234.
+    alwayson_scripts: Dict[str, Any] = Field(default_factory=dict)
+
+    model_config = {"extra": "allow"}
+
+    @property
+    def total_images(self) -> int:
+        return self.batch_size * self.n_iter
+
+    def pixels_per_image(self) -> int:
+        return self.width * self.height
+
+
+class GenerationResult(BaseModel):
+    """Mirrors webui's ``Processed``/sdapi response: images as base64 PNG,
+    per-image seeds and infotexts (the reference merges these into its
+    gallery at distributed.py:110-181)."""
+
+    images: List[str] = Field(default_factory=list)   # base64 PNG
+    seeds: List[int] = Field(default_factory=list)
+    subseeds: List[int] = Field(default_factory=list)
+    prompts: List[str] = Field(default_factory=list)
+    negative_prompts: List[str] = Field(default_factory=list)
+    infotexts: List[str] = Field(default_factory=list)
+    parameters: Dict[str, Any] = Field(default_factory=dict)
+    # which generation backend produced each image (reference appends
+    # ", Worker Label: x" to infotext at distributed.py:343-349)
+    worker_labels: List[str] = Field(default_factory=list)
+
+    def extend(self, other: "GenerationResult") -> None:
+        self.images.extend(other.images)
+        self.seeds.extend(other.seeds)
+        self.subseeds.extend(other.subseeds)
+        self.prompts.extend(other.prompts)
+        self.negative_prompts.extend(other.negative_prompts)
+        self.infotexts.extend(other.infotexts)
+        self.worker_labels.extend(other.worker_labels)
+
+
+# --------------------------------------------------------------------------
+# image <-> base64 PNG (wire format parity with the reference)
+# --------------------------------------------------------------------------
+
+def array_to_b64png(img: np.ndarray) -> str:
+    """(H,W,3) uint8 -> base64 PNG string."""
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def b64png_to_array(data: str) -> np.ndarray:
+    """base64 PNG (optionally data-URL prefixed) -> (H,W,3) uint8."""
+    from PIL import Image
+
+    if "," in data and data.strip().startswith("data:"):
+        data = data.split(",", 1)[1]
+    img = Image.open(io.BytesIO(base64.b64decode(data)))
+    return np.asarray(img.convert("RGB"))
+
+
+def build_infotext(payload: GenerationPayload, seed: int, subseed: int,
+                   model_name: str = "", width: int = 0, height: int = 0,
+                   extra: str = "") -> str:
+    """webui-format generation parameters text (the string the reference
+    rewrites per gallery image at distributed.py:343-349)."""
+    lines = [payload.prompt]
+    if payload.negative_prompt:
+        lines.append(f"Negative prompt: {payload.negative_prompt}")
+    fields = [
+        f"Steps: {payload.steps}",
+        f"Sampler: {payload.sampler_name}",
+        f"CFG scale: {payload.cfg_scale}",
+        f"Seed: {seed}",
+        f"Size: {width or payload.width}x{height or payload.height}",
+    ]
+    if model_name:
+        fields.append(f"Model: {model_name}")
+    if payload.subseed_strength > 0:
+        fields.append(f"Variation seed: {subseed}")
+        fields.append(f"Variation seed strength: {payload.subseed_strength}")
+    if payload.denoising_strength != 0.75 and (
+        payload.init_images or payload.enable_hr
+    ):
+        fields.append(f"Denoising strength: {payload.denoising_strength}")
+    if extra:
+        fields.append(extra)
+    lines.append(", ".join(fields))
+    return "\n".join(lines)

@@ -97,7 +97,16 @@ class GenerationState:
 
     def finish(self) -> None:
         with self._lock:
-            self.progress.sampling_step = self.progress.sampling_steps
+            self.progress.interrupted = self.flag.interrupted
+            if not self.progress.interrupted:
+                # only a completed run reports full step count; an
+                # interrupted one keeps the step it actually reached
+                self.progress.sampling_step = self.progress.sampling_steps
+            listeners = list(self._listeners)
+            snapshot = dataclasses.replace(self.progress)
+        # terminal state must reach listeners too (same outside-lock rule)
+        for cb in listeners:
+            cb(snapshot)
 
     def add_listener(self, cb: Callable[[Progress], None]) -> None:
         with self._lock:

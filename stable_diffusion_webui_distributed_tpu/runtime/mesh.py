@@ -1,0 +1,92 @@
+"""Device mesh construction: the TPU substrate for the batch split.
+
+Where the reference's "world" is a pool of HTTP hosts (one GPU each,
+/root/reference/scripts/spartan/world.py:75-145), this framework's first
+tier of parallelism is a ``jax.sharding.Mesh`` over local chips: the batch
+axis is sharded over ``dp`` (XLA emits ICI collectives; no request fan-out,
+no HTTP). The World scheduler (scheduler/) then balances *across* meshes —
+slices/hosts — the way the reference balances across HTTP workers.
+
+Axis names: ``dp`` (batch data-parallel), ``tp`` (tensor parallel within the
+UNet/VAE), reserved ``sp`` (latent-token sequence parallel for very high
+resolutions). ``--mesh "dp=4,tp=2"`` flag parsing lives here (the flag is
+registered at runtime/flags.py:33-38).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "tp", "sp")
+
+
+def parse_mesh_spec(spec: Optional[str]) -> Dict[str, int]:
+    """'dp=4,tp=2' -> {'dp': 4, 'tp': 2}. Empty/None -> {} (all devices on dp)."""
+    if not spec:
+        return {}
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh axis '{part}' (want name=size)")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis '{name}' (known: {AXIS_ORDER})")
+        out[name] = int(size)
+        if out[name] <= 0:
+            raise ValueError(f"mesh axis {name} must be positive")
+    return out
+
+
+def build_mesh(spec: Optional[str] = None, devices: Optional[Sequence] = None):
+    """Construct a Mesh from a spec string over the given (or all) devices.
+
+    Unspecified axes get size 1; if no axes are given, every device lands on
+    ``dp`` — the TPU analogue of the reference's default equal batch split
+    (world.py:111-115).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    axes = parse_mesh_spec(spec)
+    if not axes:
+        axes = {"dp": len(devices)}
+    sizes = [axes.get(a, 1) for a in AXIS_ORDER]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        # Allow a spec that uses a subset (e.g. dp=4 of 8 devices).
+        if total < len(devices) and len(devices) % total == 0:
+            devices = devices[:total]
+        else:
+            raise ValueError(
+                f"mesh spec {axes} needs {total} devices, have {len(devices)}"
+            )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def batch_sharding(mesh):
+    """NamedSharding that splits axis 0 (the image batch) over ``dp``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def pad_batch(n: int, mesh) -> int:
+    """Images to generate so the batch divides the dp axis: pad-and-drop,
+    the TPU replacement for the reference's remainder round-robin
+    (world.py:482-510)."""
+    dp = mesh.shape["dp"]
+    return ((n + dp - 1) // dp) * dp

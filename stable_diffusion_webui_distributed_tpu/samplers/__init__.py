@@ -1,0 +1,22 @@
+"""k-diffusion sampler family as pure, scan-compatible JAX functions.
+
+The reference's workers each run webui's bundled samplers; the master only
+names them in payloads (``sampler_name``) and models their relative speed for
+ETA purposes (/root/reference/scripts/spartan/worker.py:75-94). Here the
+samplers are the framework's own: pure functions over a ``lax.scan`` whose
+step function is exposed so the pipeline can run it in chunks and honor
+interrupts between chunks (runtime/interrupt.py semantics).
+"""
+
+from stable_diffusion_webui_distributed_tpu.samplers.schedules import (  # noqa: F401
+    NoiseSchedule,
+    karras_sigmas,
+    default_sigmas,
+    ddim_sigmas,
+)
+from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import (  # noqa: F401
+    SAMPLERS,
+    SamplerSpec,
+    resolve_sampler,
+    make_sampler_step,
+)

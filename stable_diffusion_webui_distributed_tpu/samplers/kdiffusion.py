@@ -1,0 +1,218 @@
+"""k-diffusion samplers as scan-step functions.
+
+Design: a sampler is ``(carry, step_index) -> (carry, ())`` so the pipeline
+can ``lax.scan`` any contiguous chunk of steps and check the interrupt flag
+between chunks — reproducing the reference's 0.5 s interrupt poll
+(/root/reference/scripts/spartan/worker.py:440-448) under XLA compilation.
+
+Stochastic (ancestral) steps draw noise keyed per image *and* per step from
+the image's own PRNG key, never from batch position — so a sub-batch sharded
+to any device/slice reproduces the exact images of a single-device run (the
+seed contract of runtime/rng.py; reference seed fan-out semantics at
+/root/reference/scripts/distributed.py:297-305).
+
+Sampler names mirror webui's (the reference's speed table rows,
+worker.py:75-94): "Euler a", "Euler", "Heun", "DDIM", "DPM++ 2M",
+"DPM++ 2M Karras", "DPM2", "DPM2 a", "LMS".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
+
+# denoise_fn(x, sigma_scalar) -> denoised x0 prediction, same shape as x.
+DenoiseFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerSpec:
+    """A named sampler = step algorithm + sigma schedule + stochasticity."""
+
+    algorithm: str           # euler | euler_a | heun | dpmpp_2m | dpm2 | dpm2_a | lms
+    schedule: str = "default"  # key into schedules.SCHEDULES
+    ancestral: bool = False
+    # Extra model evaluations per step (Heun/DPM2 are 2nd order).
+    evals_per_step: int = 1
+
+
+SAMPLERS = {
+    "Euler a": SamplerSpec("euler_a", ancestral=True),
+    "Euler": SamplerSpec("euler"),
+    "Heun": SamplerSpec("heun", evals_per_step=2),
+    "DDIM": SamplerSpec("euler", schedule="ddim"),
+    "LMS": SamplerSpec("lms"),
+    "DPM2": SamplerSpec("dpm2", evals_per_step=2),
+    "DPM2 a": SamplerSpec("dpm2_a", ancestral=True, evals_per_step=2),
+    "DPM++ 2M": SamplerSpec("dpmpp_2m"),
+    "DPM++ 2M Karras": SamplerSpec("dpmpp_2m", schedule="karras"),
+    "Euler a Karras": SamplerSpec("euler_a", schedule="karras", ancestral=True),
+    "Euler Karras": SamplerSpec("euler", schedule="karras"),
+}
+
+
+def resolve_sampler(name: str) -> SamplerSpec:
+    """Look up a webui sampler name; unknown names fall back to Euler a —
+    the same degraded-capability fallback the reference applies on a remote's
+    404 "Sampler not found" (worker.py:457-467)."""
+    if name in SAMPLERS:
+        return SAMPLERS[name]
+    base = name.replace(" Karras", "")
+    if base in SAMPLERS and "Karras" in name:
+        return dataclasses.replace(SAMPLERS[base], schedule="karras")
+    return SAMPLERS["Euler a"]
+
+
+class Carry(NamedTuple):
+    """Scan carry: latent + one denoised history slot (multistep methods)."""
+
+    x: jax.Array
+    old_denoised: jax.Array  # zeros until step 1
+    have_old: jax.Array      # bool scalar
+
+
+def _ancestral_split(sigma, sigma_next, eta: float = 1.0):
+    """(sigma_down, sigma_up) for ancestral steps (k-diffusion formula)."""
+    var_frac = (sigma**2 - sigma_next**2) / jnp.maximum(sigma**2, 1e-20)
+    sigma_up = jnp.minimum(
+        sigma_next, eta * jnp.sqrt(jnp.maximum(sigma_next**2 * var_frac, 0.0))
+    )
+    sigma_down = jnp.sqrt(jnp.maximum(sigma_next**2 - sigma_up**2, 0.0))
+    return sigma_down, sigma_up
+
+
+def _step_noise(keys: jax.Array, step: jax.Array, shape, dtype) -> jax.Array:
+    """Per-image, per-step noise: fold the step index into each image key.
+
+    ``keys`` is a (B,) key array (one key per image, derived from that
+    image's seed); batch position never enters, so sharding is seed-exact.
+    """
+    def one(k):
+        return jax.random.normal(jax.random.fold_in(k, step), shape[1:], dtype)
+
+    return jax.vmap(one)(keys)
+
+
+def make_sampler_step(
+    spec: SamplerSpec,
+    denoise_fn: DenoiseFn,
+    sigmas: jax.Array,        # (steps+1,) f32
+    image_keys: jax.Array,    # (B,) PRNG keys, one per image
+) -> Callable[[Carry, jax.Array], Tuple[Carry, Tuple]]:
+    """Build the scan-step function for ``spec`` over a fixed sigma ladder."""
+
+    algo = spec.algorithm
+
+    def to_d(x, sigma, denoised):
+        return (x - denoised) / jnp.maximum(sigma, 1e-10)
+
+    def step(carry: Carry, i: jax.Array) -> Tuple[Carry, Tuple]:
+        x = carry.x
+        sigma = sigmas[i]
+        sigma_next = sigmas[i + 1]
+        denoised = denoise_fn(x, sigma)
+        d = to_d(x, sigma, denoised)
+
+        if algo == "euler":
+            x_new = x + d * (sigma_next - sigma)
+
+        elif algo == "euler_a":
+            sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
+            x_new = x + d * (sigma_down - sigma)
+            noise = _step_noise(image_keys, i, x.shape, x.dtype)
+            x_new = x_new + noise * sigma_up
+
+        elif algo == "heun":
+            x_eul = x + d * (sigma_next - sigma)
+
+            def second_order(_):
+                denoised2 = denoise_fn(x_eul, jnp.maximum(sigma_next, 1e-10))
+                d2 = to_d(x_eul, sigma_next, denoised2)
+                return x + (d + d2) / 2 * (sigma_next - sigma)
+
+            x_new = jax.lax.cond(sigma_next > 0, second_order,
+                                 lambda _: x_eul, operand=None)
+
+        elif algo in ("dpm2", "dpm2_a"):
+            if algo == "dpm2_a":
+                sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
+            else:
+                sigma_down, sigma_up = sigma_next, jnp.float32(0.0)
+
+            def second_order(_):
+                # midpoint in log-sigma space (k-diffusion sample_dpm_2)
+                sigma_mid = jnp.exp(
+                    (jnp.log(jnp.maximum(sigma, 1e-10))
+                     + jnp.log(jnp.maximum(sigma_down, 1e-10))) / 2
+                )
+                x_mid = x + d * (sigma_mid - sigma)
+                denoised2 = denoise_fn(x_mid, sigma_mid)
+                d2 = to_d(x_mid, sigma_mid, denoised2)
+                return x + d2 * (sigma_down - sigma)
+
+            x_new = jax.lax.cond(sigma_down > 0, second_order,
+                                 lambda _: x + d * (sigma_down - sigma),
+                                 operand=None)
+            if algo == "dpm2_a":
+                noise = _step_noise(image_keys, i, x.shape, x.dtype)
+                x_new = x_new + noise * sigma_up
+
+        elif algo == "dpmpp_2m":
+            t = -jnp.log(jnp.maximum(sigma, 1e-10))
+            t_next = -jnp.log(jnp.maximum(sigma_next, 1e-10))
+            h = t_next - t
+            sigma_prev = sigmas[jnp.maximum(i - 1, 0)]
+            t_prev = -jnp.log(jnp.maximum(sigma_prev, 1e-10))
+            h_last = t - t_prev
+            r = h_last / jnp.maximum(h, 1e-10)
+            denoised_d = (1 + 1 / (2 * r)) * denoised \
+                - (1 / (2 * r)) * carry.old_denoised
+            use_multistep = jnp.logical_and(carry.have_old, sigma_next > 0)
+            eff = jnp.where(use_multistep, denoised_d, denoised)
+            ratio = sigma_next / jnp.maximum(sigma, 1e-10)
+            x_new = ratio * x - jnp.expm1(-h) * eff
+            # terminal step (sigma_next == 0): x collapses to denoised
+            x_new = jnp.where(sigma_next > 0, x_new, denoised)
+
+        elif algo == "lms":
+            # order-2 Adams-Bashforth on d (k-diffusion LMS truncated to
+            # order 2: identical at step 0, very close thereafter). The carry
+            # history slot holds the PREVIOUS step's d for this algorithm.
+            d_prev = carry.old_denoised
+            h = sigma_next - sigma
+            h_last = sigma - sigmas[jnp.maximum(i - 1, 0)]
+            r = h / jnp.where(h_last == 0, 1.0, h_last)
+            d_eff = jnp.where(carry.have_old,
+                              d + 0.5 * r * (d - d_prev), d)
+            x_new = x + d_eff * h
+
+        else:  # pragma: no cover
+            raise ValueError(f"unknown sampler algorithm {algo}")
+
+        history = d if algo == "lms" else denoised
+        return Carry(x_new, history, jnp.bool_(True)), ()
+
+    return step
+
+
+def init_carry(x: jax.Array) -> Carry:
+    return Carry(x, jnp.zeros_like(x), jnp.bool_(False))
+
+
+def run_steps(
+    step_fn, carry: Carry, start: int, stop: int
+) -> Carry:
+    """Scan a contiguous chunk [start, stop) of sampler steps."""
+    idx = jnp.arange(start, stop)
+    carry, _ = jax.lax.scan(step_fn, carry, idx)
+    return carry
+
+
+def build_sigmas(spec: SamplerSpec, schedule: sched.NoiseSchedule,
+                 steps: int) -> jax.Array:
+    return jnp.asarray(sched.SCHEDULES[spec.schedule](schedule, steps))

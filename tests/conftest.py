@@ -5,9 +5,41 @@ hardware per the standard JAX recipe: 8 virtual CPU devices via XLA_FLAGS.
 """
 
 import os
+import sys
 
-# Must be set before jax (or anything importing jax) is imported.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual CPU platform (must happen before jax import).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def pytest_configure(config):
+    """Keep test runs off the real TPU chip.
+
+    The harness environment routes EVERY python process through the one real
+    TPU chip: a sitecustomize hook (PYTHONPATH=/root/.axon_site) claims the
+    chip at interpreter startup whenever PALLAS_AXON_POOL_IPS is set.
+    Concurrent pythons then serialize (or deadlock) on the device claim — a
+    pytest run would both hold the chip hostage and hang if anything else
+    holds it. Tests belong on the virtual CPU platform; only bench.py uses
+    the real TPU.
+
+    The claim happens before any pytest code, so once we detect it we
+    re-exec with a scrubbed environment. Global capture must be stopped
+    first: it has already redirected fd 1/2 to tempfiles, and an exec'd
+    process inheriting those would lose every byte of output.
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

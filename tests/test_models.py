@@ -1,0 +1,374 @@
+"""Model zoo tests: forward shapes, clip-skip, tokenizer, ldm conversion.
+
+The conversion tests build *synthetic* ldm-layout state dicts by replaying
+the torch ldm module-construction rules independently of the converter; if
+the converter's key numbering or any transpose is wrong, the converted tree
+will not match the Flax-initialized tree and the forward pass fails.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.configs import (
+    CLIPTextConfig, TINY, TINY_XL,
+)
+from stable_diffusion_webui_distributed_tpu.models import convert
+from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
+from stable_diffusion_webui_distributed_tpu.models.unet import UNet, make_added_cond
+from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
+    CLIPTokenizer, FallbackTokenizer,
+)
+
+RNG = np.random.default_rng(0)
+
+
+# --------------------------------------------------------------------------
+# synthetic ldm state-dict generators (torch tensor conventions)
+# --------------------------------------------------------------------------
+
+def _lin(sd, key, o, i, bias=True):
+    sd[f"{key}.weight"] = RNG.standard_normal((o, i), np.float32) * 0.02
+    if bias:
+        sd[f"{key}.bias"] = np.zeros(o, np.float32)
+
+
+def _conv(sd, key, o, i, k=3):
+    sd[f"{key}.weight"] = RNG.standard_normal((o, i, k, k), np.float32) * 0.02
+    sd[f"{key}.bias"] = np.zeros(o, np.float32)
+
+
+def _norm(sd, key, c):
+    sd[f"{key}.weight"] = np.ones(c, np.float32)
+    sd[f"{key}.bias"] = np.zeros(c, np.float32)
+
+
+def _ldm_res(sd, key, cin, cout, tdim):
+    _norm(sd, f"{key}.in_layers.0", cin)
+    _conv(sd, f"{key}.in_layers.2", cout, cin)
+    _lin(sd, f"{key}.emb_layers.1", cout, tdim)
+    _norm(sd, f"{key}.out_layers.0", cout)
+    _conv(sd, f"{key}.out_layers.3", cout, cout)
+    if cin != cout:
+        _conv(sd, f"{key}.skip_connection", cout, cin, k=1)
+
+
+def _ldm_xformer(sd, key, c, depth, ctx):
+    _norm(sd, f"{key}.norm", c)
+    _lin(sd, f"{key}.proj_in", c, c)
+    _lin(sd, f"{key}.proj_out", c, c)
+    for d in range(depth):
+        bp = f"{key}.transformer_blocks.{d}"
+        for nm in ("norm1", "norm2", "norm3"):
+            _norm(sd, f"{bp}.{nm}", c)
+        for nm in ("to_q", "to_k", "to_v"):
+            _lin(sd, f"{bp}.attn1.{nm}", c, c, bias=False)
+        _lin(sd, f"{bp}.attn1.to_out.0", c, c)
+        _lin(sd, f"{bp}.attn2.to_q", c, c, bias=False)
+        _lin(sd, f"{bp}.attn2.to_k", c, ctx, bias=False)
+        _lin(sd, f"{bp}.attn2.to_v", c, ctx, bias=False)
+        _lin(sd, f"{bp}.attn2.to_out.0", c, c)
+        _lin(sd, f"{bp}.ff.net.0.proj", 8 * c, c)
+        _lin(sd, f"{bp}.ff.net.2", c, 4 * c)
+
+
+def make_ldm_unet(cfg, prefix="model.diffusion_model"):
+    sd = {}
+    ch0 = cfg.block_out_channels[0]
+    tdim = 4 * ch0
+    ctx = cfg.cross_attention_dim
+    _lin(sd, f"{prefix}.time_embed.0", tdim, ch0)
+    _lin(sd, f"{prefix}.time_embed.2", tdim, tdim)
+    if cfg.addition_embed_dim:
+        _lin(sd, f"{prefix}.label_emb.0.0", tdim, cfg.projection_input_dim)
+        _lin(sd, f"{prefix}.label_emb.0.2", tdim, tdim)
+    _conv(sd, f"{prefix}.input_blocks.0.0", ch0, cfg.in_channels)
+
+    levels = list(zip(cfg.block_out_channels, cfg.down_blocks))
+    skips = [ch0]
+    prev = ch0
+    n = 1
+    for level, (ch, depth) in enumerate(levels):
+        for _ in range(cfg.layers_per_block):
+            _ldm_res(sd, f"{prefix}.input_blocks.{n}.0", prev, ch, tdim)
+            if depth is not None:
+                _ldm_xformer(sd, f"{prefix}.input_blocks.{n}.1", ch, depth, ctx)
+            prev = ch
+            skips.append(ch)
+            n += 1
+        if level < len(levels) - 1:
+            _conv(sd, f"{prefix}.input_blocks.{n}.0.op", ch, ch)
+            skips.append(ch)
+            n += 1
+
+    mid = cfg.block_out_channels[-1]
+    _ldm_res(sd, f"{prefix}.middle_block.0", mid, mid, tdim)
+    idx = 1
+    if cfg.mid_block_depth is not None:
+        _ldm_xformer(sd, f"{prefix}.middle_block.1", mid, cfg.mid_block_depth, ctx)
+        idx = 2
+    _ldm_res(sd, f"{prefix}.middle_block.{idx}", mid, mid, tdim)
+
+    n = 0
+    for level in reversed(range(len(levels))):
+        ch, depth = levels[level]
+        for i in range(cfg.layers_per_block + 1):
+            _ldm_res(sd, f"{prefix}.output_blocks.{n}.0",
+                     prev + skips.pop(), ch, tdim)
+            sub = 1
+            if depth is not None:
+                _ldm_xformer(sd, f"{prefix}.output_blocks.{n}.1", ch, depth, ctx)
+                sub = 2
+            if i == cfg.layers_per_block and level > 0:
+                _conv(sd, f"{prefix}.output_blocks.{n}.{sub}.conv", ch, ch)
+            prev = ch
+            n += 1
+
+    _norm(sd, f"{prefix}.out.0", ch0)
+    _conv(sd, f"{prefix}.out.2", cfg.out_channels, ch0)
+    return sd
+
+
+def make_ldm_clip_hf(cfg: CLIPTextConfig,
+                     prefix="cond_stage_model.transformer.text_model"):
+    sd = {}
+    h = cfg.hidden_size
+    sd[f"{prefix}.embeddings.token_embedding.weight"] = (
+        RNG.standard_normal((cfg.vocab_size, h), np.float32) * 0.02
+    )
+    sd[f"{prefix}.embeddings.position_embedding.weight"] = (
+        RNG.standard_normal((cfg.max_length, h), np.float32) * 0.01
+    )
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}.encoder.layers.{i}"
+        for nm in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            _lin(sd, f"{lp}.self_attn.{nm}", h, h)
+        _norm(sd, f"{lp}.layer_norm1", h)
+        _norm(sd, f"{lp}.layer_norm2", h)
+        _lin(sd, f"{lp}.mlp.fc1", cfg.intermediate_size, h)
+        _lin(sd, f"{lp}.mlp.fc2", h, cfg.intermediate_size)
+    _norm(sd, f"{prefix}.final_layer_norm", h)
+    if cfg.projection_dim:
+        parent = prefix.rsplit(".text_model", 1)[0]
+        _lin(sd, f"{parent}.text_projection", cfg.projection_dim, h, bias=False)
+    return sd
+
+
+def make_ldm_clip_openai(cfg: CLIPTextConfig,
+                         prefix="conditioner.embedders.1.model"):
+    sd = {}
+    h = cfg.hidden_size
+    sd[f"{prefix}.token_embedding.weight"] = (
+        RNG.standard_normal((cfg.vocab_size, h), np.float32) * 0.02
+    )
+    sd[f"{prefix}.positional_embedding"] = (
+        RNG.standard_normal((cfg.max_length, h), np.float32) * 0.01
+    )
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}.transformer.resblocks.{i}"
+        sd[f"{lp}.attn.in_proj_weight"] = (
+            RNG.standard_normal((3 * h, h), np.float32) * 0.02
+        )
+        sd[f"{lp}.attn.in_proj_bias"] = np.zeros(3 * h, np.float32)
+        _lin(sd, f"{lp}.attn.out_proj", h, h)
+        _norm(sd, f"{lp}.ln_1", h)
+        _norm(sd, f"{lp}.ln_2", h)
+        _lin(sd, f"{lp}.mlp.c_fc", cfg.intermediate_size, h)
+        _lin(sd, f"{lp}.mlp.c_proj", h, cfg.intermediate_size)
+    _norm(sd, f"{prefix}.ln_final", h)
+    if cfg.projection_dim:
+        sd[f"{prefix}.text_projection"] = (
+            RNG.standard_normal((h, cfg.projection_dim), np.float32) * 0.02
+        )
+    return sd
+
+
+def _ldm_vae_res(sd, key, cin, cout):
+    _norm(sd, f"{key}.norm1", cin)
+    _conv(sd, f"{key}.conv1", cout, cin)
+    _norm(sd, f"{key}.norm2", cout)
+    _conv(sd, f"{key}.conv2", cout, cout)
+    if cin != cout:
+        _conv(sd, f"{key}.nin_shortcut", cout, cin, k=1)
+
+
+def _ldm_vae_attn(sd, key, c):
+    _norm(sd, f"{key}.norm", c)
+    for nm in ("q", "k", "v", "proj_out"):
+        _conv(sd, f"{key}.{nm}", c, c, k=1)
+
+
+def make_ldm_vae(cfg, prefix="first_stage_model"):
+    sd = {}
+    chs = cfg.block_out_channels
+    _conv(sd, f"{prefix}.encoder.conv_in", chs[0], cfg.in_channels)
+    prev = chs[0]
+    for level, ch in enumerate(chs):
+        for i in range(cfg.layers_per_block):
+            _ldm_vae_res(sd, f"{prefix}.encoder.down.{level}.block.{i}",
+                         prev if i == 0 else ch, ch)
+        prev = ch
+        if level < len(chs) - 1:
+            _conv(sd, f"{prefix}.encoder.down.{level}.downsample.conv", ch, ch)
+    _ldm_vae_res(sd, f"{prefix}.encoder.mid.block_1", chs[-1], chs[-1])
+    _ldm_vae_attn(sd, f"{prefix}.encoder.mid.attn_1", chs[-1])
+    _ldm_vae_res(sd, f"{prefix}.encoder.mid.block_2", chs[-1], chs[-1])
+    _norm(sd, f"{prefix}.encoder.norm_out", chs[-1])
+    _conv(sd, f"{prefix}.encoder.conv_out", 2 * cfg.latent_channels, chs[-1])
+    _conv(sd, f"{prefix}.quant_conv",
+          2 * cfg.latent_channels, 2 * cfg.latent_channels, k=1)
+
+    _conv(sd, f"{prefix}.post_quant_conv",
+          cfg.latent_channels, cfg.latent_channels, k=1)
+    _conv(sd, f"{prefix}.decoder.conv_in", chs[-1], cfg.latent_channels)
+    _ldm_vae_res(sd, f"{prefix}.decoder.mid.block_1", chs[-1], chs[-1])
+    _ldm_vae_attn(sd, f"{prefix}.decoder.mid.attn_1", chs[-1])
+    _ldm_vae_res(sd, f"{prefix}.decoder.mid.block_2", chs[-1], chs[-1])
+    prev = chs[-1]
+    for level in reversed(range(len(chs))):
+        ch = chs[level]
+        for i in range(cfg.layers_per_block + 1):
+            _ldm_vae_res(sd, f"{prefix}.decoder.up.{level}.block.{i}",
+                         prev if i == 0 else ch, ch)
+        prev = ch
+        if level > 0:
+            _conv(sd, f"{prefix}.decoder.up.{level}.upsample.conv", ch, ch)
+    _norm(sd, f"{prefix}.decoder.norm_out", chs[0])
+    _conv(sd, f"{prefix}.decoder.conv_out", cfg.in_channels, chs[0])
+    return sd
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def tree_shapes(tree):
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(k): np.shape(v) for k, v in flat}
+
+
+def assert_same_structure(converted, initialized, scope):
+    a, b = tree_shapes(converted), tree_shapes(initialized)
+    assert set(a) == set(b), (
+        f"{scope}: key mismatch\n  only-converted: {sorted(set(a) - set(b))[:6]}"
+        f"\n  only-init: {sorted(set(b) - set(a))[:6]}"
+    )
+    bad = {k: (a[k], b[k]) for k in a if a[k] != b[k]}
+    assert not bad, f"{scope}: shape mismatches {dict(list(bad.items())[:6])}"
+
+
+# --------------------------------------------------------------------------
+# tests
+# --------------------------------------------------------------------------
+
+class TestCLIP:
+    def test_forward_and_skip(self):
+        cfg = TINY.text_encoder
+        ids = jnp.asarray(FallbackTokenizer(cfg.vocab_size)(["a cow", ""]))
+        model = CLIPTextModel(cfg)
+        params = model.init(jax.random.key(0), ids)
+        ctx0, pooled = model.apply(params, ids, skip=0)
+        ctx1, _ = model.apply(params, ids, skip=1)
+        assert ctx0.shape == (2, 77, cfg.hidden_size)
+        assert pooled.shape == (2, cfg.hidden_size)
+        assert not np.allclose(np.asarray(ctx0), np.asarray(ctx1))
+
+    def test_conversion_hf(self):
+        cfg = TINY.text_encoder
+        sd = make_ldm_clip_hf(cfg)
+        converted = convert.convert_clip_hf(
+            sd, cfg, "cond_stage_model.transformer.text_model")
+        ids = jnp.asarray(FallbackTokenizer(cfg.vocab_size)(["x"]))
+        model = CLIPTextModel(cfg)
+        init = model.init(jax.random.key(0), ids)["params"]
+        assert_same_structure(converted, init, "clip-hf")
+        ctx, _ = model.apply({"params": converted}, ids)
+        assert np.isfinite(np.asarray(ctx)).all()
+
+    def test_conversion_openclip(self):
+        cfg = TINY_XL.text_encoder_2
+        sd = make_ldm_clip_openai(cfg)
+        converted = convert.convert_clip_openai(
+            sd, cfg, "conditioner.embedders.1.model")
+        ids = jnp.asarray(FallbackTokenizer(cfg.vocab_size)(["x"]))
+        model = CLIPTextModel(cfg)
+        init = model.init(jax.random.key(0), ids)["params"]
+        assert_same_structure(converted, init, "openclip")
+        _, pooled = model.apply({"params": converted}, ids)
+        assert pooled.shape == (1, cfg.projection_dim)
+
+
+class TestUNetConversion:
+    @pytest.mark.parametrize("family", [TINY, TINY_XL], ids=["sd", "xl"])
+    def test_conversion_matches_init(self, family):
+        cfg = family.unet
+        sd = make_ldm_unet(cfg)
+        converted = convert.convert_unet(sd, cfg)
+        lat = jnp.zeros((1, 8, 8, cfg.in_channels))
+        ctx = jnp.zeros((1, 77, cfg.cross_attention_dim))
+        t = jnp.ones((1,))
+        model = UNet(cfg)
+        if cfg.addition_embed_dim:
+            ac = jnp.zeros((1, cfg.projection_input_dim))
+            init = model.init(jax.random.key(0), lat, t, ctx, ac)["params"]
+            assert_same_structure(converted, init, f"unet-{family.name}")
+            out = model.apply({"params": converted}, lat, t, ctx, ac)
+        else:
+            init = model.init(jax.random.key(0), lat, t, ctx)["params"]
+            assert_same_structure(converted, init, f"unet-{family.name}")
+            out = model.apply({"params": converted}, lat, t, ctx)
+        assert out.shape == (1, 8, 8, cfg.out_channels)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestVAEConversion:
+    def test_conversion_matches_init(self):
+        cfg = TINY.vae
+        sd = make_ldm_vae(cfg)
+        converted = convert.convert_vae(sd, cfg)
+        img = jnp.zeros((1, 16, 16, 3))
+        model = VAE(cfg)
+        init = model.init(jax.random.key(0), img, jax.random.key(1))["params"]
+        assert_same_structure(converted, init, "vae")
+        mean, logvar = model.apply({"params": converted}, img,
+                                   method=VAE.encode)
+        dec = model.apply({"params": converted}, mean, method=VAE.decode)
+        assert dec.shape == (1, 16, 16, 3)
+
+
+class TestTokenizer:
+    def test_real_bpe_roundtrip(self, tmp_path):
+        # Minimal CLIP-style vocabulary exercising merges + end-of-word.
+        import json as js
+
+        chars = "abcdehilorsuwy "
+        vocab = {}
+        for ch in chars.strip():
+            vocab[ch] = len(vocab)
+            vocab[ch + "</w>"] = len(vocab)
+        for tok in ["lo", "low</w>", "he", "hel", "hell", "hello</w>",
+                    "wo", "wor", "worl", "world</w>"]:
+            vocab[tok] = len(vocab)
+        vocab["<|startoftext|>"] = len(vocab)
+        vocab["<|endoftext|>"] = len(vocab)
+        merges = [("l", "o"), ("lo", "w</w>"), ("h", "e"), ("he", "l"),
+                  ("hel", "l"), ("hell", "o</w>"), ("w", "o"), ("wo", "r"),
+                  ("wor", "l"), ("worl", "d</w>")]
+        (tmp_path / "vocab.json").write_text(js.dumps(vocab))
+        (tmp_path / "merges.txt").write_text(
+            "#version\n" + "\n".join(f"{a} {b}" for a, b in merges))
+        tok = CLIPTokenizer.load(str(tmp_path))
+        ids = tok.encode("hello world")
+        assert ids == [vocab["hello</w>"], vocab["world</w>"]]
+        batch = tok(["hello world"])
+        assert batch.shape == (1, 77)
+        assert batch[0, 0] == tok.bos and batch[0, 3] == tok.eos
+
+    def test_fallback_deterministic(self):
+        tok = FallbackTokenizer(256)
+        a, b = tok(["same prompt"]), tok(["same prompt"])
+        np.testing.assert_array_equal(a, b)
+        assert (tok(["other"]) != a).any()

@@ -1,0 +1,183 @@
+"""End-to-end pipeline tests on the tiny families (CPU, random weights).
+
+Covers the minimum end-to-end slice of SURVEY.md §7 plus the seed-exact
+range-split contract that replaces the reference's per-worker seed offsets
+(/root/reference/scripts/distributed.py:297-305)."""
+
+import base64
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY, TINY_XL
+from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
+from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    b64png_to_array,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+
+def init_params(family):
+    k = jax.random.key(0)
+    ids = jnp.zeros((1, 77), jnp.int32)
+    te = CLIPTextModel(family.text_encoder).init(k, ids)["params"]
+    te2 = (CLIPTextModel(family.text_encoder_2).init(k, ids)["params"]
+           if family.text_encoder_2 else None)
+    ctx_dim = family.unet.cross_attention_dim
+    args = [jnp.zeros((2, 8, 8, 4)), jnp.ones((2,)),
+            jnp.zeros((2, 77, ctx_dim))]
+    if family.unet.addition_embed_dim:
+        args.append(jnp.zeros((2, family.unet.projection_input_dim)))
+    un = UNet(family.unet).init(k, *args)["params"]
+    vae = VAE(family.vae).init(k, jnp.zeros((1, 16, 16, 3)),
+                               jax.random.key(1))["params"]
+    return {"text_encoder": te, "text_encoder_2": te2,
+            "unet": un, "vae": vae}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState())
+
+
+@pytest.fixture(scope="module")
+def engine_xl():
+    return Engine(TINY_XL, init_params(TINY_XL), chunk_size=4,
+                  state=GenerationState())
+
+
+def decode(b64):
+    return b64png_to_array(b64)
+
+
+class TestTxt2Img:
+    def test_shapes_seeds_infotext(self, engine):
+        p = GenerationPayload(prompt="a cow", steps=6, width=64, height=64,
+                              batch_size=2, seed=42)
+        r = engine.txt2img(p)
+        assert len(r.images) == 2
+        assert r.seeds == [42, 43]
+        img = decode(r.images[0])
+        assert img.shape == (64, 64, 3)
+        assert "Seed: 42" in r.infotexts[0]
+        assert "Sampler: Euler a" in r.infotexts[0]
+
+    def test_deterministic(self, engine):
+        p = GenerationPayload(prompt="x", steps=4, width=32, height=32, seed=9)
+        a = engine.txt2img(p).images[0]
+        b = engine.txt2img(p).images[0]
+        assert a == b
+
+    def test_range_split_seed_exact(self, engine):
+        """Sub-ranges == same images of the full batch: the DP contract."""
+        p = GenerationPayload(prompt="a cow", steps=4, width=32, height=32,
+                              batch_size=3, seed=100)
+        full = engine.txt2img(p)
+        part0 = engine.generate_range(p, 0, 1)
+        part12 = engine.generate_range(p, 1, 2)
+        assert part0.images[0] == full.images[0]
+        assert part12.images == full.images[1:]
+        assert part12.seeds == full.seeds[1:]
+
+    def test_n_iter(self, engine):
+        p = GenerationPayload(prompt="y", steps=4, width=32, height=32,
+                              batch_size=2, n_iter=2, seed=5)
+        r = engine.txt2img(p)
+        assert len(r.images) == 4
+        assert r.seeds == [5, 6, 7, 8]
+
+    def test_variation_seed_images_differ_but_share_base(self, engine):
+        p0 = GenerationPayload(prompt="v", steps=4, width=32, height=32,
+                               batch_size=2, seed=11, subseed=99,
+                               subseed_strength=0.4)
+        r = engine.txt2img(p0)
+        assert r.images[0] != r.images[1]  # subseed advances per image
+        assert r.seeds == [11, 11]         # base seed does not
+        assert r.subseeds == [99, 100]
+
+
+class TestImg2Img:
+    def test_roundtrip(self, engine):
+        src = GenerationPayload(prompt="s", steps=4, width=32, height=32,
+                                seed=1)
+        base = engine.txt2img(src).images[0]
+        p = GenerationPayload(prompt="s", steps=6, width=32, height=32,
+                              seed=2, init_images=[base],
+                              denoising_strength=0.5)
+        r = engine.img2img(p)
+        assert decode(r.images[0]).shape == (32, 32, 3)
+
+    def test_strength_zero_steps(self, engine):
+        # strength ~0 -> almost no denoise steps; must not crash
+        src = GenerationPayload(prompt="s", steps=4, width=32, height=32,
+                                seed=1)
+        base = engine.txt2img(src).images[0]
+        p = GenerationPayload(prompt="s", steps=4, width=32, height=32,
+                              seed=2, init_images=[base],
+                              denoising_strength=0.1)
+        r = engine.img2img(p)
+        assert len(r.images) == 1
+
+    def test_inpaint_mask(self, engine):
+        src = GenerationPayload(prompt="s", steps=4, width=32, height=32,
+                                seed=1)
+        base = engine.txt2img(src).images[0]
+        # mask: repaint left half only
+        from PIL import Image
+
+        m = np.zeros((32, 32, 3), np.uint8)
+        m[:, :16] = 255
+        buf = io.BytesIO()
+        Image.fromarray(m).save(buf, format="PNG")
+        mask_b64 = base64.b64encode(buf.getvalue()).decode()
+        p = GenerationPayload(prompt="s", steps=6, width=32, height=32,
+                              seed=3, init_images=[base], mask=mask_b64,
+                              denoising_strength=0.9)
+        r = engine.img2img(p)
+        out = decode(r.images[0]).astype(np.int32)
+        orig = decode(base).astype(np.int32)
+        # unmasked right half stays close to the original
+        right_diff = np.abs(out[:, 16:] - orig[:, 16:]).mean()
+        left_diff = np.abs(out[:, :16] - orig[:, :16]).mean()
+        assert right_diff < left_diff
+
+    def test_hires_fix_output_size(self, engine):
+        p = GenerationPayload(prompt="h", steps=4, width=32, height=32,
+                              seed=4, enable_hr=True, hr_scale=2.0,
+                              denoising_strength=0.7)
+        r = engine.txt2img(p)
+        assert decode(r.images[0]).shape == (64, 64, 3)
+
+
+class TestXL:
+    def test_txt2img(self, engine_xl):
+        p = GenerationPayload(prompt="xl", steps=4, width=32, height=32,
+                              seed=6)
+        r = engine_xl.txt2img(p)
+        assert decode(r.images[0]).shape == (32, 32, 3)
+
+
+class TestInterrupt:
+    def test_interrupt_stops_early(self):
+        st = GenerationState()
+        eng = Engine(TINY, init_params(TINY), chunk_size=1, state=st)
+        # interrupt as soon as the first chunk reports progress
+        st.add_listener(lambda prog: st.flag.interrupt())
+        p = GenerationPayload(prompt="i", steps=12, width=32, height=32,
+                              seed=8)
+        r = eng.txt2img(p)
+        # partial result is still decoded and returned (reference keeps
+        # whatever images came back, distributed.py:158-169)
+        assert len(r.images) == 1
+        assert st.progress.sampling_step < 12
