@@ -26,10 +26,12 @@ NOMINAL_SINGLE_GPU_IPM = 30.0
 
 
 def main() -> None:
+    import os
+
     import jax
     import jax.numpy as jnp
 
-    from stable_diffusion_webui_distributed_tpu.models.configs import SD15
+    from stable_diffusion_webui_distributed_tpu.models.configs import SD15, TINY
     from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
     from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
         GenerationPayload,
@@ -45,7 +47,10 @@ def main() -> None:
     print(f"bench: device={dev.device_kind} platform={dev.platform}",
           file=sys.stderr)
 
-    family = SD15
+    # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
+    # (same protocol and code path, tiny model + payload; NOT a perf claim).
+    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+    family = TINY if tiny else SD15
     zeros = lambda mod, *args: jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype),
         jax.eval_shape(lambda: mod.init(jax.random.key(0), *args)))["params"]
@@ -56,22 +61,26 @@ def main() -> None:
 
     t0 = time.time()
     ids = jnp.zeros((1, 77), jnp.int32)
+    # init spatial dims are irrelevant to param shapes — keep them minimal
     params = {
         "text_encoder": zeros(CLIPTextModel(family.text_encoder), ids),
         "text_encoder_2": None,
         "unet": zeros(
             UNet(family.unet),
-            jnp.zeros((2, 64, 64, 4)), jnp.ones((2,)),
+            jnp.zeros((2, 16, 16, 4)), jnp.ones((2,)),
             jnp.zeros((2, 77, family.unet.cross_attention_dim))),
         "vae": zeros(
             VAE(family.vae),
-            jnp.zeros((1, 512, 512, 3)), jax.random.key(1)),
+            jnp.zeros((1, 64, 64, 3)), jax.random.key(1)),
     }
     print(f"bench: zero-init params in {time.time()-t0:.1f}s", file=sys.stderr)
 
-    engine = Engine(family, params, policy=dtypes.TPU, model_name="sd15-bench")
+    engine = Engine(family, params, policy=dtypes.TPU,
+                    model_name=f"{family.name}-bench")
 
     bp = BenchmarkPayload()  # the reference's fixed calibration workload
+    if tiny:
+        bp = BenchmarkPayload(width=64, height=64, steps=4)
     payload = GenerationPayload(
         prompt=bp.prompt, negative_prompt=bp.negative_prompt, steps=bp.steps,
         width=bp.width, height=bp.height, batch_size=bp.batch_size,
@@ -91,8 +100,10 @@ def main() -> None:
 
     avg = sum(samples) / len(samples)
     ipm = bp.batch_size / (avg / 60.0)
+    metric = ("tiny_logiccheck_ipm" if tiny
+              else "sd15_512x512_20step_euler_a_ipm")
     print(json.dumps({
-        "metric": "sd15_512x512_20step_euler_a_ipm",
+        "metric": metric,
         "value": round(ipm, 2),
         "unit": "images/min",
         "vs_baseline": round(ipm / NOMINAL_SINGLE_GPU_IPM, 3),

@@ -1,0 +1,283 @@
+"""Command-line surface: generate / benchmark / serve / status / workers.
+
+The reference's user surface is a Gradio panel inside webui
+(/root/reference/scripts/spartan/ui.py:217-404: Status, Utils, Worker
+Config, Settings tabs). The CLI covers the same operations head-on:
+``generate`` (the Generate button + payload), ``benchmark`` ("Redo
+benchmarks", ui.py:259-260), ``ping`` ("Reconnect workers", ui.py:268-269),
+``interrupt`` ("Interrupt all", ui.py:271-272), ``workers`` (Worker Config
+CRUD, ui.py:90-214), ``status`` (the Status tab + /progress), ``serve``
+(the node role every remote plays).
+
+Usage::
+
+    python -m stable_diffusion_webui_distributed_tpu.cli generate \
+        --prompt "a herd of cows" --steps 20 --size 512x512 -n 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from stable_diffusion_webui_distributed_tpu.runtime import config as config_mod
+from stable_diffusion_webui_distributed_tpu.runtime import flags as flags_mod
+from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+    configure as configure_logging,
+    get_ring_buffer,
+)
+
+
+def _build_world(args, require_local: bool = True):
+    """World from config + a local engine backend when models exist.
+
+    ``require_local=False`` (status/ping) skips checkpoint activation —
+    loading+converting a multi-GB checkpoint to print metadata is wasteful.
+    """
+    from stable_diffusion_webui_distributed_tpu.pipeline.registry import (
+        ModelRegistry,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        LocalBackend, WorkerNode,
+    )
+
+    path = args.distributed_config or config_mod.default_config_path()
+    cfg = config_mod.load_config(path)
+    world = World.from_config(
+        cfg, config_path=path,
+        verify_tls=not args.distributed_skip_verify_remotes)
+
+    mesh = None
+    mesh_spec = args.mesh or ",".join(
+        f"{k}={v}" for k, v in cfg.mesh_axes.items())
+    if mesh_spec:
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        mesh = build_mesh(mesh_spec)
+
+    registry = ModelRegistry(args.model_dir or cfg.model_dir, mesh=mesh)
+    engine = None
+    if require_local:
+        names = list(registry.available())
+        if names:
+            want = cfg.default_model or names[0]
+            engine = registry.activate(want if want in names else names[0])
+    if engine is not None:
+        world.current_model = registry.current_name
+        master_cal = world.master_calibration()
+        node = WorkerNode(
+            "master", LocalBackend(engine), master=True,
+            benchmark_payload=cfg.benchmark_payload,
+            avg_ipm=master_cal.avg_ipm if master_cal else None,
+            eta_percent_error=(master_cal.eta_percent_error
+                               if master_cal else None),
+            pixel_cap=master_cal.pixel_cap if master_cal else 0,
+        )
+        world.workers.insert(0, node)  # master leads the gallery
+    elif engine is None and require_local and not world.workers:
+        print("no checkpoints found and no remote workers configured; "
+              f"put a .safetensors under '{registry.model_dir}' or add "
+              "workers to the config", file=sys.stderr)
+        sys.exit(2)
+    return world, registry
+
+
+def cmd_generate(args) -> int:
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload, b64png_to_array,
+    )
+
+    world, _ = _build_world(args)
+    w, h = (int(x) for x in args.size.split("x"))
+    payload = GenerationPayload(
+        prompt=args.prompt, negative_prompt=args.negative or "",
+        steps=args.steps, width=w, height=h,
+        batch_size=args.num, seed=args.seed,
+        sampler_name=args.sampler, cfg_scale=args.cfg,
+        enable_hr=args.hires, hr_scale=args.hires_scale,
+        denoising_strength=args.strength,
+    )
+    if args.init_image:
+        import base64
+
+        with open(args.init_image, "rb") as f:
+            payload.init_images = [base64.b64encode(f.read()).decode()]
+    result = world.execute(payload)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    from PIL import Image
+    import numpy as np
+
+    for i, (b64, info) in enumerate(zip(result.images, result.infotexts)):
+        arr = b64png_to_array(b64)
+        img = Image.fromarray(np.asarray(arr))
+        path = os.path.join(args.outdir,
+                            f"{result.seeds[i]}-{i:02d}.png")
+        img.save(path)
+        print(path)
+        if args.verbose_info:
+            print("  " + info.replace("\n", " | "))
+    return 0
+
+
+def cmd_benchmark(args) -> int:
+    world, _ = _build_world(args)
+    speeds = world.benchmark_all(rebenchmark=args.rebenchmark)
+    for label, ipm in sorted(speeds.items(), key=lambda kv: -kv[1]):
+        print(f"{label:24s} {ipm:8.2f} ipm")
+    if not speeds:
+        print("no benchmarkable workers", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_ping(args) -> int:
+    world, _ = _build_world(args, require_local=False)
+    results = world.ping_workers(indiscriminate=True)
+    for label, ok in results.items():
+        print(f"{label:24s} {'reachable' if ok else 'UNREACHABLE'}")
+    world.save_config()
+    return 0 if all(results.values()) else 1
+
+
+def cmd_interrupt(args) -> int:
+    # interrupt a running server node over its own API
+    import urllib.request
+
+    url = f"http://{args.listen}:{args.port}/sdapi/v1/interrupt"
+    urllib.request.urlopen(urllib.request.Request(url, method="POST"),
+                           timeout=5)
+    print("interrupt sent")
+    return 0
+
+
+def cmd_status(args) -> int:
+    world, registry = _build_world(args, require_local=False)
+    print(f"config: {world.config_path or config_mod.default_config_path()}")
+    print(f"models: {', '.join(registry.available()) or '(none)'}")
+    for w in world.workers:
+        speed = (f"{w.cal.avg_ipm:.2f} ipm" if w.cal.benchmarked
+                 else "not benchmarked")
+        print(f"  {w.label:20s} {w.state.name:12s} {speed}"
+              f"{'  [master]' if w.master else ''}")
+    for line in get_ring_buffer().dump():
+        print("  log: " + line)
+    return 0
+
+
+def cmd_workers(args) -> int:
+    path = args.distributed_config or config_mod.default_config_path()
+    cfg = config_mod.load_config(path)
+    if args.action == "list":
+        for entry in cfg.workers:
+            for label, wm in entry.items():
+                print(f"{label:20s} {wm.address}:{wm.port} "
+                      f"{'tls ' if wm.tls else ''}"
+                      f"{'disabled ' if wm.disabled else ''}"
+                      f"ipm={wm.avg_ipm}")
+        return 0
+    if args.action == "add":
+        if not args.label:
+            print("--label required", file=sys.stderr)
+            return 2
+        cfg.workers = [e for e in cfg.workers if args.label not in e]
+        cfg.workers.append({args.label: config_mod.WorkerModel(
+            address=args.address, port=args.api_port, tls=args.tls,
+            user=args.user, password=args.password,
+            pixel_cap=args.pixel_cap)})
+        config_mod.save_config(cfg, path)
+        print(f"worker '{args.label}' saved to {path}")
+        return 0
+    if args.action == "remove":
+        before = len(cfg.workers)
+        cfg.workers = [e for e in cfg.workers if args.label not in e]
+        config_mod.save_config(cfg, path)
+        print(f"removed {before - len(cfg.workers)} worker(s)")
+        return 0
+    print(f"unknown action {args.action}", file=sys.stderr)
+    return 2
+
+
+def cmd_serve(args) -> int:
+    from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+
+    world, registry = _build_world(args)
+    world.current_model = registry.current_name
+    server = ApiServer(world, registry=registry, host=args.listen,
+                       port=args.port, user=args.api_auth_user,
+                       password=args.api_auth_password)
+    server.serve_forever()
+    if server.restart_requested:
+        # /sdapi/v1/server-restart relaunches the node, as the reference's
+        # whole-fleet restart expects (worker.py:690-717)
+        os.execv(sys.executable, [sys.executable, "-m",
+                                  "stable_diffusion_webui_distributed_tpu.cli",
+                                  *sys.argv[1:]])
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="sdtpu", description=__doc__.split("\n")[0])
+    flags_mod.add_flags(parser)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("generate", help="txt2img / img2img")
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--negative", default="")
+    g.add_argument("--steps", type=int, default=20)
+    g.add_argument("--size", default="512x512")
+    g.add_argument("-n", "--num", type=int, default=1)
+    g.add_argument("--seed", type=int, default=-1)
+    g.add_argument("--sampler", default="Euler a")
+    g.add_argument("--cfg", type=float, default=7.0)
+    g.add_argument("--init-image", default=None)
+    g.add_argument("--strength", type=float, default=0.75)
+    g.add_argument("--hires", action="store_true")
+    g.add_argument("--hires-scale", type=float, default=2.0)
+    g.add_argument("--outdir", default="outputs")
+    g.add_argument("--verbose-info", action="store_true")
+    g.set_defaults(fn=cmd_generate)
+
+    b = sub.add_parser("benchmark", help="2+3 ipm benchmark of all workers")
+    b.add_argument("--rebenchmark", action="store_true")
+    b.set_defaults(fn=cmd_benchmark)
+
+    sub.add_parser("ping", help="health sweep").set_defaults(fn=cmd_ping)
+    sub.add_parser("status", help="worker/model status").set_defaults(
+        fn=cmd_status)
+    sub.add_parser("interrupt", help="interrupt a serving node").set_defaults(
+        fn=cmd_interrupt)
+
+    wk = sub.add_parser("workers", help="worker registry CRUD")
+    wk.add_argument("action", choices=["list", "add", "remove"])
+    wk.add_argument("--label")
+    wk.add_argument("--address", default="localhost")
+    wk.add_argument("--api-port", type=int, default=7860)
+    wk.add_argument("--tls", action="store_true")
+    wk.add_argument("--user", default=None)
+    wk.add_argument("--password", default=None)
+    wk.add_argument("--pixel-cap", type=int, default=0)
+    wk.set_defaults(fn=cmd_workers)
+
+    s = sub.add_parser("serve", help="run the sdapi-v1 node server")
+    s.add_argument("--api-auth-user", default=None)
+    s.add_argument("--api-auth-password", default=None)
+    s.set_defaults(fn=cmd_serve)
+    return parser
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logging(debug=args.distributed_debug)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

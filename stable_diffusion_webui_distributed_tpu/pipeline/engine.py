@@ -40,21 +40,12 @@ from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     array_to_b64png,
     b64png_to_array,
     build_infotext,
+    fix_seed,
 )
 from stable_diffusion_webui_distributed_tpu.runtime import dtypes, rng
 from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
 from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
-
-
-def _fix_seed(seed: int) -> int:
-    """-1 -> fresh random seed (webui fix_seed semantics; the reference
-    records the fixed value before fan-out, distributed.py:252-254)."""
-    if seed is None or int(seed) == -1:
-        import secrets
-
-        return secrets.randbelow(2**32)
-    return int(seed) % 2**32
 
 
 class Engine:
@@ -70,12 +61,14 @@ class Engine:
         state: Optional[interrupt_mod.GenerationState] = None,
         chunk_size: int = 5,
         schedule: Optional[sched.NoiseSchedule] = None,
+        mesh=None,
     ):
         self.family = family
         self.policy = policy
         self.model_name = model_name or family.name
         self.state = state or interrupt_mod.STATE
         self.chunk_size = max(1, chunk_size)
+        self.mesh = mesh
         self.schedule = schedule or sched.sd_schedule(
             prediction_type=family.prediction_type
         )
@@ -86,6 +79,16 @@ class Engine:
         cast = lambda t: dtypes.cast_floating(t, policy.param_dtype)
         self.params = {k: (cast(v) if v is not None else None)
                        for k, v in params.items()}
+        if mesh is not None:
+            # Megatron-pattern TP placement (or replication at tp=1); the
+            # batch axis is placed per request in _place_batch. XLA's SPMD
+            # partitioner handles the rest (parallel/sharding.py).
+            from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+                shard_params,
+            )
+
+            self.params = {k: (shard_params(v, mesh) if v is not None else None)
+                           for k, v in self.params.items()}
 
         cd = policy.compute_dtype
         self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
@@ -283,8 +286,8 @@ class Engine:
         assigns each HTTP worker a sub-batch plus a seed offset
         (distributed.py:284-319)."""
         payload = payload.model_copy()
-        payload.seed = _fix_seed(payload.seed)
-        payload.subseed = _fix_seed(payload.subseed)
+        payload.seed = fix_seed(payload.seed)
+        payload.subseed = fix_seed(payload.subseed)
         count = payload.total_images if count is None else count
         if payload.init_images:
             return self._run_img2img(payload, start_index, count, job)
@@ -301,6 +304,21 @@ class Engine:
     def _latent_hw(self, width, height):
         f = self.family.vae_scale_factor
         return height // f, width // f
+
+    def _place_batch(self, x):
+        """Split the batch over the mesh's dp axis when it divides evenly;
+        the remainder case falls back to single-placement (pad-and-mask is
+        the scheduler's job via mesh.pad_batch)."""
+        if self.mesh is None:
+            return x
+        dp = self.mesh.shape.get("dp", 1)
+        if dp <= 1 or x.shape[0] % dp != 0:
+            return x
+        from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+            place_batch,
+        )
+
+        return place_batch(x, self.mesh)
 
     def _image_keys(self, payload, start, batch):
         idx = jnp.arange(batch, dtype=jnp.uint32) + jnp.uint32(start)
@@ -375,7 +393,7 @@ class Engine:
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
                 pos, n, (h, w, C))
-            x = noise.astype(jnp.float32) * sigmas[0]
+            x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
             keys = self._image_keys(payload, pos, n)
             latents = self._denoise(
                 payload, x, keys, conds, pooleds, width, height,
@@ -456,7 +474,8 @@ class Engine:
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
                 pos, n, init_lat.shape[1:])
-            x = init_lat + noise.astype(jnp.float32) * sigmas[start_step]
+            x = self._place_batch(
+                init_lat + noise.astype(jnp.float32) * sigmas[start_step])
             keys = self._image_keys(payload, pos, n)
             latents = self._denoise_range(
                 payload, x, keys, conds, pooleds, width, height,

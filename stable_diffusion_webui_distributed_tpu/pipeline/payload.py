@@ -92,6 +92,17 @@ class GenerationResult(BaseModel):
         self.worker_labels.extend(other.worker_labels)
 
 
+def fix_seed(seed: Optional[int]) -> int:
+    """-1 -> fresh random seed (webui fix_seed semantics; the reference
+    records the fixed value before fan-out so every worker agrees on the
+    seed base, distributed.py:252-254)."""
+    if seed is None or int(seed) == -1:
+        import secrets
+
+        return secrets.randbelow(2**32)
+    return int(seed) % 2**32
+
+
 # --------------------------------------------------------------------------
 # image <-> base64 PNG (wire format parity with the reference)
 # --------------------------------------------------------------------------

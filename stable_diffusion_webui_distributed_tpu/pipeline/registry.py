@@ -1,0 +1,116 @@
+"""Model registry: checkpoint discovery + engine lifecycle.
+
+webui scans a checkpoint directory and switches models via POST /options;
+the reference syncs that choice across every worker
+(/root/reference/scripts/spartan/world.py:784-811, worker.py:646-688). This
+registry is the node-local half: discover ``*.safetensors``/``*.ckpt`` in a
+directory, convert to Flax on activation, keep the active
+:class:`~..pipeline.engine.Engine` (one at a time — a TPU's HBM rarely fits
+two SDXLs; switching drops the old params before loading the new).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+
+CHECKPOINT_EXTENSIONS = (".safetensors", ".ckpt", ".pt")
+
+
+class ModelRegistry:
+    """Discovers checkpoints and activates one engine at a time."""
+
+    def __init__(self, model_dir: str = "models",
+                 policy: dtypes.Policy = dtypes.TPU,
+                 chunk_size: int = 5,
+                 state=None,
+                 mesh=None):
+        self.model_dir = model_dir
+        self.policy = policy
+        self.chunk_size = chunk_size
+        self.state = state
+        self.mesh = mesh
+        self._paths: Dict[str, str] = {}
+        self._engine = None
+        self.current_name: str = ""
+        self._lock = threading.Lock()
+        self.refresh()
+
+    def refresh(self) -> Dict[str, str]:
+        """Re-scan the model directory (reference fan-outs
+        /refresh-checkpoints the same way, worker.py:577-581)."""
+        found: Dict[str, str] = {}
+        if os.path.isdir(self.model_dir):
+            for name in sorted(os.listdir(self.model_dir)):
+                if name.lower().endswith(CHECKPOINT_EXTENSIONS):
+                    found[os.path.splitext(name)[0]] = os.path.join(
+                        self.model_dir, name)
+        self._paths = found
+        return found
+
+    def available(self) -> Dict[str, str]:
+        return dict(self._paths)
+
+    @property
+    def engine(self):
+        return self._engine
+
+    def register_engine(self, name: str, engine) -> None:
+        """Install a pre-built engine (tests, programmatic use)."""
+        with self._lock:
+            self._engine = engine
+            self.current_name = name
+
+    def activate(self, name: str):
+        """Load + convert the named checkpoint and build its engine."""
+        with self._lock:
+            if name == self.current_name and self._engine is not None:
+                return self._engine
+            path = self._paths.get(name) or self._paths.get(
+                os.path.splitext(name)[0])
+            if path is None:
+                raise KeyError(f"unknown model '{name}' "
+                               f"(have: {list(self._paths)})")
+            log = get_logger()
+            log.info("loading checkpoint '%s' from %s", name, path)
+
+            from stable_diffusion_webui_distributed_tpu.models import convert
+            from stable_diffusion_webui_distributed_tpu.models.configs import (
+                FAMILIES,
+            )
+            from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
+                load_tokenizer,
+            )
+            from stable_diffusion_webui_distributed_tpu.pipeline.engine import (
+                Engine,
+            )
+
+            if path.lower().endswith(".safetensors"):
+                sd = convert.load_safetensors(path)
+            else:
+                import torch
+
+                raw = torch.load(path, map_location="cpu", weights_only=True)
+                raw = raw.get("state_dict", raw)
+                sd = {k: v.float().numpy() for k, v in raw.items()
+                      if hasattr(v, "numpy")}
+            family = FAMILIES[convert.detect_family(sd)]
+            params = convert.convert_ldm(sd, family)
+            del sd  # free host RAM before device transfer
+
+            # drop the previous engine's params before building the new one
+            self._engine = None
+            tokenizer = load_tokenizer(self.model_dir,
+                                       family.text_encoder.vocab_size)
+            self._engine = Engine(
+                family, params, tokenizer=tokenizer, policy=self.policy,
+                model_name=name, chunk_size=self.chunk_size,
+                state=self.state, mesh=self.mesh,
+            )
+            self.current_name = name
+            log.info("checkpoint '%s' active (%s)", name, family.name)
+            return self._engine

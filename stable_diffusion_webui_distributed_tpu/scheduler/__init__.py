@@ -1,0 +1,28 @@
+"""Scheduler: the reference's World/Job/ETA/benchmark policy layer, reborn
+as the multi-backend planner above the TPU compute path.
+
+Within one mesh, parallelism is XLA's problem (parallel/). This package
+balances *across* generation backends — the local mesh, other slices/hosts,
+or remote sdapi servers — exactly the scheduling problem the reference
+solves for a pool of HTTP GPU workers (/root/reference/scripts/spartan/
+world.py, worker.py): speed-calibrated splits, stall detection, deferral,
+complementary production, elastic health handling.
+"""
+
+from stable_diffusion_webui_distributed_tpu.scheduler.eta import (  # noqa: F401
+    EtaCalibration,
+    SAMPLER_SPEED_VS_EULER_A,
+    predict_eta,
+    record_eta_error,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.worker import (  # noqa: F401
+    State,
+    WorkerNode,
+    LocalBackend,
+    StubBackend,
+    HTTPBackend,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.world import (  # noqa: F401
+    Job,
+    World,
+)

@@ -1,0 +1,446 @@
+"""Generation backends ("workers") and their health state machine.
+
+A :class:`WorkerNode` is one schedulable generation backend. The reference's
+worker is always a remote sdwui HTTP process
+(/root/reference/scripts/spartan/worker.py:51-758); here a backend is
+pluggable:
+
+- :class:`LocalBackend` — the in-process Engine on the local TPU mesh (the
+  "master" role; the reference times local generation the same way,
+  world.py:188-197);
+- :class:`HTTPBackend` — a remote sdapi-v1 server (another host running
+  this framework, or an actual sdwui instance) — capability parity with the
+  reference's transport (worker.py:288-504);
+- :class:`StubBackend` — deterministic fake for tests and failure injection
+  (SURVEY.md §4 test strategy).
+
+State machine parity (worker.py:36-41, 719-758): 5 states with guarded
+transitions; a demotion to UNAVAILABLE invalidates the loaded-model cache so
+a reconnect forces re-sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Protocol
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    GenerationResult,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    BenchmarkPayload,
+    WARMUP_SAMPLES,
+    RECORDED_SAMPLES,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+from stable_diffusion_webui_distributed_tpu.scheduler import eta as eta_mod
+
+
+class State(enum.Enum):
+    IDLE = 1
+    WORKING = 2
+    INTERRUPTED = 3
+    UNAVAILABLE = 4
+    DISABLED = 5
+
+
+#: Guarded transition table (reference worker.py:738-743). UNAVAILABLE is
+#: reachable from anywhere except DISABLED (handled specially in set_state).
+TRANSITIONS = {
+    State.IDLE: {State.IDLE, State.WORKING, State.DISABLED},
+    State.WORKING: {State.WORKING, State.IDLE, State.INTERRUPTED},
+    State.UNAVAILABLE: {State.IDLE},
+    State.INTERRUPTED: {State.WORKING, State.IDLE},
+    State.DISABLED: {State.IDLE},
+}
+
+
+class Backend(Protocol):
+    """What a schedulable backend must provide."""
+
+    def generate(self, payload: GenerationPayload, start_index: int,
+                 count: int) -> GenerationResult: ...
+
+    def reachable(self) -> bool: ...
+
+    def interrupt(self) -> None: ...
+
+    def load_options(self, model: str, vae: str = "") -> None: ...
+
+    def available_models(self) -> List[str]: ...
+
+    def memory_info(self) -> Dict[str, Any]: ...
+
+
+class WorkerNode:
+    """One schedulable backend + its calibration, state, and caps."""
+
+    def __init__(
+        self,
+        label: str,
+        backend: Backend,
+        master: bool = False,
+        pixel_cap: int = 0,
+        avg_ipm: Optional[float] = None,
+        eta_percent_error: Optional[List[float]] = None,
+        benchmark_payload: Optional[BenchmarkPayload] = None,
+    ):
+        self.label = label
+        self.backend = backend
+        self.master = master
+        self.pixel_cap = pixel_cap  # 0 = uncapped (reference -1, pmodels.py:34)
+        self.cal = eta_mod.EtaCalibration(
+            avg_ipm=avg_ipm,
+            eta_percent_error=list(eta_percent_error or []),
+        )
+        self.benchmark_payload = benchmark_payload or BenchmarkPayload()
+        self.state = State.IDLE
+        self.loaded_model: Optional[str] = None
+        self.loaded_vae: Optional[str] = None
+        self.model_override: Optional[str] = None  # runtime-only, ui.py:161-171
+        self.response_time: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # -- state machine ------------------------------------------------------
+
+    def set_state(self, state: State, expect_cycle: bool = False) -> bool:
+        """Guarded transition; returns True if the state changed/held legally."""
+        log = get_logger()
+        with self._lock:
+            if state == State.UNAVAILABLE:
+                if self.state == State.DISABLED:
+                    log.debug("%s: disabled, refusing UNAVAILABLE", self.label)
+                    return False
+                # invalidate model cache so reconnection forces re-sync
+                # (reference worker.py:747-755)
+                self.loaded_model = None
+                self.loaded_vae = None
+                log.warning("worker '%s' unreachable; avoided until "
+                            "reconnection", self.label)
+                self.state = State.UNAVAILABLE
+                return True
+            if state in TRANSITIONS.get(self.state, set()):
+                if state != self.state or expect_cycle:
+                    log.debug("%s: %s -> %s", self.label, self.state.name,
+                              state.name)
+                    self.state = state
+                return True
+            log.debug("%s: invalid transition %s -> %s", self.label,
+                      self.state.name, state.name)
+            return False
+
+    @property
+    def available(self) -> bool:
+        return self.state not in (State.UNAVAILABLE, State.DISABLED)
+
+    # -- ETA ----------------------------------------------------------------
+
+    def eta(self, payload, batch_size: Optional[int] = None,
+            steps: Optional[int] = None) -> float:
+        return eta_mod.predict_eta(self.cal, payload, self.benchmark_payload,
+                                   batch_size=batch_size, steps=steps)
+
+    # -- request lifecycle --------------------------------------------------
+
+    def request(self, payload: GenerationPayload, start_index: int,
+                count: int) -> Optional[GenerationResult]:
+        """Generate images [start_index, start_index+count); returns None on
+        failure (the reference logs and drops the worker's images,
+        distributed.py:158-169 + worker.py:494-500)."""
+        log = get_logger()
+        # wait out a prior request still in flight (reference busy-wait,
+        # worker.py:301-315)
+        deadline = time.monotonic() + 30.0
+        while self.state == State.WORKING and time.monotonic() < deadline:
+            time.sleep(0.1)
+        self.set_state(State.WORKING)
+
+        predicted = None
+        if self.cal.benchmarked:
+            try:
+                predicted = self.eta(payload, batch_size=count)
+            except ValueError:
+                predicted = None
+        started = time.monotonic()
+        try:
+            result = self.backend.generate(payload, start_index, count)
+        except Exception as e:  # noqa: BLE001 — any backend failure demotes
+            log.error("worker '%s' failed request: %s", self.label, e)
+            self.set_state(State.UNAVAILABLE)
+            return None
+        elapsed = time.monotonic() - started
+        self.response_time = elapsed
+        if predicted is not None:
+            eta_mod.record_eta_error(self.cal, predicted, elapsed)
+        self.set_state(State.IDLE)
+        return result
+
+    def interrupt(self) -> None:
+        try:
+            self.backend.interrupt()
+            self.set_state(State.INTERRUPTED)
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("interrupt of '%s' failed: %s", self.label, e)
+            self.set_state(State.UNAVAILABLE)
+
+    def reachable(self) -> bool:
+        try:
+            return self.backend.reachable()
+        except Exception:  # noqa: BLE001
+            return False
+
+    def load_options(self, model: str, vae: str = "") -> bool:
+        """Sync the loaded checkpoint (reference worker.py:646-688)."""
+        if self.model_override:
+            model = self.model_override
+        if self.loaded_model == model and self.loaded_vae == vae:
+            return True
+        try:
+            t0 = time.monotonic()
+            self.backend.load_options(model, vae)
+            get_logger().info("worker '%s' loaded model '%s' in %.1fs",
+                              self.label, model, time.monotonic() - t0)
+            self.loaded_model, self.loaded_vae = model, vae
+            return True
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("model sync to '%s' failed: %s", self.label, e)
+            self.set_state(State.UNAVAILABLE)
+            return False
+
+    # -- benchmark ----------------------------------------------------------
+
+    def benchmark(self, rebenchmark: bool = False) -> Optional[float]:
+        """2 warmup + 3 recorded samples of the fixed benchmark payload ->
+        avg images/minute (reference worker.py:506-575, shared.py:63-64)."""
+        log = get_logger()
+        if self.cal.benchmarked and not rebenchmark:
+            return self.cal.avg_ipm
+        if not self.reachable():
+            self.set_state(State.UNAVAILABLE)
+            return None
+        bp = self.benchmark_payload
+        payload = GenerationPayload(
+            prompt=bp.prompt, negative_prompt=bp.negative_prompt,
+            steps=bp.steps, width=bp.width, height=bp.height,
+            batch_size=bp.batch_size, sampler_name=bp.sampler_name, seed=1,
+        )
+        ipms = []
+        for i in range(WARMUP_SAMPLES + RECORDED_SAMPLES):
+            t0 = time.monotonic()
+            try:
+                result = self.backend.generate(payload, 0, bp.batch_size)
+            except Exception as e:  # noqa: BLE001
+                log.error("benchmark of '%s' failed: %s", self.label, e)
+                self.set_state(State.UNAVAILABLE)
+                return None
+            elapsed = time.monotonic() - t0
+            sample_ipm = len(result.images) / (elapsed / 60.0)
+            if i < WARMUP_SAMPLES:
+                log.debug("benchmark '%s' warmup %d: %.2f ipm",
+                          self.label, i, sample_ipm)
+            else:
+                ipms.append(sample_ipm)
+                log.debug("benchmark '%s' sample %d: %.2f ipm",
+                          self.label, i - WARMUP_SAMPLES, sample_ipm)
+        self.cal.avg_ipm = sum(ipms) / len(ipms)
+        self.cal.eta_percent_error.clear()  # stale MPE dies with re-bench
+        log.info("worker '%s': %.2f ipm", self.label, self.cal.avg_ipm)
+        return self.cal.avg_ipm
+
+
+# --------------------------------------------------------------------------
+# backends
+# --------------------------------------------------------------------------
+
+class LocalBackend:
+    """The in-process Engine (master role)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def generate(self, payload, start_index, count):
+        return self.engine.generate_range(payload, start_index, count)
+
+    def reachable(self) -> bool:
+        return True
+
+    def interrupt(self) -> None:
+        self.engine.state.flag.interrupt()
+
+    def load_options(self, model: str, vae: str = "") -> None:
+        # local model switching is handled by the ModelRegistry at the
+        # server layer; the engine itself holds one loaded family
+        self.engine.model_name = model or self.engine.model_name
+
+    def available_models(self) -> List[str]:
+        return [self.engine.model_name]
+
+    def memory_info(self) -> Dict[str, Any]:
+        import jax
+
+        out: Dict[str, Any] = {"devices": []}
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — CPU backends lack stats
+                stats = {}
+            out["devices"].append({
+                "id": d.id, "kind": d.device_kind,
+                "bytes_in_use": stats.get("bytes_in_use", 0),
+                "bytes_limit": stats.get("bytes_limit", 0),
+            })
+        return out
+
+
+@dataclasses.dataclass
+class StubBehavior:
+    """Failure-injection knobs for tests."""
+
+    seconds_per_image: float = 0.0
+    fail_generate: bool = False
+    fail_reachable: bool = False
+    fail_after_n_requests: Optional[int] = None
+
+
+class StubBackend:
+    """Deterministic in-process fake worker (SURVEY §4: failure injection)."""
+
+    def __init__(self, behavior: Optional[StubBehavior] = None):
+        self.behavior = behavior or StubBehavior()
+        self.requests: List[Dict[str, Any]] = []
+        self.interrupted = False
+        self.options: Dict[str, str] = {}
+
+    def generate(self, payload, start_index, count):
+        n = len(self.requests)
+        self.requests.append(
+            {"payload": payload, "start": start_index, "count": count})
+        b = self.behavior
+        if b.fail_generate or (
+            b.fail_after_n_requests is not None
+            and n >= b.fail_after_n_requests
+        ):
+            raise ConnectionError("stub backend injected failure")
+        if b.seconds_per_image:
+            time.sleep(b.seconds_per_image * count)
+        result = GenerationResult()
+        for i in range(start_index, start_index + count):
+            result.images.append(f"stub-image-{payload.seed + i}")
+            result.seeds.append(payload.seed + i)
+            result.subseeds.append(payload.subseed + i)
+            result.prompts.append(payload.prompt)
+            result.negative_prompts.append(payload.negative_prompt)
+            result.infotexts.append(f"{payload.prompt}, Seed: {payload.seed + i}")
+            result.worker_labels.append("")
+        return result
+
+    def reachable(self) -> bool:
+        return not self.behavior.fail_reachable
+
+    def interrupt(self) -> None:
+        self.interrupted = True
+
+    def load_options(self, model: str, vae: str = "") -> None:
+        if self.behavior.fail_generate:
+            raise ConnectionError("stub: load_options failure")
+        self.options = {"model": model, "vae": vae}
+
+    def available_models(self) -> List[str]:
+        return ["stub-model"]
+
+    def memory_info(self) -> Dict[str, Any]:
+        return {"ram": {"free": 1 << 30, "used": 0, "total": 1 << 30}}
+
+
+class HTTPBackend:
+    """Remote sdapi-v1 server over HTTP(S) — the reference's entire transport
+    (worker.py:192-203 route table, 288-504 request path), kept for parity so
+    a pool of this framework's servers (or legacy sdwui nodes) can be driven.
+    """
+
+    def __init__(self, address: str, port: int, tls: bool = False,
+                 user: Optional[str] = None, password: Optional[str] = None,
+                 verify_tls: bool = True, timeout: float = 3.0):
+        self.address = address
+        self.port = port
+        self.tls = tls
+        self.user = user
+        self.password = password
+        self.verify_tls = verify_tls
+        self.timeout = timeout
+        import requests
+
+        self.session = requests.Session()
+        self.session.verify = verify_tls
+        if user or password:
+            self.session.auth = (user or "", password or "")
+
+    def url(self, route: str) -> str:
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.address}:{self.port}/sdapi/v1/{route}"
+
+    def generate(self, payload: GenerationPayload, start_index: int,
+                 count: int) -> GenerationResult:
+        body = payload.model_dump()
+        # seed fan-out arithmetic, identical to the reference master
+        # (distributed.py:297-305): offset by prior images
+        if payload.subseed_strength == 0:
+            body["seed"] = payload.seed + start_index
+        body["subseed"] = payload.subseed + start_index
+        body["batch_size"] = count
+        body["n_iter"] = 1
+        route = "img2img" if payload.init_images else "txt2img"
+        r = self.session.post(self.url(route), json=body, timeout=3600)
+        r.raise_for_status()
+        data = r.json()
+        result = GenerationResult(images=data.get("images", []))
+        info = data.get("info")
+        if isinstance(info, str):
+            import json as _json
+
+            try:
+                info = _json.loads(info)
+            except ValueError:
+                info = {}
+        info = info or {}
+        result.seeds = info.get("all_seeds",
+                                [body["seed"] + i for i in range(count)])
+        result.subseeds = info.get("all_subseeds",
+                                   [body["subseed"] + i for i in range(count)])
+        result.prompts = info.get("all_prompts", [payload.prompt] * count)
+        result.negative_prompts = info.get(
+            "all_negative_prompts", [payload.negative_prompt] * count)
+        result.infotexts = info.get("infotexts", [""] * count)
+        result.worker_labels = [""] * len(result.images)
+        return result
+
+    def reachable(self) -> bool:
+        try:
+            r = self.session.get(self.url("memory"), timeout=self.timeout)
+            return r.ok
+        except Exception:  # noqa: BLE001
+            return False
+
+    def interrupt(self) -> None:
+        self.session.post(self.url("interrupt"), timeout=self.timeout)
+
+    def load_options(self, model: str, vae: str = "") -> None:
+        body = {"sd_model_checkpoint": model}
+        if vae:
+            body["sd_vae"] = vae
+        r = self.session.post(self.url("options"), json=body, timeout=600)
+        r.raise_for_status()
+
+    def available_models(self) -> List[str]:
+        r = self.session.get(self.url("sd-models"), timeout=self.timeout)
+        r.raise_for_status()
+        return [m.get("model_name", m.get("title", "?")) for m in r.json()]
+
+    def memory_info(self) -> Dict[str, Any]:
+        r = self.session.get(self.url("memory"), timeout=self.timeout)
+        r.raise_for_status()
+        return r.json()

@@ -1,0 +1,517 @@
+"""World: the multi-backend job planner and request orchestrator.
+
+Policy parity with the reference's scheduler
+(/root/reference/scripts/spartan/world.py:37-601): equal split, stall
+detection against the fastest backend, deferral of stalling backends,
+round-robin redistribution of deferred + remainder images under pixel caps,
+complementary "bonus" production in slack time, optional step scaling, and
+elastic shrink/grow per request as backends fail and reconnect.
+
+The orchestration differences are deliberate TPU redesigns:
+- jobs carry an explicit ``start_index`` into the request's global image
+  range, so merging is just concatenation in index order and every backend
+  reproduces its images seed-exactly (the reference re-derives this with
+  ``prior_images`` arithmetic at distributed.py:284-319);
+- a failed job's range is re-queued to surviving backends (the reference
+  simply drops those images, distributed.py:158-169).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    GenerationResult,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import config as config_mod
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+    State,
+    WorkerNode,
+)
+
+
+class Job:
+    """Work assigned to one backend (reference world.py:37-72)."""
+
+    def __init__(self, worker: WorkerNode, batch_size: int):
+        self.worker = worker
+        self.batch_size = batch_size
+        self.complementary = False
+        self.step_override: Optional[int] = None
+        self.start_index = 0          # global image index of this job's range
+        self.result: Optional[GenerationResult] = None
+        self.thread: Optional[threading.Thread] = None
+
+    def __str__(self):
+        prefix = "(complementary) " if self.complementary else ""
+        return (f"{prefix}Job: {self.batch_size} image(s) for "
+                f"'{self.worker.label}'")
+
+    def add_work(self, payload, batch_size: int = 1) -> bool:
+        """Grow the job if the pixel cap allows (world.py:62-72;
+        cap 0 = uncapped here vs the reference's -1)."""
+        if self.worker.pixel_cap <= 0:
+            self.batch_size += batch_size
+            return True
+        pixels = (self.batch_size + batch_size) * payload.width * payload.height
+        if pixels <= self.worker.pixel_cap:
+            self.batch_size += batch_size
+            return True
+        get_logger().debug("worker %s hit pixel cap (%d > %d)",
+                           self.worker.label, pixels, self.worker.pixel_cap)
+        return False
+
+
+class World:
+    """Backend registry + job planner + request executor."""
+
+    def __init__(self, cfg: Optional[config_mod.ConfigModel] = None,
+                 config_path: Optional[str] = None):
+        self.cfg = cfg or config_mod.ConfigModel()
+        self.config_path = config_path
+        self.workers: List[WorkerNode] = []
+        self.jobs: List[Job] = []
+        self.job_timeout: float = self.cfg.job_timeout
+        self.complement_production: bool = self.cfg.complement_production
+        self.step_scaling: bool = self.cfg.step_scaling
+        self.thin_client_mode = False
+        # checkpoint the fleet should be on; synced to non-master backends
+        # before each fan-out (reference option_payload per request,
+        # distributed.py:260-318 + worker.py:342-343)
+        self.current_model: str = self.cfg.default_model
+
+    # -- registry -----------------------------------------------------------
+
+    def add_worker(self, node: WorkerNode) -> WorkerNode:
+        self.workers.append(node)
+        return node
+
+    def get_worker(self, label: str) -> Optional[WorkerNode]:
+        for w in self.workers:
+            if w.label == label:
+                return w
+        return None
+
+    def get_workers(self) -> List[WorkerNode]:
+        """Schedulable backends (reference world.py:405-416): skips
+        UNAVAILABLE/DISABLED, invalid speeds, and the master in thin-client
+        mode — the world elastically shrinks per request."""
+        out = []
+        for w in self.workers:
+            if w.cal.avg_ipm is not None and w.cal.avg_ipm <= 0:
+                get_logger().warning(
+                    "invalid benchmarked speed for '%s'; re-benchmark", w.label)
+                continue
+            if w.master and self.thin_client_mode:
+                continue
+            if w.available:
+                out.append(w)
+        return out
+
+    def master(self) -> Optional[WorkerNode]:
+        for w in self.workers:
+            if w.master:
+                return w
+        return None
+
+    # -- planning -----------------------------------------------------------
+
+    def default_batch_size(self, total_images: int) -> int:
+        """Equal share per schedulable backend (world.py:111-115). May be 0
+        when there are more backends than images — the remainder phase then
+        places the images and zero-share jobs go complementary (the
+        reference's world.py:506-510 case)."""
+        n = max(1, len(self.get_workers()))
+        return total_images // n
+
+    def make_jobs(self, payload: GenerationPayload) -> List[Job]:
+        """Initial equal split (world.py:378-392)."""
+        self.jobs = []
+        share = self.default_batch_size(payload.total_images)
+        for w in self.get_workers():
+            if not w.cal.benchmarked:
+                w.benchmark()
+                if not w.cal.benchmarked:
+                    continue
+            self.jobs.append(Job(w, share))
+        return self.jobs
+
+    def realtime_jobs(self) -> List[Job]:
+        return [j for j in self.jobs
+                if j.worker.cal.benchmarked and not j.complementary]
+
+    def fastest_realtime_job(self) -> Job:
+        return max(self.realtime_jobs(), key=lambda j: j.worker.cal.avg_ipm)
+
+    def slowest_realtime_job(self) -> Job:
+        return min(self.realtime_jobs(), key=lambda j: j.worker.cal.avg_ipm)
+
+    def job_stall(self, worker: WorkerNode, payload,
+                  batch_size: Optional[int] = None) -> float:
+        """Extra wall-clock the gallery waits on ``worker`` vs the fastest
+        backend at equal share (world.py:363-376)."""
+        fastest = self.fastest_realtime_job().worker
+        if worker is fastest:
+            return 0.0
+        return (worker.eta(payload, batch_size=batch_size)
+                - fastest.eta(payload, batch_size=batch_size))
+
+    def optimize_jobs(self, payload: GenerationPayload) -> List[Job]:
+        """The five-phase policy (world.py:418-601), operating on the equal
+        split from :meth:`make_jobs`."""
+        log = get_logger()
+        share = self.default_batch_size(payload.total_images)
+        total = payload.total_images
+
+        # phase 1: stall detection — defer slow backends
+        deferred = 0
+        checked = 0
+        for job in self.jobs:
+            lag = self.job_stall(job.worker, payload, batch_size=share)
+            if lag < self.job_timeout or lag == 0:
+                job.batch_size = share
+                checked += share
+                continue
+            log.debug("worker '%s' would stall the gallery by ~%.2fs; "
+                      "deferring", job.worker.label, lag)
+            job.complementary = True
+            if deferred + checked + share <= total:
+                deferred += share
+            job.batch_size = 0
+
+        # phase 2: round-robin deferred images onto realtime jobs that can
+        # absorb them within the timeout + pixel cap (world.py:450-476)
+        if deferred > 0:
+            rt = [j for j in self.jobs if not j.complementary]
+            saturated: set = set()
+            i = 0
+            while deferred > 0 and rt and len(saturated) < len(rt):
+                job = rt[i % len(rt)]
+                i += 1
+                if id(job) in saturated:
+                    continue
+                stall = self.job_stall(job.worker, payload,
+                                       batch_size=job.batch_size + 1)
+                if stall < self.job_timeout and job.add_work(payload, 1):
+                    deferred -= 1
+                else:
+                    saturated.add(id(job))
+            if deferred > 0:
+                log.warning("could not redistribute %d deferred image(s)",
+                            deferred)
+
+        # phase 3: remainder round-robin, smallest jobs first (482-510)
+        assigned = sum(j.batch_size for j in self.jobs)
+        remainder = total - assigned
+        if remainder > 0:
+            rt = sorted(self.realtime_jobs(), key=lambda j: j.batch_size)
+            saturated = []
+            while remainder > 0 and rt and len(saturated) < len(rt):
+                for job in rt:
+                    if remainder < 1:
+                        break
+                    if job in saturated:
+                        continue
+                    if job.add_work(payload, 1):
+                        remainder -= 1
+                    else:
+                        saturated.append(job)
+        # a realtime job left with zero images is effectively complementary
+        for job in self.jobs:
+            if job.batch_size == 0:
+                job.complementary = True
+
+        # phase 4: complementary production in the slack window (519-557)
+        if self.complement_production and self.realtime_jobs():
+            fastest = self.fastest_realtime_job()
+            for job in self.jobs:
+                if not job.complementary or not job.worker.cal.benchmarked:
+                    continue
+                slack = fastest.worker.eta(
+                    payload, batch_size=max(1, fastest.batch_size)
+                ) + self.job_timeout
+                secs_per_image = job.worker.eta(payload, batch_size=1)
+                bonus = int(slack / secs_per_image)
+                log.debug("'%s': %d complementary image(s) = %.2fs slack / "
+                          "%.2fs per image", job.worker.label, bonus, slack,
+                          secs_per_image)
+                if bonus > 0:
+                    if not job.add_work(payload, bonus):
+                        # pixel-cap ceiling (world.py:540-543)
+                        per_image = payload.width * payload.height
+                        cap_images = (job.worker.pixel_cap // per_image
+                                      if job.worker.pixel_cap > 0 else 0)
+                        if cap_images > 0:
+                            job.add_work(payload, cap_images)
+                elif self.step_scaling:
+                    # one image at reduced steps (547-557)
+                    secs_per_sample = job.worker.eta(payload, batch_size=1,
+                                                     steps=1)
+                    realtime_samples = int(slack // secs_per_sample)
+                    if realtime_samples > 0:
+                        job.add_work(payload, 1)
+                        job.step_override = realtime_samples
+                        log.debug("'%s' downscaled to %d steps",
+                                  job.worker.label, realtime_samples)
+
+        # phase 5: drop empty jobs (597-601); keep ordering master-first
+        self.jobs = [j for j in self.jobs if j.batch_size > 0]
+
+        # assign contiguous global ranges: master (or first) job leads so
+        # local images land first in the gallery, like the reference's local
+        # batch preceding injected worker batches (distributed.py:110-181)
+        self.jobs.sort(key=lambda j: (not j.worker.master, j.worker.label))
+        start = 0
+        for job in self.jobs:
+            job.start_index = start
+            start += job.batch_size
+        return self.jobs
+
+    def plan(self, payload: GenerationPayload) -> List[Job]:
+        """make_jobs + optimize_jobs (reference update(), world.py:394-403)."""
+        self.make_jobs(payload)
+        if not self.jobs:
+            raise RuntimeError("no benchmarked, reachable backends")
+        return self.optimize_jobs(payload)
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, payload: GenerationPayload) -> GenerationResult:
+        """Plan, fan out, merge — the reference's request lifecycle
+        (distributed.py:185-357) without HTTP in the hot path for the local
+        backend. Failed jobs are re-queued to surviving backends (an
+        improvement over the reference, which drops those images —
+        SURVEY.md §5 failure handling)."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            fix_seed,
+        )
+
+        log = get_logger()
+        # resolve random seeds ONCE before fan-out so every backend derives
+        # the same contiguous per-image seed range (the reference fixes the
+        # seed before building per-worker payloads, distributed.py:252-254)
+        payload = payload.model_copy()
+        payload.seed = fix_seed(payload.seed)
+        payload.subseed = fix_seed(payload.subseed)
+        jobs = self.plan(payload)
+        summary = ", ".join(
+            f"{j.worker.label}:{j.batch_size}"
+            + ("*" if j.complementary else "") for j in jobs)
+        log.info("distributing %d image(s): %s", payload.total_images, summary)
+
+        for job in jobs:
+            job_payload = payload
+            if job.step_override is not None:
+                job_payload = payload.model_copy()
+                job_payload.steps = job.step_override
+            job.thread = threading.Thread(
+                target=self._run_job, args=(job, job_payload),
+                name=f"job-{job.worker.label}", daemon=True)
+            job.thread.start()
+
+        for job in jobs:
+            job.thread.join()
+
+        # re-queue failed ranges on surviving workers (elastic recovery)
+        failed = [j for j in jobs if j.result is None and not j.complementary]
+        for job in failed:
+            survivor = next(
+                (w for w in self.get_workers() if w is not job.worker), None)
+            if survivor is None:
+                log.error("no survivor to re-queue %d image(s) from '%s'",
+                          job.batch_size, job.worker.label)
+                continue
+            log.warning("re-queueing %d image(s) from failed '%s' to '%s'",
+                        job.batch_size, job.worker.label, survivor.label)
+            job.result = survivor.request(payload, job.start_index,
+                                          job.batch_size)
+            if job.result is not None:
+                job.worker = survivor  # attribute images to the producer
+
+        merged = GenerationResult(parameters=payload.model_dump())
+        for job in sorted(jobs, key=lambda j: j.start_index):
+            if job.result is None:
+                continue
+            r = job.result
+            r.worker_labels = [job.worker.label] * len(r.images)
+            # per-image worker attribution in infotext (the reference
+            # rewrites gallery infotexts the same way, distributed.py:343-349)
+            r.infotexts = [
+                f"{t}, Worker Label: {job.worker.label}" if t else t
+                for t in r.infotexts
+            ]
+            merged.extend(r)
+        self.save_config()
+        return merged
+
+    def _run_job(self, job: Job, payload: GenerationPayload) -> None:
+        # sync the loaded checkpoint before generating (the reference sends
+        # an option_payload with each request when the worker's cached model
+        # differs, worker.py:342-343,646-688); load_options no-ops when the
+        # cache matches and respects per-worker model_override
+        if self.current_model and not job.worker.master:
+            if not job.worker.load_options(self.current_model):
+                job.result = None
+                return
+        job.result = job.worker.request(payload, job.start_index,
+                                        job.batch_size)
+
+    # -- cluster ops --------------------------------------------------------
+
+    def ping_workers(self, indiscriminate: bool = False) -> Dict[str, bool]:
+        """Health sweep (world.py:724-778): demote unreachable backends,
+        revive reachable ones. ``indiscriminate`` probes DISABLED too."""
+        results: Dict[str, bool] = {}
+        threads = []
+
+        def probe(w: WorkerNode):
+            ok = w.reachable()
+            results[w.label] = ok
+            if ok:
+                if w.state == State.UNAVAILABLE:
+                    w.set_state(State.IDLE)
+            else:
+                w.set_state(State.UNAVAILABLE)
+
+        for w in self.workers:
+            if w.state == State.DISABLED and not indiscriminate:
+                continue
+            t = threading.Thread(target=probe, args=(w,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return results
+
+    def interrupt_all(self) -> None:
+        """Fan-out interrupt (world.py:173-179)."""
+        for w in self.workers:
+            if w.state == State.WORKING:
+                threading.Thread(target=w.interrupt, daemon=True).start()
+
+    def benchmark_all(self, rebenchmark: bool = False) -> Dict[str, float]:
+        """Benchmark every schedulable backend; remotes in parallel, master
+        serial (the reference's executor quirk at world.py:262-263 lands in
+        the same place: master synchronous, remotes threaded)."""
+        out: Dict[str, float] = {}
+        threads = []
+
+        def run(w: WorkerNode):
+            ipm = w.benchmark(rebenchmark)
+            if ipm:
+                out[w.label] = ipm
+
+        for w in self.get_workers():
+            if w.master:
+                run(w)
+            else:
+                t = threading.Thread(target=run, args=(w,), daemon=True)
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join()
+        self.save_config()
+        return out
+
+    def sync_models(self, model: str, vae: str = "") -> None:
+        """Checkpoint-change fan-out (world.py:784-811): push the new model
+        to every non-master backend without an override, in threads."""
+        threads = []
+        for w in self.workers:
+            if w.master or not w.available:
+                continue
+            t = threading.Thread(target=w.load_options, args=(model, vae),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    # -- persistence --------------------------------------------------------
+
+    def save_config(self) -> None:
+        """Write calibration back into the config model (world.py:705-722).
+
+        A master entry persisted earlier survives even when this World was
+        built without a local engine (status/ping runs) — otherwise those
+        commands would erase the master's calibration."""
+        worker_entries = []
+        if not any(w.master for w in self.workers):
+            for entry in self.cfg.workers:
+                for label, wm in entry.items():
+                    if wm.master:
+                        worker_entries.append({label: wm})
+        for w in self.workers:
+            model = config_mod.WorkerModel(
+                avg_ipm=w.cal.avg_ipm,
+                master=w.master,
+                eta_percent_error=list(w.cal.eta_percent_error),
+                pixel_cap=w.pixel_cap,
+                disabled=w.state == State.DISABLED,
+            )
+            # keep address/port/credentials when the backend is remote
+            backend = w.backend
+            if hasattr(backend, "address"):
+                model.address = backend.address
+                model.port = backend.port
+                model.tls = getattr(backend, "tls", False)
+                model.user = getattr(backend, "user", None)
+                model.password = getattr(backend, "password", None)
+            worker_entries.append({w.label: model})
+        self.cfg.workers = worker_entries
+        self.cfg.job_timeout = int(self.job_timeout)
+        self.cfg.complement_production = self.complement_production
+        self.cfg.step_scaling = self.step_scaling
+        if self.config_path:
+            config_mod.save_config(self.cfg, self.config_path)
+
+    def master_calibration(self) -> Optional[config_mod.WorkerModel]:
+        """The persisted master entry, if any (its calibration outlives the
+        process even though its LocalBackend cannot be serialized)."""
+        for entry in self.cfg.workers:
+            for _, wm in entry.items():
+                if wm.master:
+                    return wm
+        return None
+
+    @classmethod
+    def from_config(cls, cfg: config_mod.ConfigModel,
+                    config_path: Optional[str] = None,
+                    backend_factory=None,
+                    verify_tls: bool = True) -> "World":
+        """Rebuild a World from a persisted config: remote entries become
+        HTTP backends; calibration survives restarts (world.py:661-703).
+
+        Entries flagged ``master`` are NOT instantiated unless a
+        ``backend_factory`` is given — a master's backend is the in-process
+        engine, which the caller attaches itself (see cli._build_world);
+        resurrecting it as an HTTP backend would dial our own port.
+        """
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        world = cls(cfg, config_path)
+        for entry in cfg.workers:
+            for label, wm in entry.items():
+                if backend_factory is not None:
+                    backend = backend_factory(label, wm)
+                elif wm.master:
+                    continue  # caller attaches the local engine
+                else:
+                    backend = HTTPBackend(wm.address, wm.port, tls=wm.tls,
+                                          user=wm.user, password=wm.password,
+                                          verify_tls=verify_tls)
+                node = WorkerNode(
+                    label, backend, master=wm.master,
+                    pixel_cap=wm.pixel_cap, avg_ipm=wm.avg_ipm,
+                    eta_percent_error=wm.eta_percent_error,
+                    benchmark_payload=cfg.benchmark_payload,
+                )
+                if wm.disabled:
+                    node.state = State.DISABLED
+                world.add_worker(node)
+        return world

@@ -1,0 +1,14 @@
+"""sdapi-v1-compatible REST serving surface.
+
+The reference consumes this API from remote sdwui processes
+(/root/reference/scripts/spartan/worker.py:192-203: txt2img, img2img,
+options, memory, interrupt, progress, sd-models, script-info,
+refresh-checkpoints, server-restart). Exposing the same surface means (a) a
+legacy sdwui-distributed master can drive a TPU node of this framework
+unchanged, and (b) a pool of these servers can be scheduled by this
+framework's own World over DCN.
+"""
+
+from stable_diffusion_webui_distributed_tpu.server.api import (  # noqa: F401
+    ApiServer,
+)

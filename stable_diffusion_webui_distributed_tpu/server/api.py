@@ -1,0 +1,357 @@
+"""sdapi-v1 HTTP server on the stdlib ThreadingHTTPServer (no extra deps).
+
+Route surface mirrors what the reference consumes from each worker
+(/root/reference/scripts/spartan/worker.py:192-203) plus the webui response
+shapes it decodes (images as base64 PNG, ``info`` as a JSON-encoded string
+with ``all_seeds``/``infotexts`` — distributed.py:103-181). ``/memory``
+reports TPU HBM in both a native ``tpu`` section and the legacy
+``cuda.system`` shape the reference's VRAM probe reads (worker.py:322-340).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    GenerationResult,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import SAMPLERS
+
+
+class ApiServer:
+    """One generation node's REST surface.
+
+    ``source`` is whatever executes payloads: a ``World`` (distributed
+    fan-out) or anything with ``execute(payload) -> GenerationResult`` /
+    an ``Engine`` (single backend). Model switching goes through an optional
+    ``registry`` (see pipeline/registry.py).
+    """
+
+    def __init__(
+        self,
+        source,
+        registry=None,
+        state: Optional[interrupt_mod.GenerationState] = None,
+        host: str = "127.0.0.1",
+        port: int = 7860,
+        user: Optional[str] = None,
+        password: Optional[str] = None,
+    ):
+        self.source = source
+        self.registry = registry
+        self.state = state or getattr(source, "state", None) \
+            or interrupt_mod.STATE
+        self.host = host
+        self.port = port
+        self._auth = None
+        if user or password:
+            token = base64.b64encode(
+                f"{user or ''}:{password or ''}".encode()).decode()
+            self._auth = f"Basic {token}"
+        self.options: Dict[str, Any] = {
+            "sd_model_checkpoint": getattr(registry, "current_name", "") or
+            getattr(source, "model_name", ""),
+            "sd_vae": "Automatic",
+            "CLIP_stop_at_last_layers": 1,
+        }
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._busy = threading.Lock()
+        self.restart_requested = False
+
+    # -- request execution --------------------------------------------------
+
+    def _execute(self, payload: GenerationPayload) -> GenerationResult:
+        if hasattr(self.source, "execute"):
+            return self.source.execute(payload)
+        return self.source.generate_range(payload)  # Engine
+
+    def _generation_response(self, result: GenerationResult) -> Dict[str, Any]:
+        info = {
+            "all_seeds": result.seeds,
+            "all_subseeds": result.subseeds,
+            "all_prompts": result.prompts,
+            "all_negative_prompts": result.negative_prompts,
+            "infotexts": result.infotexts,
+            "seed": result.seeds[0] if result.seeds else -1,
+            "subseed": result.subseeds[0] if result.subseeds else -1,
+        }
+        return {
+            "images": result.images,
+            "parameters": result.parameters,
+            # webui encodes info as a JSON string; the reference re-parses it
+            "info": json.dumps(info),
+        }
+
+    # -- handlers ------------------------------------------------------------
+
+    def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        payload = GenerationPayload(**body)
+        with self._busy:
+            result = self._execute(payload)
+        return self._generation_response(result)
+
+    def handle_img2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        payload = GenerationPayload(**body)
+        if not payload.init_images:
+            raise ApiError(422, "img2img requires init_images")
+        with self._busy:
+            result = self._execute(payload)
+        return self._generation_response(result)
+
+    def handle_options_get(self) -> Dict[str, Any]:
+        return dict(self.options)
+
+    def handle_options_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        model = body.get("sd_model_checkpoint")
+        if model:
+            if self.registry is not None:
+                # blocking load, like webui's POST /options (the reference
+                # waits on it when syncing checkpoints, worker.py:646-688)
+                self.registry.activate(model)
+            self.options["sd_model_checkpoint"] = model
+            if hasattr(self.source, "sync_models"):
+                # checkpoint-change fan-out to the fleet (world.py:784-811)
+                self.source.current_model = model
+                self.source.sync_models(model)
+        for k, v in body.items():
+            if k != "sd_model_checkpoint":
+                self.options[k] = v
+        return {}
+
+    def handle_progress(self) -> Dict[str, Any]:
+        p = self.state.progress
+        eta = p.eta_seconds()
+        return {
+            "progress": p.fraction,
+            "eta_relative": eta if eta is not None else 0.0,
+            "state": {
+                "job": p.job,
+                "sampling_step": p.sampling_step,
+                "sampling_steps": p.sampling_steps,
+                "interrupted": p.interrupted,
+            },
+            "current_image": None,
+            "textinfo": None,
+        }
+
+    def handle_interrupt(self) -> Dict[str, Any]:
+        self.state.flag.interrupt()
+        if hasattr(self.source, "interrupt_all"):
+            self.source.interrupt_all()
+        return {}
+
+    def handle_sd_models(self) -> Any:
+        if self.registry is not None:
+            return [
+                {"title": name, "model_name": name,
+                 "filename": path, "hash": None, "sha256": None}
+                for name, path in self.registry.available().items()
+            ]
+        name = getattr(self.source, "model_name", "unknown")
+        return [{"title": name, "model_name": name, "filename": "",
+                 "hash": None, "sha256": None}]
+
+    def handle_samplers(self) -> Any:
+        return [{"name": n, "aliases": [], "options": {}} for n in SAMPLERS]
+
+    def handle_script_info(self) -> Any:
+        # no auxiliary scripts in this node — the reference uses this to
+        # filter per-worker script args (world.py:744-763); an empty list
+        # means "strip all alwayson scripts for this worker"
+        return []
+
+    def handle_refresh(self) -> Dict[str, Any]:
+        if self.registry is not None:
+            self.registry.refresh()
+        return {}
+
+    def handle_server_restart(self) -> Dict[str, Any]:
+        # the reference's /server-restart relaunches the webui process
+        # (worker.py:690-717); here we flag the host process to re-exec
+        self.restart_requested = True
+        threading.Thread(target=self._shutdown_later, daemon=True).start()
+        return {}
+
+    def _shutdown_later(self):
+        time.sleep(0.2)
+        self.stop()
+
+    # -- memory (real implementation) ---------------------------------------
+
+    def _memory(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        try:
+            with open("/proc/meminfo") as f:
+                mem = {l.split(":")[0]: int(l.split()[1]) * 1024
+                       for l in f if ":" in l}
+            total = mem.get("MemTotal", 0)
+            free = mem.get("MemAvailable", 0)
+            out["ram"] = {"free": free, "used": total - free, "total": total}
+        except OSError:
+            out["ram"] = {}
+        hbm_free = hbm_total = 0
+        try:
+            import jax
+
+            devs = []
+            for d in jax.devices():
+                stats = {}
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:  # noqa: BLE001
+                    pass
+                in_use = stats.get("bytes_in_use", 0)
+                limit = stats.get("bytes_limit", 0)
+                hbm_free += max(0, limit - in_use)
+                hbm_total += limit
+                devs.append({"id": d.id, "kind": d.device_kind,
+                             "bytes_in_use": in_use, "bytes_limit": limit})
+            out["tpu"] = {"devices": devs}
+        except Exception:  # noqa: BLE001
+            out["tpu"] = {"devices": []}
+        # legacy shape the reference's VRAM probe reads (worker.py:322-340)
+        out["cuda"] = {"system": {"free": hbm_free, "used":
+                                  hbm_total - hbm_free, "total": hbm_total}}
+        return out
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    def routes(self) -> Dict[Tuple[str, str], Callable]:
+        return {
+            ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,
+            ("POST", "/sdapi/v1/img2img"): self.handle_img2img,
+            ("GET", "/sdapi/v1/options"): self.handle_options_get,
+            ("POST", "/sdapi/v1/options"): self.handle_options_post,
+            ("GET", "/sdapi/v1/progress"): self.handle_progress,
+            ("POST", "/sdapi/v1/interrupt"): self.handle_interrupt,
+            ("GET", "/sdapi/v1/memory"): self._memory,
+            ("GET", "/sdapi/v1/sd-models"): self.handle_sd_models,
+            ("GET", "/sdapi/v1/samplers"): self.handle_samplers,
+            ("GET", "/sdapi/v1/script-info"): self.handle_script_info,
+            ("POST", "/sdapi/v1/refresh-checkpoints"): self.handle_refresh,
+            ("POST", "/sdapi/v1/refresh-loras"): self.handle_refresh,
+            ("POST", "/sdapi/v1/server-restart"): self.handle_server_restart,
+        }
+
+    def make_handler(self):
+        server = self
+        routes = self.routes()
+        log = get_logger()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to our logger
+                log.debug("http: " + fmt, *args)
+
+            def _check_auth(self) -> bool:
+                if server._auth is None:
+                    return True
+                if self.headers.get("Authorization") == server._auth:
+                    return True
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Basic")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return False
+
+            def _dispatch(self, method: str):
+                if not self._check_auth():
+                    return
+                key = (method, self.path.split("?")[0].rstrip("/"))
+                fn = routes.get(key)
+                if fn is None:
+                    self._send(404, {"detail": "Not Found"})
+                    return
+                try:
+                    if method == "POST":
+                        length = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(length) if length else b"{}"
+                        body = json.loads(raw or b"{}")
+                        result = fn(body) if fn.__code__.co_argcount > 1 \
+                            else fn()
+                    else:
+                        result = fn()
+                    self._send(200, result if result is not None else {})
+                except ApiError as e:
+                    self._send(e.status, {"detail": e.detail})
+                except Exception as e:  # noqa: BLE001
+                    log.error("api error on %s %s: %s", method, self.path, e)
+                    self._send(500, {"detail": str(e)})
+
+            def _send(self, status: int, obj: Any):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        return Handler
+
+    def start(self) -> "ApiServer":
+        """Serve in a daemon thread; returns self when the port is bound."""
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self.make_handler())
+        self.port = self._httpd.server_port  # resolves port 0
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="sdapi-server", daemon=True)
+        t.start()
+        get_logger().info("sdapi server on %s:%d", self.host, self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        """Blocking serve with SIGINT/SIGTERM cleanup (the reference chains
+        handlers that save config before exiting, distributed.py:359-375)."""
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self.make_handler())
+        self.port = self._httpd.server_port
+        previous = {}
+
+        def on_signal(signum, frame):
+            get_logger().info("signal %d: saving config and shutting down",
+                              signum)
+            if hasattr(self.source, "save_config"):
+                try:
+                    self.source.save_config()
+                except Exception:  # noqa: BLE001
+                    pass
+            threading.Thread(target=self._httpd.shutdown,
+                             daemon=True).start()
+            prev = previous.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            previous[sig] = signal.getsignal(sig)
+            signal.signal(sig, on_signal)
+        get_logger().info("sdapi server on %s:%d", self.host, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail

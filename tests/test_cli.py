@@ -1,0 +1,52 @@
+"""CLI tests for the config-only commands (generate/serve need checkpoints)."""
+
+import json
+import os
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu import cli
+
+
+def run(argv, capsys):
+    code = cli.main(argv)
+    return code, capsys.readouterr().out
+
+
+class TestWorkersCrud:
+    def test_add_list_remove(self, tmp_path, capsys, monkeypatch):
+        cfg = str(tmp_path / "cfg.json")
+        base = ["--distributed-config", cfg]
+        code, _ = run(base + ["workers", "add", "--label", "gpu1",
+                              "--address", "10.0.0.5", "--api-port", "7861",
+                              "--pixel-cap", "2097152"], capsys)
+        assert code == 0
+        code, out = run(base + ["workers", "list"], capsys)
+        assert code == 0 and "gpu1" in out and "10.0.0.5:7861" in out
+        raw = json.load(open(cfg))
+        assert raw["workers"][0]["gpu1"]["pixel_cap"] == 2097152
+        code, out = run(base + ["workers", "remove", "--label", "gpu1"],
+                        capsys)
+        assert code == 0
+        code, out = run(base + ["workers", "list"], capsys)
+        assert "gpu1" not in out
+
+    def test_add_replaces_same_label(self, tmp_path, capsys):
+        cfg = str(tmp_path / "cfg.json")
+        base = ["--distributed-config", cfg]
+        run(base + ["workers", "add", "--label", "a", "--address", "h1"],
+            capsys)
+        run(base + ["workers", "add", "--label", "a", "--address", "h2"],
+            capsys)
+        raw = json.load(open(cfg))
+        assert len(raw["workers"]) == 1
+        assert raw["workers"][0]["a"]["address"] == "h2"
+
+
+class TestStatus:
+    def test_status_empty(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        cfg = str(tmp_path / "cfg.json")
+        code, out = run(["--distributed-config", cfg, "status"], capsys)
+        assert code == 0
+        assert "models:" in out

@@ -1,0 +1,343 @@
+"""Scheduler tests: ETA model, state machine, five optimizer phases,
+request fan-out/merge, elastic failure handling — all against stub backends
+(SURVEY.md §4: the reference has no tests; this is the designed-from-scratch
+strategy for its scheduling policy, /root/reference/scripts/spartan/
+world.py:325-601, worker.py:36-41,176-286,719-758)."""
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    BenchmarkPayload, ConfigModel, WorkerModel,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler import eta as eta_mod
+from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+    EtaCalibration, predict_eta, record_eta_error,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+    State, StubBackend, StubBehavior, WorkerNode,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.world import Job, World
+
+
+def node(label, ipm, master=False, pixel_cap=0, behavior=None):
+    return WorkerNode(label, StubBackend(behavior), master=master,
+                      pixel_cap=pixel_cap, avg_ipm=ipm)
+
+
+def payload(**kw):
+    defaults = dict(prompt="p", steps=20, width=512, height=512,
+                    batch_size=4, seed=10)
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+class TestEta:
+    def test_base_formula(self):
+        cal = EtaCalibration(avg_ipm=6.0)  # 10 s per benchmark image
+        p = payload(batch_size=2, steps=20, width=512, height=512)
+        # 2 images at 6 ipm = 20 s; same steps/pixels as benchmark payload
+        assert predict_eta(cal, p) == pytest.approx(20.0)
+
+    def test_step_and_pixel_scaling(self):
+        cal = EtaCalibration(avg_ipm=6.0)
+        p = payload(batch_size=1, steps=40, width=1024, height=512)
+        # 10 s * (40/20 steps) * (2x pixels) = 40 s
+        assert predict_eta(cal, p) == pytest.approx(40.0)
+
+    def test_sampler_table(self):
+        cal = EtaCalibration(avg_ipm=6.0)
+        base = predict_eta(cal, payload(batch_size=1))
+        faster = predict_eta(
+            cal, payload(batch_size=1, sampler_name="DPM++ 2M Karras"))
+        slower = predict_eta(cal, payload(batch_size=1, sampler_name="Heun"))
+        # +16.20% faster, -40.24% slower (reference worker.py:75-94)
+        assert faster == pytest.approx(base * (1 - 0.1620))
+        assert slower == pytest.approx(base * (1 + 0.4024))
+        unknown = predict_eta(
+            cal, payload(batch_size=1, sampler_name="Mystery Sampler"))
+        assert unknown == pytest.approx(base)  # treated as Euler a
+
+    def test_hires_pseudo_pass(self):
+        cal = EtaCalibration(avg_ipm=6.0)
+        plain = predict_eta(cal, payload(batch_size=1))
+        hr = predict_eta(cal, payload(batch_size=1, enable_hr=True,
+                                      hr_scale=2.0))
+        # second pass at 4x pixels: base*(1 + 4) then *1 pixel ratio
+        assert hr == pytest.approx(plain * 5.0)
+
+    def test_mpe_correction_and_rejection(self):
+        cal = EtaCalibration(avg_ipm=6.0)
+        p = payload(batch_size=1)
+        base = predict_eta(cal, p)
+        record_eta_error(cal, predicted=12.0, actual=10.0)  # +20% error
+        corrected = predict_eta(cal, p)
+        assert corrected == pytest.approx(base * 0.8)
+        # |error| >= 500% rejected (worker.py:483-490)
+        record_eta_error(cal, predicted=100.0, actual=1.0)
+        assert len(cal.eta_percent_error) == 1
+        # window caps at 5
+        for _ in range(10):
+            record_eta_error(cal, predicted=11.0, actual=10.0)
+        assert len(cal.eta_percent_error) == eta_mod.MPE_WINDOW
+
+    def test_unbenchmarked_raises(self):
+        with pytest.raises(ValueError):
+            predict_eta(EtaCalibration(), payload())
+
+
+class TestStateMachine:
+    def test_happy_path(self):
+        w = node("w", 10.0)
+        assert w.set_state(State.WORKING)
+        assert w.set_state(State.INTERRUPTED)
+        assert w.set_state(State.WORKING)
+        assert w.set_state(State.IDLE)
+
+    def test_invalid_transition_refused(self):
+        w = node("w", 10.0)
+        assert not w.set_state(State.INTERRUPTED)  # IDLE -> INTERRUPTED
+        assert w.state == State.IDLE
+
+    def test_unavailable_invalidates_model_cache(self):
+        w = node("w", 10.0)
+        w.loaded_model = "m"
+        w.loaded_vae = "v"
+        w.set_state(State.UNAVAILABLE)
+        assert w.loaded_model is None and w.loaded_vae is None
+        # reconnect path: UNAVAILABLE -> IDLE forces re-sync
+        assert w.set_state(State.IDLE)
+        assert w.load_options("m2")
+        assert w.backend.options["model"] == "m2"
+
+    def test_disabled_refuses_unavailable(self):
+        w = node("w", 10.0)
+        w.state = State.DISABLED
+        assert not w.set_state(State.UNAVAILABLE)
+        assert w.state == State.DISABLED
+
+
+class TestJobPixelCap:
+    def test_uncapped(self):
+        j = Job(node("w", 10.0, pixel_cap=0), 1)
+        assert j.add_work(payload(), 100)
+
+    def test_cap_blocks(self):
+        # cap allows exactly 2 images at 512x512
+        j = Job(node("w", 10.0, pixel_cap=2 * 512 * 512), 1)
+        p = payload()
+        assert j.add_work(p, 1)       # 2 images: at cap
+        assert not j.add_work(p, 1)   # 3rd refused
+        assert j.batch_size == 2
+
+
+class TestOptimizer:
+    def make_world(self, *nodes):
+        w = World(ConfigModel())
+        for n in nodes:
+            w.add_worker(n)
+        return w
+
+    def test_equal_split_even(self):
+        w = self.make_world(node("m", 10.0, master=True), node("a", 10.0))
+        jobs = w.plan(payload(batch_size=4))
+        assert [j.batch_size for j in jobs] == [2, 2]
+        assert jobs[0].worker.master  # master leads the gallery
+        assert [j.start_index for j in jobs] == [0, 2]
+
+    def test_remainder_round_robin(self):
+        w = self.make_world(node("m", 10.0, master=True), node("a", 10.0),
+                            node("b", 10.0))
+        jobs = w.plan(payload(batch_size=5))
+        assert sum(j.batch_size for j in jobs) == 5
+        sizes = sorted(j.batch_size for j in jobs)
+        assert sizes == [1, 2, 2]
+
+    def test_more_workers_than_images(self):
+        w = self.make_world(node("m", 10.0, master=True), node("a", 10.0),
+                            node("b", 10.0))
+        jobs = w.plan(payload(batch_size=2))
+        # reference world.py:506-510: trailing zero-share jobs dropped or
+        # complementary; exactly 2 images land
+        assert sum(j.batch_size for j in jobs if not j.complementary) == 2
+
+    def test_slow_worker_deferred_and_redistributed(self):
+        w = self.make_world(node("m", 60.0, master=True), node("slow", 1.0))
+        w.complement_production = False
+        # share=2 each; slow worker: 2 img at 1 ipm = 120 s vs 2 s -> stall
+        jobs = w.plan(payload(batch_size=4))
+        by_label = {j.worker.label: j for j in jobs}
+        assert "slow" not in by_label  # deferred, no complementary work
+        assert by_label["m"].batch_size == 4  # absorbed both deferred images
+
+    def test_complementary_production(self):
+        w = self.make_world(node("m", 60.0, master=True), node("slow", 6.0))
+        w.job_timeout = 3
+        w.complement_production = True
+        # share=4: slow eta=40s vs fast ~4s -> defer; slack = 4+3 = 7s;
+        # slow does 10s/image -> 0 bonus images... use slightly faster slow
+        w2 = self.make_world(node("m", 60.0, master=True), node("s2", 30.0))
+        w2.job_timeout = 3
+        jobs = w2.plan(payload(batch_size=8))
+        # s2: 4 img at 30ipm = 8s vs master 4s -> lag 4 > 3 -> deferred;
+        # slack = master eta(absorbed batch) + 3; s2 2s/img -> bonus > 0
+        comp = [j for j in jobs if j.complementary]
+        assert comp and comp[0].worker.label == "s2"
+        assert comp[0].batch_size >= 1
+
+    def test_step_scaling(self):
+        w = self.make_world(node("m", 60.0, master=True),
+                            node("crawl", 0.5))
+        w.job_timeout = 3
+        w.step_scaling = True
+        jobs = w.plan(payload(batch_size=4))
+        comp = [j for j in jobs if j.complementary]
+        # crawl: 120 s/image, slack ~7 s -> 0 bonus images; step scaling
+        # gives it 1 image at reduced steps (reference world.py:547-557)
+        assert comp and comp[0].step_override is not None
+        assert 0 < comp[0].step_override < 20
+
+    def test_unavailable_worker_excluded(self):
+        a, b = node("m", 10.0, master=True), node("b", 10.0)
+        w = self.make_world(a, b)
+        b.set_state(State.UNAVAILABLE)
+        jobs = w.plan(payload(batch_size=4))
+        assert len(jobs) == 1 and jobs[0].worker is a
+        assert jobs[0].batch_size == 4
+
+
+class TestExecute:
+    def test_merge_order_and_seed_continuity(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("a", 10.0))
+        r = w.execute(payload(batch_size=4, seed=100))
+        assert len(r.images) == 4
+        # global order: images [0..4) in seed order regardless of worker
+        assert r.seeds == [100, 101, 102, 103]
+        assert r.images == [f"stub-image-{s}" for s in r.seeds]
+        # worker attribution in infotext (distributed.py:343-349)
+        assert "Worker Label: m" in r.infotexts[0]
+        assert "Worker Label: a" in r.infotexts[-1]
+
+    def test_failed_worker_requeued(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        bad = node("bad", 10.0,
+                   behavior=StubBehavior(fail_after_n_requests=0))
+        w.add_worker(bad)
+        r = w.execute(payload(batch_size=4, seed=100))
+        # bad's 2 images re-queued on m: full gallery still delivered
+        assert len(r.images) == 4
+        assert r.seeds == [100, 101, 102, 103]
+        assert bad.state == State.UNAVAILABLE
+
+    def test_ping_revives_and_demotes(self):
+        w = World(ConfigModel())
+        good = node("good", 10.0)
+        flaky = node("flaky", 10.0,
+                     behavior=StubBehavior(fail_reachable=True))
+        w.add_worker(good)
+        w.add_worker(flaky)
+        res = w.ping_workers()
+        assert res == {"good": True, "flaky": False}
+        assert flaky.state == State.UNAVAILABLE
+        flaky.backend.behavior.fail_reachable = False
+        res = w.ping_workers()
+        assert res["flaky"] is True
+        assert flaky.state == State.IDLE
+
+
+class TestBenchmark:
+    def test_stub_benchmark_records_ipm(self):
+        w = node("w", None)
+        assert not w.cal.benchmarked
+        ipm = w.benchmark()
+        assert ipm and ipm > 0
+        assert len(w.backend.requests) == 5  # 2 warmup + 3 recorded
+
+    def test_benchmark_cached_unless_rebenchmark(self):
+        w = node("w", 12.0)
+        assert w.benchmark() == 12.0
+        assert len(w.backend.requests) == 0  # cached, no generation
+
+    def test_world_roundtrip_via_config(self, tmp_path):
+        w = World(ConfigModel(), str(tmp_path / "cfg.json"))
+        n = node("m", 42.0, master=True)
+        n.cal.eta_percent_error = [1.0, -2.0]
+        w.add_worker(n)
+        w.save_config()
+        cfg = ConfigModel(**w.cfg.model_dump())
+        w2 = World.from_config(
+            cfg, backend_factory=lambda label, wm: StubBackend())
+        m = w2.get_worker("m")
+        assert m.cal.avg_ipm == 42.0
+        assert m.cal.eta_percent_error == [1.0, -2.0]
+        assert m.master
+
+    def test_master_not_resurrected_as_http(self):
+        """A persisted master entry must NOT come back as an HTTP worker
+        dialing our own port; its calibration is still readable."""
+        cfg = ConfigModel(workers=[
+            {"master": WorkerModel(master=True, avg_ipm=30.0)},
+            {"r1": WorkerModel(address="10.0.0.9", port=7861, avg_ipm=5.0)},
+        ])
+        w = World.from_config(cfg)
+        assert w.get_worker("master") is None
+        assert w.get_worker("r1") is not None
+        assert w.master_calibration().avg_ipm == 30.0
+
+    def test_save_config_keeps_credentials(self, tmp_path):
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        w = World(ConfigModel(), str(tmp_path / "cfg.json"))
+        backend = HTTPBackend("10.0.0.2", 7861, user="u", password="secret")
+        w.add_worker(WorkerNode("r", backend, avg_ipm=8.0))
+        w.save_config()
+        wm = w.cfg.workers[0]["r"]
+        assert (wm.user, wm.password) == ("u", "secret")
+        assert (wm.address, wm.port) == ("10.0.0.2", 7861)
+
+    def test_model_synced_to_remotes_before_fanout(self):
+        """The reference pushes the checkpoint with each request when the
+        worker's cache differs (worker.py:342-343); execute() must do the
+        same for non-master backends."""
+        w = World(ConfigModel())
+        w.current_model = "modelB"
+        w.add_worker(node("m", 10.0, master=True))
+        remote = node("r", 10.0)
+        w.add_worker(remote)
+        r = w.execute(payload(batch_size=4, seed=1))
+        assert len(r.images) == 4
+        assert remote.backend.options == {"model": "modelB", "vae": ""}
+        assert remote.loaded_model == "modelB"
+        # second request: cache hit, no re-send
+        remote.backend.options = {}
+        w.execute(payload(batch_size=2, seed=2))
+        assert remote.backend.options == {}
+
+    def test_save_config_preserves_persisted_master(self, tmp_path):
+        """ping/status Worlds have no master worker; saving must not erase
+        the master's persisted calibration."""
+        cfg = ConfigModel(workers=[
+            {"master": WorkerModel(master=True, avg_ipm=33.0)},
+            {"r1": WorkerModel(address="10.0.0.9", avg_ipm=5.0)},
+        ])
+        w = World.from_config(cfg, backend_factory=None)
+        w.save_config()
+        masters = [e for e in w.cfg.workers if "master" in e]
+        assert masters and masters[0]["master"].avg_ipm == 33.0
+
+    def test_execute_resolves_random_seed_once(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("a", 10.0))
+        r = w.execute(payload(batch_size=4, seed=-1))
+        # one coherent contiguous range across both workers
+        base = r.seeds[0]
+        assert base != -1
+        assert r.seeds == [base, base + 1, base + 2, base + 3]
