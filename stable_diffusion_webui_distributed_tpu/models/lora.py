@@ -1,0 +1,307 @@
+"""LoRA adapters: kohya-format safetensors merged into Flax param trees.
+
+The reference never touches LoRA math — each sdwui worker applies adapters
+itself from the ``<lora:name:weight>`` prompt syntax, and the reference only
+fans out ``/refresh-loras`` so workers re-scan their directories
+(/root/reference/scripts/spartan/worker.py:577-581). Here the framework owns
+the application: adapters are merged into the (already converted) Flax
+params as ``W += weight * (alpha/rank) * up @ down``. Merging happens on
+request boundaries host-side; the jitted graph sees ordinary params, so
+switching adapters never retriggers compilation (params are inputs, not
+constants — SURVEY.md §7 hard part #2).
+
+Key format (kohya sd-scripts, the webui ecosystem standard):
+``lora_unet_<ldm_module_path_with_underscores>.{lora_up,lora_down}.weight``
++ ``.alpha``; text encoder under ``lora_te_`` (``lora_te1_``/``lora_te2_``
+for SDXL's two encoders).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.models.configs import (
+    ModelFamily,
+    UNetConfig,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+
+Array = np.ndarray
+
+
+def load_lora(path: str) -> Dict[str, Array]:
+    from stable_diffusion_webui_distributed_tpu.models.convert import (
+        load_safetensors,
+    )
+
+    return load_safetensors(path)
+
+
+def group_lora(sd: Dict[str, Array]) -> Dict[str, Dict[str, Array]]:
+    """{module_key: {"up": .., "down": .., "alpha": ..}}."""
+    groups: Dict[str, Dict[str, Array]] = {}
+    for key, value in sd.items():
+        if "." not in key:
+            continue
+        module, _, leaf = key.partition(".")
+        g = groups.setdefault(module, {})
+        if leaf.startswith("lora_up"):
+            g["up"] = value
+        elif leaf.startswith("lora_down"):
+            g["down"] = value
+        elif leaf == "alpha":
+            g["alpha"] = value
+    return groups
+
+
+# --------------------------------------------------------------------------
+# kohya module key -> (my param path, fused column slice)
+# --------------------------------------------------------------------------
+
+def _unet_block_index_maps(cfg: UNetConfig):
+    """Replay ldm input/output block numbering (same walk as convert.py) to
+    map block numbers -> my module names."""
+    levels = list(zip(cfg.block_out_channels, cfg.down_blocks))
+    in_map: Dict[int, str] = {}
+    n = 1
+    for level, (_, depth) in enumerate(levels):
+        for i in range(cfg.layers_per_block):
+            if depth is not None:
+                in_map[n] = f"down_{level}_attn_{i}"
+            n += 1
+        if level < len(levels) - 1:
+            n += 1  # downsample block: no attention
+    out_map: Dict[int, str] = {}
+    n = 0
+    for level in reversed(range(len(levels))):
+        _, depth = levels[level]
+        for i in range(cfg.layers_per_block + 1):
+            if depth is not None:
+                out_map[n] = f"up_{level}_attn_{i}"
+            n += 1
+    return in_map, out_map
+
+
+#: leaf name inside a transformer block -> (my path suffix, fused slot)
+#: fused slot: (index, of) into the fused kernel's output columns
+_ATTN_LEAVES = {
+    "attn1_to_q": ("attn1/qkv", (0, 3)),
+    "attn1_to_k": ("attn1/qkv", (1, 3)),
+    "attn1_to_v": ("attn1/qkv", (2, 3)),
+    "attn1_to_out_0": ("attn1/out_proj", None),
+    "attn2_to_q": ("attn2/q", None),
+    "attn2_to_k": ("attn2/kv", (0, 2)),
+    "attn2_to_v": ("attn2/kv", (1, 2)),
+    "attn2_to_out_0": ("attn2/out_proj", None),
+    "ff_net_0_proj": ("geglu/proj", None),
+    "ff_net_2": ("ff_out", None),
+}
+
+
+def _resolve_unet_key(module: str, cfg: UNetConfig
+                      ) -> Optional[Tuple[List[str], Optional[Tuple[int, int]]]]:
+    """kohya unet module key -> (path into my unet params, fused slot)."""
+    in_map, out_map = _unet_block_index_maps(cfg)
+
+    m = re.match(r"lora_unet_input_blocks_(\d+)_1_(.+)", module)
+    base = None
+    if m:
+        base = in_map.get(int(m.group(1)))
+        rest = m.group(2)
+    else:
+        m = re.match(r"lora_unet_output_blocks_(\d+)_1_(.+)", module)
+        if m:
+            base = out_map.get(int(m.group(1)))
+            rest = m.group(2)
+        else:
+            m = re.match(r"lora_unet_middle_block_1_(.+)", module)
+            if m:
+                base = "mid_attn"
+                rest = m.group(1)
+    if base is None:
+        return None
+
+    if rest == "proj_in":
+        return [base, "proj_in"], None
+    if rest == "proj_out":
+        return [base, "proj_out"], None
+    m = re.match(r"transformer_blocks_(\d+)_(.+)", rest)
+    if not m:
+        return None
+    block = f"block_{m.group(1)}"
+    leaf = _ATTN_LEAVES.get(m.group(2))
+    if leaf is None:
+        return None
+    suffix, slot = leaf
+    return [base, block, *suffix.split("/")], slot
+
+
+def _resolve_te_key(module: str, prefix: str
+                    ) -> Optional[Tuple[List[str], Optional[Tuple[int, int]]]]:
+    """kohya text-encoder module key -> path into my CLIP params."""
+    m = re.match(
+        rf"{prefix}_text_model_encoder_layers_(\d+)_(.+)", module)
+    if not m:
+        return None
+    layer = f"layer_{m.group(1)}"
+    rest = m.group(2)
+    table = {
+        "self_attn_q_proj": (["attn", "qkv"], (0, 3)),
+        "self_attn_k_proj": (["attn", "qkv"], (1, 3)),
+        "self_attn_v_proj": (["attn", "qkv"], (2, 3)),
+        "self_attn_out_proj": (["attn", "out_proj"], None),
+        "mlp_fc1": (["fc1"], None),
+        "mlp_fc2": (["fc2"], None),
+    }
+    hit = table.get(rest)
+    if hit is None:
+        return None
+    path, slot = hit
+    return [layer, *path], slot
+
+
+def _delta(g: Dict[str, Array]) -> Optional[Array]:
+    """up @ down * alpha/rank, in torch (O, I) orientation."""
+    up, down = g.get("up"), g.get("down")
+    if up is None or down is None:
+        return None
+    if up.ndim == 4:  # 1x1 conv LoRA
+        up = up[:, :, 0, 0]
+    if down.ndim == 4:
+        if down.shape[2:] != (1, 1):
+            return None  # 3x3 conv (LoCon) unsupported for now
+        down = down[:, :, 0, 0]
+    rank = down.shape[0]
+    alpha = float(g["alpha"]) if "alpha" in g else float(rank)
+    return (up @ down) * (alpha / rank)
+
+
+def merge_lora(
+    params: Dict,
+    lora_sd: Dict[str, Array],
+    weight: float,
+    family: ModelFamily,
+    te_weight: Optional[float] = None,
+) -> Tuple[Dict, int, int]:
+    """Return a new params dict with the adapter merged at ``weight``.
+
+    ``te_weight`` optionally scales text-encoder modules differently
+    (webui's ``<lora:name:unet_w:te_w>`` dual-multiplier form); defaults to
+    ``weight``. ``params`` is the engine's component dict ({"unet": ..,
+    "text_encoder": .., ...}). Only touched leaves are re-allocated;
+    everything else is shared. Returns (new_params, applied, skipped).
+    """
+    import jax.numpy as jnp
+
+    if te_weight is None:
+        te_weight = weight
+    groups = group_lora(lora_sd)
+    applied = skipped = 0
+    out = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in params.items()}
+
+    def patch(component: str, path: List[str],
+              slot: Optional[Tuple[int, int]], delta: Array) -> bool:
+        w = te_weight if component.startswith("text_encoder") else weight
+        tree = out.get(component)
+        if tree is None:
+            return False
+        # copy-on-write walk to the leaf dict
+        node = tree
+        for part in path[:-1]:
+            child = node.get(part)
+            if child is None:
+                return False
+            child = dict(child)
+            node[part] = child
+            node = child
+        leaf = node.get(path[-1])
+        if leaf is None or "kernel" not in leaf:
+            return False
+        kernel = leaf["kernel"]
+        dk = jnp.asarray(delta.T, kernel.dtype) * w  # (I, O_sub)
+        if slot is not None:
+            idx, of = slot
+            cols = kernel.shape[-1] // of
+            if dk.shape != (kernel.shape[0], cols):
+                return False
+            start = idx * cols
+            kernel = kernel.at[:, start:start + cols].add(dk)
+        else:
+            if dk.shape != kernel.shape:
+                return False
+            kernel = kernel + dk
+        node[path[-1]] = {**leaf, "kernel": kernel}
+        return True
+
+    for module, g in groups.items():
+        delta = _delta(g)
+        if delta is None:
+            skipped += 1
+            continue
+        resolved = None
+        if module.startswith("lora_unet_"):
+            r = _resolve_unet_key(module, family.unet)
+            if r:
+                resolved = ("unet", *r)
+        elif module.startswith("lora_te1_"):
+            r = _resolve_te_key(module, "lora_te1")
+            if r:
+                resolved = ("text_encoder", *r)
+        elif module.startswith("lora_te2_"):
+            r = _resolve_te_key(module, "lora_te2")
+            if r:
+                resolved = ("text_encoder_2", *r)
+        elif module.startswith("lora_te_"):
+            r = _resolve_te_key(module, "lora_te")
+            if r:
+                resolved = ("text_encoder", *r)
+        if resolved is None:
+            skipped += 1
+            continue
+        component, path, slot = resolved
+        if patch(component, path, slot, delta):
+            applied += 1
+        else:
+            skipped += 1
+
+    if skipped:
+        get_logger().debug("lora: %d module(s) applied, %d skipped",
+                           applied, skipped)
+    return out, applied, skipped
+
+
+# --------------------------------------------------------------------------
+# prompt syntax
+# --------------------------------------------------------------------------
+
+_LORA_TAG = re.compile(
+    r"<lora:([^:>]+)(?::([0-9.+-]+))?(?::([0-9.+-]+))?>")
+
+
+def extract_lora_tags(prompt: str
+                      ) -> Tuple[str, List[Tuple[str, float, float]]]:
+    """Strip webui ``<lora:name[:weight[:te_weight]]>`` extra-network tags.
+
+    Returns (clean_prompt, [(name, unet_weight, te_weight), ...]). A single
+    weight applies to both; omitted weights default to 1.0.
+    """
+    tags: List[Tuple[str, float, float]] = []
+
+    def keep(m: re.Match) -> str:
+        def num(g, default):
+            try:
+                return float(g) if g else default
+            except ValueError:
+                return default
+
+        w = num(m.group(2), 1.0)
+        te_w = num(m.group(3), w)
+        tags.append((m.group(1), w, te_w))
+        return ""
+
+    clean = _LORA_TAG.sub(keep, prompt)
+    return re.sub(r"\s{2,}", " ", clean).strip(), tags

@@ -203,6 +203,7 @@ class UNet(nn.Module):
         timesteps: jax.Array,
         context: jax.Array,
         added_cond: Optional[jax.Array] = None,
+        control_residuals: Optional[Tuple[jax.Array, ...]] = None,
     ) -> jax.Array:
         c = self.cfg
         ch0 = c.block_out_channels[0]
@@ -251,6 +252,18 @@ class UNet(nn.Module):
                 c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
                 self.dtype, name="mid_attn")(x, context)
         x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
+
+        # ControlNet residual injection: one residual per skip + one for the
+        # mid block output (the standard ControlNet contract; the reference
+        # only serializes the conditioning payload, control_net.py:20-79 —
+        # the math lives here).
+        if control_residuals is not None:
+            assert len(control_residuals) == len(skips) + 1, (
+                f"expected {len(skips) + 1} control residuals, "
+                f"got {len(control_residuals)}")
+            x = x + control_residuals[-1].astype(x.dtype)
+            skips = [s + r.astype(s.dtype)
+                     for s, r in zip(skips, control_residuals[:-1])]
 
         # --- up path (mirror of down, one extra layer per block) ---
         for level in reversed(range(len(c.block_out_channels))):

@@ -62,6 +62,8 @@ class Engine:
         chunk_size: int = 5,
         schedule: Optional[sched.NoiseSchedule] = None,
         mesh=None,
+        lora_provider: Optional[Callable[[str], Optional[Dict]]] = None,
+        controlnet_provider: Optional[Callable[[str], Optional[Dict]]] = None,
     ):
         self.family = family
         self.policy = policy
@@ -90,6 +92,22 @@ class Engine:
             self.params = {k: (shard_params(v, mesh) if v is not None else None)
                            for k, v in self.params.items()}
 
+        # LoRA: merged host-side on request boundaries; the jitted stages
+        # take params as arguments, so adapter swaps never recompile.
+        self.lora_provider = lora_provider
+        self._base_params = self.params
+        self._active_loras: Tuple = ()
+
+        # ControlNet: same-architecture residual network; params arrive per
+        # request via the provider (name -> converted param tree).
+        self.controlnet_provider = controlnet_provider
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            ControlNet,
+        )
+
+        self.controlnet_module = ControlNet(family.unet,
+                                            dtype=policy.compute_dtype)
+
         cd = policy.compute_dtype
         self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
         self.text_encoder_2 = (
@@ -113,20 +131,21 @@ class Engine:
         return fn
 
     def _encode_fn(self) -> Callable:
-        """(ids, ids2, clip_skip static) -> (context, pooled)."""
+        """(te_params, te2_params, ids, ids2, clip_skip static) ->
+        (context, pooled). Params are jit ARGUMENTS, never closure constants
+        — so LoRA-patched trees swap in without recompiling and weights are
+        not baked into the executable."""
 
         def build():
-            def encode(ids, ids2, skip):
+            def encode(te_params, te2_params, ids, ids2, skip):
                 # skip=0 -> model default (None); webui clip_skip N maps to N-1.
                 skip_arg = skip if skip else None
                 ctx, pooled = self.text_encoder.apply(
-                    {"params": self.params["text_encoder"]}, ids,
-                    skip=skip_arg,
+                    {"params": te_params}, ids, skip=skip_arg,
                 )
                 if self.text_encoder_2 is not None:
                     ctx2, pooled2 = self.text_encoder_2.apply(
-                        {"params": self.params["text_encoder_2"]}, ids2,
-                        skip=skip_arg,
+                        {"params": te2_params}, ids2, skip=skip_arg,
                     )
                     ctx = jnp.concatenate(
                         [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)],
@@ -135,16 +154,23 @@ class Engine:
                     pooled = pooled2
                 return ctx.astype(jnp.float32), pooled.astype(jnp.float32)
 
-            return jax.jit(encode, static_argnums=(2,))
+            return jax.jit(encode, static_argnums=(4,))
 
         return self._cached(("encode",), build)
 
-    def _make_denoise_fn(self, ctx_u, ctx_c, cfg_scale, added_u, added_c):
-        """Closure: x0-prediction denoiser with classifier-free guidance."""
-        unet_params = {"params": self.params["unet"]}
+    def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
+                         added_u, added_c, controls=(), total_steps=1):
+        """Closure: x0-prediction denoiser with classifier-free guidance and
+        optional ControlNet residual injection.
+
+        ``controls``: tuple of (cn_params, hint(B,H,W,3), weight, g_start,
+        g_end) — residuals from every unit are summed, each gated by its
+        guidance step-fraction window (webui unit semantics; the reference
+        serializes exactly these fields, control_net.py:20-79)."""
+        unet_params = {"params": unet_tree}
         v_pred = self.schedule.prediction_type == "v_prediction"
 
-        def denoise(x, sigma):
+        def denoise(x, sigma, step):
             B = x.shape[0]
             c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
             t = self.schedule.sigma_to_t(sigma)
@@ -155,14 +181,29 @@ class Engine:
                 jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
                 jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
             ], axis=0)
+            added = None
             if added_u is not None:
                 added = jnp.concatenate([
                     jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
                     jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
                 ], axis=0)
-                out = self.unet.apply(unet_params, both, tb, ctx, added)
-            else:
-                out = self.unet.apply(unet_params, both, tb, ctx)
+
+            residuals = None
+            frac = (step.astype(jnp.float32) + 0.5) / total_steps
+            for cn_params, hint, weight, g_start, g_end in controls:
+                gate = jnp.where(
+                    (frac >= g_start) & (frac <= g_end), weight, 0.0
+                ).astype(jnp.float32)
+                hint_b = jnp.broadcast_to(hint, (B,) + hint.shape[1:])
+                hint2 = jnp.concatenate([hint_b, hint_b], axis=0)
+                rs = self.controlnet_module.apply(
+                    {"params": cn_params}, both, tb, ctx, hint2, added)
+                rs = tuple(r.astype(jnp.float32) * gate for r in rs)
+                residuals = rs if residuals is None else tuple(
+                    a + b for a, b in zip(residuals, rs))
+
+            out = self.unet.apply(unet_params, both, tb, ctx, added,
+                                  control_residuals=residuals)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
             guided = out_u + cfg_scale * (out_c - out_u)
             if v_pred:
@@ -175,20 +216,22 @@ class Engine:
 
     def _chunk_fn(self, sampler_name: str, steps: int, width: int,
                   height: int, batch: int, length: int,
-                  masked: bool) -> Callable:
+                  masked: bool, n_controls: int = 0) -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
         index. Cache key excludes prompt/seed/cfg — those are data."""
         spec = kd.resolve_sampler(sampler_name)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
-               masked, self.family.name)
+               masked, n_controls, self.family.name)
 
         def build():
             sigmas = kd.build_sigmas(spec, self.schedule, steps)
 
-            def run_chunk(carry, start, ctx_u, ctx_c, cfg, image_keys,
-                          added_u, added_c, mask_lat, init_lat):
+            def run_chunk(unet_params, carry, start, ctx_u, ctx_c, cfg,
+                          image_keys, added_u, added_c, mask_lat, init_lat,
+                          controls):
                 denoise = self._make_denoise_fn(
-                    ctx_u, ctx_c, cfg, added_u, added_c)
+                    unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
+                    controls=controls, total_steps=steps)
                 base_step = kd.make_sampler_step(
                     spec, denoise, sigmas, image_keys)
 
@@ -222,9 +265,9 @@ class Engine:
         def build():
             scale = self.family.vae.scaling_factor
 
-            def decode(latents):
+            def decode(vae_params, latents):
                 imgs = self.vae.apply(
-                    {"params": self.params["vae"]}, latents / scale,
+                    {"params": vae_params}, latents / scale,
                     method=VAE.decode)
                 return jnp.clip(imgs * 0.5 + 0.5, 0.0, 1.0)
 
@@ -238,9 +281,9 @@ class Engine:
         def build():
             scale = self.family.vae.scaling_factor
 
-            def encode(images):
+            def encode(vae_params, images):
                 mean, _ = self.vae.apply(
-                    {"params": self.params["vae"]}, images * 2.0 - 1.0,
+                    {"params": vae_params}, images * 2.0 - 1.0,
                     method=VAE.encode)
                 return mean.astype(jnp.float32) * scale
 
@@ -248,16 +291,130 @@ class Engine:
 
         return self._cached(key, build)
 
+    # -- LoRA ---------------------------------------------------------------
+
+    def set_loras(self, specs) -> None:
+        """Activate a stack of (name, unet_weight, te_weight) adapters
+        (webui ``<lora:name:w[:te_w]>`` semantics; BASELINE config #4).
+        Re-merges from the pristine base on every change, so removing an
+        adapter is exact, not approximate. If any requested adapter cannot
+        be resolved, the set is NOT latched — the next request retries
+        (covers the add-file-then-/refresh-loras flow)."""
+        from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+
+        key = tuple(specs)
+        if key == self._active_loras:
+            return
+        params = self._base_params
+        all_resolved = True
+        for name, weight, te_weight in specs:
+            sd = self.lora_provider(name) if self.lora_provider else None
+            if sd is None:
+                from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+                    get_logger,
+                )
+
+                get_logger().warning("lora '%s' not found; skipping", name)
+                all_resolved = False
+                continue
+            params, applied, skipped = lora_mod.merge_lora(
+                params, sd, weight, self.family, te_weight=te_weight)
+        self.params = params
+        self._active_loras = key if all_resolved else None
+
+    def _apply_prompt_loras(self, payload: GenerationPayload) -> None:
+        """Activate adapters named in the prompt. The payload keeps its tags
+        — infotext/result prompts must round-trip them (webui convention);
+        only tokenization strips them (see encode_prompts)."""
+        from stable_diffusion_webui_distributed_tpu.models.lora import (
+            extract_lora_tags,
+        )
+
+        _, tags = extract_lora_tags(payload.prompt)
+        if tags or self._active_loras:
+            self.set_loras(tags)
+
+    # -- ControlNet ---------------------------------------------------------
+
+    def _parse_controlnet_units(self, payload: GenerationPayload):
+        """Extract enabled ControlNet units from ``alwayson_scripts`` —
+        the same payload shape the reference packs (control_net.py:20-79;
+        both Mikubill-style flat 'image' and Forge-style dict accepted)."""
+        scripts = payload.alwayson_scripts or {}
+        for key in ("controlnet", "ControlNet"):
+            if key in scripts:
+                units = []
+                for u in scripts[key].get("args", []):
+                    if not isinstance(u, dict) or not u.get("enabled", True):
+                        continue
+                    image = u.get("image") or u.get("input_image")
+                    if isinstance(image, dict):
+                        image = image.get("image")
+                    if not image:
+                        continue
+                    units.append({**u, "image": image})
+                return units
+        return []
+
+    def _prepare_controls(self, payload: GenerationPayload,
+                          width: int, height: int):
+        """Units -> (cn_params, hint(1,H,W,3), weight, g_start, g_end)."""
+        units = self._parse_controlnet_units(payload)
+        if not units:
+            return ()
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            run_preprocessor,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+            get_logger,
+        )
+
+        controls = []
+        for u in units:
+            name = u.get("model", "")
+            cn_params = (self.controlnet_provider(name)
+                         if self.controlnet_provider else None)
+            if cn_params is None:
+                get_logger().warning(
+                    "controlnet model '%s' not found; unit skipped", name)
+                continue
+            img = b64png_to_array(u["image"])
+            processed = run_preprocessor(u.get("module", "none"), img)
+            # the hint embedder downsamples x8 into latent space; size the
+            # hint so hint/8 == latent dims (equals width x height for real
+            # SD families whose VAE factor is 8)
+            lat_h, lat_w = self._latent_hw(width, height)
+            processed = _resize_image(
+                np.asarray(processed, np.float32), lat_w * 8, lat_h * 8)
+            hint = jnp.asarray(processed)[None]
+            # weights/windows stay python floats: the chunk loop uses them
+            # host-side to skip ControlNet compute for chunks entirely
+            # outside the guidance window
+            controls.append((
+                cn_params, hint,
+                float(u.get("weight", 1.0)),
+                float(u.get("guidance_start", 0.0)),
+                float(u.get("guidance_end", 1.0)),
+            ))
+        return tuple(controls)
+
     # -- prompt conditioning -----------------------------------------------
 
     def encode_prompts(self, payload: GenerationPayload):
+        from stable_diffusion_webui_distributed_tpu.models.lora import (
+            extract_lora_tags,
+        )
+
         tok = self.tokenizer
-        ids_c = jnp.asarray(tok([payload.prompt]))
+        clean_prompt, _ = extract_lora_tags(payload.prompt)
+        ids_c = jnp.asarray(tok([clean_prompt]))
         ids_u = jnp.asarray(tok([payload.negative_prompt]))
         skip = int(payload.clip_skip or 0)
         enc = self._encode_fn()
-        ctx_c, pooled_c = enc(ids_c, ids_c, skip)
-        ctx_u, pooled_u = enc(ids_u, ids_u, skip)
+        te = self.params["text_encoder"]
+        te2 = self.params["text_encoder_2"]
+        ctx_c, pooled_c = enc(te, te2, ids_c, ids_c, skip)
+        ctx_u, pooled_u = enc(te, te2, ids_u, ids_u, skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _added_cond(self, pooled_u, pooled_c, width, height):
@@ -288,6 +445,7 @@ class Engine:
         payload = payload.model_copy()
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
+        self._apply_prompt_loras(payload)
         count = payload.total_images if count is None else count
         if payload.init_images:
             return self._run_img2img(payload, start_index, count, job)
@@ -331,14 +489,14 @@ class Engine:
             lambda i: rng.key_for_image(payload.seed, i))(idx)
 
     def _denoise(self, payload, x, image_keys, conds, pooleds, width, height,
-                 start_step, steps, job):
+                 start_step, steps, job, controls=()):
         return self._denoise_range(payload, x, image_keys, conds, pooleds,
                                    width, height, start_step, steps, job,
-                                   None, None)
+                                   None, None, controls)
 
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
-                       mask_lat, init_lat):
+                       mask_lat, init_lat, controls=()):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448)."""
@@ -357,10 +515,18 @@ class Engine:
             if self.state.flag.interrupted:
                 break
             length = min(self.chunk_size, steps - pos)
+            # drop units whose guidance window misses this chunk entirely —
+            # a gated-off ControlNet forward is ~half a UNet of wasted MXU
+            lo = (pos + 0.5) / steps
+            hi = (pos + length - 0.5) / steps
+            active = tuple(c for c in controls
+                           if c[3] <= hi and c[4] >= lo)
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
-                                batch, length, masked=masked)
-            carry = fn(carry, jnp.int32(pos), ctx_u, ctx_c, cfg, image_keys,
-                       au, ac, mask_arg, init_arg)
+                                batch, length, masked=masked,
+                                n_controls=len(active))
+            carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
+                       ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
+                       active)
             pos += length
             done += length
             self.state.step(done)
@@ -381,6 +547,7 @@ class Engine:
         sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
 
         conds, pooleds = self.encode_prompts(payload)
+        controls = self._prepare_controls(payload, width, height)
         out = GenerationResult(parameters=payload.model_dump())
 
         # Generate in groups of batch_size so the compiled batch dim is
@@ -397,7 +564,7 @@ class Engine:
             keys = self._image_keys(payload, pos, n)
             latents = self._denoise(
                 payload, x, keys, conds, pooleds, width, height,
-                0, payload.steps, job)
+                0, payload.steps, job, controls)
             out_w, out_h = width, height
             if payload.enable_hr:
                 latents, out_w, out_h = self._hires_pass(
@@ -438,9 +605,12 @@ class Engine:
 
         hires = payload.model_copy()
         hires.steps = steps2
+        # ControlNet conditions the hires pass too (webui behavior); hints
+        # re-prepared at the target resolution
+        controls2 = self._prepare_controls(payload, tw, th)
         latents2 = self._denoise_range(
             hires, x, image_keys, conds, pooleds, tw, th,
-            start2, steps2, job + "+hr", None, None)
+            start2, steps2, job + "+hr", None, None, controls2)
         return latents2, tw, th
 
     def _run_img2img(self, payload, start, count, job) -> GenerationResult:
@@ -455,6 +625,7 @@ class Engine:
         init = b64png_to_array(payload.init_images[0]).astype(np.float32) / 255.0
         init = _resize_image(init, width, height)
         conds, pooleds = self.encode_prompts(payload)
+        controls = self._prepare_controls(payload, width, height)
 
         mask_lat = None
         if payload.mask is not None:
@@ -470,7 +641,8 @@ class Engine:
         while remaining > 0 and not self.state.flag.interrupted:
             n = min(group, remaining)
             enc = self._encode_image_fn(width, height, n)
-            init_lat = enc(jnp.asarray(init)[None].repeat(n, axis=0))
+            init_lat = enc(self.params["vae"],
+                           jnp.asarray(init)[None].repeat(n, axis=0))
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
                 pos, n, init_lat.shape[1:])
@@ -479,7 +651,7 @@ class Engine:
             keys = self._image_keys(payload, pos, n)
             latents = self._denoise_range(
                 payload, x, keys, conds, pooleds, width, height,
-                start_step, payload.steps, job, mask_lat, init_lat)
+                start_step, payload.steps, job, mask_lat, init_lat, controls)
             self._append_decoded(out, payload, latents, pos, n, width, height)
             pos += n
             remaining -= n
@@ -487,7 +659,7 @@ class Engine:
 
     def _append_decoded(self, out, payload, latents, pos, n, width, height):
         decode = self._decode_fn(width, height, n)
-        imgs = np.asarray(decode(latents))
+        imgs = np.asarray(decode(self.params["vae"], latents))
         imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
         for j in range(n):
             i = pos + j

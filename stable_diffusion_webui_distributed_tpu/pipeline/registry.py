@@ -35,6 +35,10 @@ class ModelRegistry:
         self.state = state
         self.mesh = mesh
         self._paths: Dict[str, str] = {}
+        self._lora_paths: Dict[str, str] = {}
+        self._controlnet_paths: Dict[str, str] = {}
+        self._controlnet_cache: Dict[tuple, Dict] = {}
+        self._lora_cache: Dict[str, Dict] = {}
         self._engine = None
         self.current_name: str = ""
         self._lock = threading.Lock()
@@ -42,7 +46,8 @@ class ModelRegistry:
 
     def refresh(self) -> Dict[str, str]:
         """Re-scan the model directory (reference fan-outs
-        /refresh-checkpoints the same way, worker.py:577-581)."""
+        /refresh-checkpoints and /refresh-loras the same way,
+        worker.py:577-581)."""
         found: Dict[str, str] = {}
         if os.path.isdir(self.model_dir):
             for name in sorted(os.listdir(self.model_dir)):
@@ -50,7 +55,80 @@ class ModelRegistry:
                     found[os.path.splitext(name)[0]] = os.path.join(
                         self.model_dir, name)
         self._paths = found
+        self._lora_paths = {}
+        for lora_dir in (os.path.join(self.model_dir, "Lora"),
+                         os.path.join(self.model_dir, "lora")):
+            if os.path.isdir(lora_dir):
+                for name in sorted(os.listdir(lora_dir)):
+                    if name.lower().endswith(".safetensors"):
+                        self._lora_paths[os.path.splitext(name)[0]] = \
+                            os.path.join(lora_dir, name)
+        self._controlnet_paths = {}
+        for cn_dir in (os.path.join(self.model_dir, "ControlNet"),
+                       os.path.join(self.model_dir, "controlnet")):
+            if os.path.isdir(cn_dir):
+                for name in sorted(os.listdir(cn_dir)):
+                    if name.lower().endswith(".safetensors"):
+                        self._controlnet_paths[os.path.splitext(name)[0]] = \
+                            os.path.join(cn_dir, name)
+        # adapters may have been replaced on disk — drop converted caches
+        self._controlnet_cache.clear()
+        self._lora_cache.clear()
         return found
+
+    def available_loras(self) -> Dict[str, str]:
+        return dict(self._lora_paths)
+
+    def available_controlnets(self) -> Dict[str, str]:
+        return dict(self._controlnet_paths)
+
+    def controlnet_provider(self, name: str):
+        """Load + convert a ControlNet checkpoint by name; cached per
+        (name, active family) — a family switch re-converts against the new
+        UNet config — and cleared on refresh()."""
+        family_name = (self._engine.family.name if self._engine is not None
+                       else "sd15")
+        cache_key = (name, family_name)
+        if cache_key in self._controlnet_cache:
+            return self._controlnet_cache[cache_key]
+        path = self._controlnet_paths.get(name) or self._controlnet_paths.get(
+            os.path.splitext(name)[0])
+        if path is None:
+            return None
+        from stable_diffusion_webui_distributed_tpu.models import convert
+        from stable_diffusion_webui_distributed_tpu.models.configs import (
+            FAMILIES,
+        )
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            convert_controlnet,
+        )
+
+        sd = convert.load_safetensors(path)
+        prefix = "control_model"
+        if not any(k.startswith("control_model.") for k in sd):
+            # bare layout: keys start directly at time_embed./input_blocks.
+            sd = {f"control_model.{k}": v for k, v in sd.items()}
+        # a ControlNet mirrors the UNet it controls
+        ucfg = (self._engine.family.unet if self._engine is not None
+                else FAMILIES["sd15"].unet)
+        params = convert_controlnet(sd, ucfg, prefix)
+        self._controlnet_cache[cache_key] = params
+        get_logger().info("controlnet '%s' loaded (%s)", name, family_name)
+        return params
+
+    def lora_provider(self, name: str):
+        """Load a LoRA state dict by name, cached until the next refresh
+        (engine callback for the ``<lora:...>`` prompt syntax)."""
+        if name in self._lora_cache:
+            return self._lora_cache[name]
+        path = self._lora_paths.get(name)
+        if path is None:
+            return None
+        from stable_diffusion_webui_distributed_tpu.models.lora import load_lora
+
+        sd = load_lora(path)
+        self._lora_cache[name] = sd
+        return sd
 
     def available(self) -> Dict[str, str]:
         return dict(self._paths)
@@ -110,6 +188,8 @@ class ModelRegistry:
                 family, params, tokenizer=tokenizer, policy=self.policy,
                 model_name=name, chunk_size=self.chunk_size,
                 state=self.state, mesh=self.mesh,
+                lora_provider=self.lora_provider,
+                controlnet_provider=self.controlnet_provider,
             )
             self.current_name = name
             log.info("checkpoint '%s' active (%s)", name, family.name)

@@ -26,8 +26,10 @@ import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
 
-# denoise_fn(x, sigma_scalar) -> denoised x0 prediction, same shape as x.
-DenoiseFn = Callable[[jax.Array, jax.Array], jax.Array]
+# denoise_fn(x, sigma_scalar, step_index) -> denoised x0 prediction, same
+# shape as x. ``step_index`` lets conditioners gate by progress fraction
+# (ControlNet guidance_start/end) without re-deriving it from sigma.
+DenoiseFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,7 +117,7 @@ def make_sampler_step(
         x = carry.x
         sigma = sigmas[i]
         sigma_next = sigmas[i + 1]
-        denoised = denoise_fn(x, sigma)
+        denoised = denoise_fn(x, sigma, i)
         d = to_d(x, sigma, denoised)
 
         if algo == "euler":
@@ -131,7 +133,8 @@ def make_sampler_step(
             x_eul = x + d * (sigma_next - sigma)
 
             def second_order(_):
-                denoised2 = denoise_fn(x_eul, jnp.maximum(sigma_next, 1e-10))
+                denoised2 = denoise_fn(x_eul, jnp.maximum(sigma_next, 1e-10),
+                                       i)
                 d2 = to_d(x_eul, sigma_next, denoised2)
                 return x + (d + d2) / 2 * (sigma_next - sigma)
 
@@ -151,7 +154,7 @@ def make_sampler_step(
                      + jnp.log(jnp.maximum(sigma_down, 1e-10))) / 2
                 )
                 x_mid = x + d * (sigma_mid - sigma)
-                denoised2 = denoise_fn(x_mid, sigma_mid)
+                denoised2 = denoise_fn(x_mid, sigma_mid, i)
                 d2 = to_d(x_mid, sigma_mid, denoised2)
                 return x + d2 * (sigma_down - sigma)
 

@@ -1,0 +1,243 @@
+"""LoRA + ControlNet tests: key mapping, merge math, prompt syntax,
+zero-residual identity, end-to-end engine behavior (BASELINE configs #3/#4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+    ControlNet,
+    convert_controlnet,
+    preprocess_canny,
+    run_preprocessor,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    array_to_b64png,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+from test_models import _conv, _lin, _norm, _ldm_res, _ldm_xformer
+from test_pipeline import init_params
+
+RNG = np.random.default_rng(7)
+
+
+def make_lora_sd(dim=32, rank=4, scale=1.0):
+    """Synthetic kohya LoRA touching TINY's first UNet attention q and the
+    text encoder's layer-0 q projection."""
+    sd = {}
+    for module, d in [
+        ("lora_unet_input_blocks_1_1_transformer_blocks_0_attn1_to_q", dim),
+        ("lora_te_text_model_encoder_layers_0_self_attn_q_proj", 32),
+    ]:
+        sd[f"{module}.lora_down.weight"] = (
+            RNG.standard_normal((rank, d), np.float32) * scale)
+        sd[f"{module}.lora_up.weight"] = (
+            RNG.standard_normal((d, rank), np.float32) * scale)
+        sd[f"{module}.alpha"] = np.float32(rank)
+    return sd
+
+
+class TestLoraMapping:
+    def test_merge_touches_only_target_slice(self):
+        params = init_params(TINY)
+        sd = make_lora_sd()
+        merged, applied, skipped = lora_mod.merge_lora(params, sd, 1.0, TINY)
+        assert applied == 2 and skipped == 0
+        base_qkv = np.asarray(
+            params["unet"]["down_0_attn_0"]["block_0"]["attn1"]["qkv"]["kernel"])
+        new_qkv = np.asarray(
+            merged["unet"]["down_0_attn_0"]["block_0"]["attn1"]["qkv"]["kernel"])
+        C = base_qkv.shape[1] // 3
+        assert not np.allclose(base_qkv[:, :C], new_qkv[:, :C])   # q changed
+        np.testing.assert_array_equal(base_qkv[:, C:], new_qkv[:, C:])  # k,v not
+        # untouched modules are shared, not copied
+        assert merged["unet"]["mid_res_0"] is params["unet"]["mid_res_0"]
+
+    def test_weight_zero_is_identity(self):
+        params = init_params(TINY)
+        merged, _, _ = lora_mod.merge_lora(params, make_lora_sd(), 0.0, TINY)
+        a = params["unet"]["down_0_attn_0"]["block_0"]["attn1"]["qkv"]["kernel"]
+        b = merged["unet"]["down_0_attn_0"]["block_0"]["attn1"]["qkv"]["kernel"]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_unknown_modules_skipped(self):
+        sd = {"lora_unet_bogus_module.lora_down.weight":
+              np.zeros((4, 8), np.float32),
+              "lora_unet_bogus_module.lora_up.weight":
+              np.zeros((8, 4), np.float32)}
+        _, applied, skipped = lora_mod.merge_lora(
+            init_params(TINY), sd, 1.0, TINY)
+        assert applied == 0 and skipped == 1
+
+
+class TestLoraPromptSyntax:
+    def test_extract_tags(self):
+        clean, tags = lora_mod.extract_lora_tags(
+            "a cow <lora:style:0.8> in a field <lora:detail> end")
+        assert clean == "a cow in a field end"
+        assert tags == [("style", 0.8, 0.8), ("detail", 1.0, 1.0)]
+
+    def test_extract_dual_weight_tag(self):
+        # webui dual-multiplier form: <lora:name:unet_w:te_w>
+        clean, tags = lora_mod.extract_lora_tags("x <lora:s:0.5:0.7> y")
+        assert clean == "x y"
+        assert tags == [("s", 0.5, 0.7)]
+
+    def test_engine_end_to_end(self):
+        params = init_params(TINY)
+        loras = {"test": make_lora_sd(scale=2.0)}
+        eng = Engine(TINY, params, chunk_size=4, state=GenerationState(),
+                     lora_provider=loras.get)
+        base = eng.txt2img(GenerationPayload(
+            prompt="a cow", steps=4, width=32, height=32, seed=3))
+        styled = eng.txt2img(GenerationPayload(
+            prompt="a cow <lora:test:1.0>", steps=4, width=32, height=32,
+            seed=3))
+        assert styled.images[0] != base.images[0]
+        # infotext keeps the tag so the image round-trips (webui convention)
+        assert "<lora:test:1.0>" in styled.infotexts[0]
+        # deactivation restores the base outputs exactly
+        again = eng.txt2img(GenerationPayload(
+            prompt="a cow", steps=4, width=32, height=32, seed=3))
+        assert again.images[0] == base.images[0]
+
+    def test_missing_lora_warns_and_continues(self):
+        eng = Engine(TINY, init_params(TINY), chunk_size=4,
+                     state=GenerationState(), lora_provider=lambda n: None)
+        r = eng.txt2img(GenerationPayload(
+            prompt="x <lora:nope:1.0>", steps=2, width=32, height=32, seed=1))
+        assert len(r.images) == 1
+
+
+def make_ldm_controlnet(cfg, prefix="control_model"):
+    """Synthetic ldm ControlNet state dict for the TINY unet config."""
+    sd = {}
+    ch0 = cfg.block_out_channels[0]
+    tdim = 4 * ch0
+    ctx = cfg.cross_attention_dim
+    _lin(sd, f"{prefix}.time_embed.0", tdim, ch0)
+    _lin(sd, f"{prefix}.time_embed.2", tdim, tdim)
+    _conv(sd, f"{prefix}.input_blocks.0.0", ch0, cfg.in_channels)
+    hint_chs = (16, 16, 32, 32, 96, 96, 256)
+    prev = 3
+    for i, ch in enumerate(hint_chs):
+        _conv(sd, f"{prefix}.input_hint_block.{2 * i}", ch, prev)
+        prev = ch
+    _conv(sd, f"{prefix}.input_hint_block.{2 * len(hint_chs)}", ch0, prev)
+
+    levels = list(zip(cfg.block_out_channels, cfg.down_blocks))
+    _conv(sd, f"{prefix}.zero_convs.0.0", ch0, ch0, k=1)
+    n = 1
+    prev = ch0
+    for level, (ch, depth) in enumerate(levels):
+        for i in range(cfg.layers_per_block):
+            _ldm_res(sd, f"{prefix}.input_blocks.{n}.0", prev, ch, tdim)
+            if depth is not None:
+                _ldm_xformer(sd, f"{prefix}.input_blocks.{n}.1", ch, depth,
+                             ctx)
+            _conv(sd, f"{prefix}.zero_convs.{n}.0", ch, ch, k=1)
+            prev = ch
+            n += 1
+        if level < len(levels) - 1:
+            _conv(sd, f"{prefix}.input_blocks.{n}.0.op", ch, ch)
+            _conv(sd, f"{prefix}.zero_convs.{n}.0", ch, ch, k=1)
+            n += 1
+    mid = cfg.block_out_channels[-1]
+    _ldm_res(sd, f"{prefix}.middle_block.0", mid, mid, tdim)
+    _ldm_xformer(sd, f"{prefix}.middle_block.1", mid, cfg.mid_block_depth,
+                 ctx)
+    _ldm_res(sd, f"{prefix}.middle_block.2", mid, mid, tdim)
+    _conv(sd, f"{prefix}.middle_block_out.0", mid, mid, k=1)
+    return sd
+
+
+class TestControlNet:
+    def test_conversion_matches_init(self):
+        cfg = TINY.unet
+        sd = make_ldm_controlnet(cfg)
+        converted = convert_controlnet(sd, cfg)
+        model = ControlNet(cfg)
+        lat = jnp.zeros((1, 8, 8, 4))
+        hint = jnp.zeros((1, 64, 64, 3))  # hint embedder downsamples x8
+        init = model.init(jax.random.key(0), lat, jnp.ones((1,)),
+                          jnp.zeros((1, 77, cfg.cross_attention_dim)),
+                          hint)["params"]
+        from test_models import assert_same_structure
+
+        assert_same_structure(converted, init, "controlnet")
+        res = model.apply({"params": converted}, lat, jnp.ones((1,)),
+                          jnp.zeros((1, 77, cfg.cross_attention_dim)), hint)
+        assert len(res) > 2
+        assert all(np.isfinite(np.asarray(r)).all() for r in res)
+
+    def test_zero_init_controlnet_is_identity(self):
+        """A freshly initialized ControlNet has zero output convs, so its
+        residuals are zero and generation must be bit-identical to running
+        with no unit at all."""
+        params = init_params(TINY)
+        cfg = TINY.unet
+        model = ControlNet(cfg)
+        cn_params = model.init(
+            jax.random.key(1), jnp.zeros((1, 8, 8, 4)), jnp.ones((1,)),
+            jnp.zeros((1, 77, cfg.cross_attention_dim)),
+            jnp.zeros((1, 64, 64, 3)))["params"]
+        eng = Engine(TINY, params, chunk_size=4, state=GenerationState(),
+                     controlnet_provider=lambda n: cn_params)
+        plain = eng.txt2img(GenerationPayload(
+            prompt="c", steps=3, width=32, height=32, seed=5))
+        hint_img = np.zeros((32, 32, 3), np.uint8)
+        with_cn = eng.txt2img(GenerationPayload(
+            prompt="c", steps=3, width=32, height=32, seed=5,
+            alwayson_scripts={"controlnet": {"args": [{
+                "enabled": True, "image": array_to_b64png(hint_img),
+                "module": "none", "model": "zero", "weight": 1.0,
+            }]}}))
+        assert with_cn.images[0] == plain.images[0]
+
+    def test_trained_controlnet_changes_output(self):
+        params = init_params(TINY)
+        cfg = TINY.unet
+        converted = convert_controlnet(make_ldm_controlnet(cfg), cfg)
+        eng = Engine(TINY, params, chunk_size=4, state=GenerationState(),
+                     controlnet_provider=lambda n: converted)
+        plain = eng.txt2img(GenerationPayload(
+            prompt="c", steps=3, width=32, height=32, seed=5))
+        hint_img = (RNG.random((32, 32, 3)) * 255).astype(np.uint8)
+        unit = {"enabled": True, "image": array_to_b64png(hint_img),
+                "module": "none", "model": "cn", "weight": 1.0}
+        with_cn = eng.txt2img(GenerationPayload(
+            prompt="c", steps=3, width=32, height=32, seed=5,
+            alwayson_scripts={"controlnet": {"args": [unit]}}))
+        assert with_cn.images[0] != plain.images[0]
+        # weight 0 gates the residuals off entirely
+        off = eng.txt2img(GenerationPayload(
+            prompt="c", steps=3, width=32, height=32, seed=5,
+            alwayson_scripts={"controlnet": {"args": [
+                {**unit, "weight": 0.0}]}}))
+        assert off.images[0] == plain.images[0]
+
+
+class TestPreprocessors:
+    def test_canny_finds_edges(self):
+        img = np.zeros((64, 64, 3), np.uint8)
+        img[:, 32:] = 255  # vertical edge at x=32
+        edges = preprocess_canny(img)
+        assert edges.shape == (64, 64, 3)
+        assert edges[:, 30:34].max() == 1.0   # edge detected
+        assert edges[:, :28].max() == 0.0     # flat region clean
+        assert edges[:, 36:].max() == 0.0
+
+    def test_unknown_module_falls_back(self):
+        img = np.full((8, 8, 3), 128, np.uint8)
+        out = run_preprocessor("mystery-module", img)
+        np.testing.assert_allclose(out, 128 / 255.0, atol=1e-6)

@@ -50,7 +50,7 @@ class TestSamplerMath:
         spec = kd.resolve_sampler(name)
         x0 = self.X0 if x0 is None else x0
 
-        def denoise(x, sigma):
+        def denoise(x, sigma, step):
             return jnp.full_like(x, x0)
 
         sigmas = kd.build_sigmas(spec, SCHEDULE, steps)
@@ -87,7 +87,7 @@ class TestShardingContract:
         spec = kd.resolve_sampler("Euler a")
         shape = (4, 4, 1)
 
-        def denoise(x, sigma):
+        def denoise(x, sigma, step):
             # any x-dependent denoiser; keeps the test honest
             return x * 0.9 / (1.0 + sigma)
 
@@ -116,7 +116,7 @@ class TestChunking:
         semantics: polling is invisible to the computation)."""
         spec = kd.resolve_sampler("Euler a")
 
-        def denoise(x, sigma):
+        def denoise(x, sigma, step):
             return x / (1.0 + sigma)
 
         sigmas = kd.build_sigmas(spec, SCHEDULE, 10)
