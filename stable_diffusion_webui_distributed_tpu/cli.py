@@ -50,6 +50,7 @@ def _build_world(args, require_local: bool = True):
     world = World.from_config(
         cfg, config_path=path,
         verify_tls=not args.distributed_skip_verify_remotes)
+    world.thin_client_mode = bool(getattr(args, "thin_client", False))
 
     mesh = None
     mesh_spec = args.mesh or ",".join(

@@ -74,10 +74,16 @@ class ResBlock(nn.Module):
 
 
 class Attention(nn.Module):
-    """Self- or cross-attention over flattened spatial tokens."""
+    """Self- or cross-attention over flattened spatial tokens.
+
+    ``impl``: "xla" (compiler-fused), "flash" (Pallas online-softmax kernel
+    for the latent self-attention hot spot — cross-attention's 77-token
+    context always takes the XLA path).
+    """
 
     num_heads: int
     dtype: jnp.dtype = jnp.float32
+    impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
@@ -96,7 +102,15 @@ class Attention(nn.Module):
         q = q.reshape(B, T, self.num_heads, head_dim)
         k = k.reshape(B, ctx_len, self.num_heads, head_dim)
         v = v.reshape(B, ctx_len, self.num_heads, head_dim)
-        out = jax.nn.dot_product_attention(q, k, v, scale=1.0 / head_dim**0.5)
+        if self.impl == "flash" and context is None:
+            from stable_diffusion_webui_distributed_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, scale=1.0 / head_dim**0.5)
+        else:
+            out = jax.nn.dot_product_attention(
+                q, k, v, scale=1.0 / head_dim**0.5)
         out = out.reshape(B, T, C)
         return nn.Dense(C, dtype=self.dtype, name="out_proj")(out)
 
@@ -117,11 +131,13 @@ class TransformerBlock(nn.Module):
 
     num_heads: int
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         C = x.shape[-1]
-        x = x + Attention(self.num_heads, dtype=self.dtype, name="attn1")(
+        x = x + Attention(self.num_heads, dtype=self.dtype,
+                          impl=self.attention_impl, name="attn1")(
             nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         )
         x = x + Attention(self.num_heads, dtype=self.dtype, name="attn2")(
@@ -140,6 +156,7 @@ class SpatialTransformer(nn.Module):
     num_heads: int
     use_remat: bool = False
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -151,7 +168,9 @@ class SpatialTransformer(nn.Module):
         if self.use_remat:
             block = nn.remat(TransformerBlock, static_argnums=())
         for i in range(self.depth):
-            h = block(self.num_heads, dtype=self.dtype, name=f"block_{i}")(h, context)
+            h = block(self.num_heads, dtype=self.dtype,
+                      attention_impl=self.attention_impl,
+                      name=f"block_{i}")(h, context)
         h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
         return residual + h.reshape(B, H, W, C)
 
@@ -190,6 +209,7 @@ class UNet(nn.Module):
     cfg: UNetConfig
     dtype: jnp.dtype = jnp.float32
     use_remat: bool = False
+    attention_impl: str = "xla"
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -238,6 +258,7 @@ class UNet(nn.Module):
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
+                        self.attention_impl,
                         name=f"down_{level}_attn_{i}")(x, context)
                 skips.append(x)
             if level < len(c.block_out_channels) - 1:
@@ -250,7 +271,7 @@ class UNet(nn.Module):
         if c.mid_block_depth is not None:
             x = SpatialTransformer(
                 c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
-                self.dtype, name="mid_attn")(x, context)
+                self.dtype, self.attention_impl, name="mid_attn")(x, context)
         x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
 
         # ControlNet residual injection: one residual per skip + one for the
@@ -275,6 +296,7 @@ class UNet(nn.Module):
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
+                        self.attention_impl,
                         name=f"up_{level}_attn_{i}")(x, context)
             if level > 0:
                 x = Upsample(ch, dtype=self.dtype, name=f"up_{level}_us")(x)

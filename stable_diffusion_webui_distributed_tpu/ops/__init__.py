@@ -1,0 +1,17 @@
+"""Hand-written TPU kernels (Pallas) + sequence-parallel collectives.
+
+The reference has no kernels at all — its FLOPs live in remote CUDA
+processes. Here the UNet's self-attention over latent tokens (4096 tokens at
+SDXL 1024², 16k+ at hires resolutions) is the MXU hot spot, served by a
+Pallas flash-attention kernel; beyond single-chip VMEM limits, ring
+attention shards the token axis over the mesh's ``sp`` axis and rotates K/V
+blocks over ICI (the long-context strategy the task brief makes
+first-class).
+"""
+
+from stable_diffusion_webui_distributed_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+)
+from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+)

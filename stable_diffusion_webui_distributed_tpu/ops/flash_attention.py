@@ -1,0 +1,98 @@
+"""Pallas flash attention for the UNet's latent-token self-attention.
+
+Online-softmax blockwise attention: K/V stream through VMEM in
+``block_k``-sized tiles per ``block_q`` query tile, so the (T x S) score
+matrix never materializes in HBM — the standard memory-bound win at SDXL
+resolutions (T = 4096 latent tokens at 1024², 16384 at 2048² hires).
+
+Falls back to ``jax.nn.dot_product_attention`` when shapes don't tile
+(cross-attention's 77-token context) or when running on CPU test platforms
+without ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
+    """One (batch*head, q-tile) program: stream K/V tiles, online softmax."""
+    q = q_ref[0].astype(jnp.float32) * scale           # (block_q, D)
+    block_q, d = q.shape
+    s_len = k_ref.shape[1]
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                 # (block_q, block_k)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ v_blk
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, s_len // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_bhtd(q, k, v, scale, block_q, block_k, interpret):
+    """(BH, T, D) x (BH, S, D) -> (BH, T, D)."""
+    bh, t, d = q.shape
+    kernel = functools.partial(_attn_kernel, scale=scale, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, k.shape[1], d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k.shape[1], d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q: jax.Array,      # (B, T, H, D)
+    k: jax.Array,      # (B, S, H, D)
+    v: jax.Array,      # (B, S, H, D)
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in for ``jax.nn.dot_product_attention`` (no mask/bias path).
+
+    Tiles shrink to fit short sequences; if the sequence still doesn't tile
+    evenly, falls back to the XLA path (correctness first — the reference's
+    degraded-capability spirit, worker.py:457-467).
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    if t % block_q or s % block_k:
+        return jax.nn.dot_product_attention(q, k, v, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), scale,
+                      block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -42,7 +42,7 @@ from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     build_infotext,
     fix_seed,
 )
-from stable_diffusion_webui_distributed_tpu.runtime import dtypes, rng
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes, rng, trace
 from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
 from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
@@ -114,7 +114,8 @@ class Engine:
             CLIPTextModel(family.text_encoder_2, dtype=cd)
             if family.text_encoder_2 else None
         )
-        self.unet = UNet(family.unet, dtype=cd)
+        self.unet = UNet(family.unet, dtype=cd,
+                         attention_impl=policy.attention_impl)
         self.vae = VAE(family.vae, dtype=cd)
 
         self._cache: Dict[Tuple, Callable] = {}
@@ -413,8 +414,9 @@ class Engine:
         enc = self._encode_fn()
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
-        ctx_c, pooled_c = enc(te, te2, ids_c, ids_c, skip)
-        ctx_u, pooled_u = enc(te, te2, ids_u, ids_u, skip)
+        with trace.STATS.timer("text_encode"):
+            ctx_c, pooled_c = enc(te, te2, ids_c, ids_c, skip)
+            ctx_u, pooled_u = enc(te, te2, ids_u, ids_u, skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _added_cond(self, pooled_u, pooled_c, width, height):
@@ -524,9 +526,12 @@ class Engine:
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
                                 batch, length, masked=masked,
                                 n_controls=len(active))
-            carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
-                       ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
-                       active)
+            with trace.STATS.timer("denoise_chunk"), \
+                    trace.annotate(f"denoise[{pos}:{pos + length}]"):
+                carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
+                           ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
+                           active)
+                carry.x.block_until_ready()
             pos += length
             done += length
             self.state.step(done)
@@ -659,7 +664,8 @@ class Engine:
 
     def _append_decoded(self, out, payload, latents, pos, n, width, height):
         decode = self._decode_fn(width, height, n)
-        imgs = np.asarray(decode(self.params["vae"], latents))
+        with trace.STATS.timer("vae_decode"):
+            imgs = np.asarray(decode(self.params["vae"], latents))
         imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
         for j in range(n):
             i = pos + j

@@ -17,10 +17,27 @@ class Policy:
     param_dtype: jnp.dtype = jnp.dtype(jnp.float32)   # storage dtype of weights
     compute_dtype: jnp.dtype = jnp.dtype(jnp.bfloat16)  # matmul/conv dtype
     sampler_dtype: jnp.dtype = jnp.dtype(jnp.float32)   # latent/sigma math
+    # "xla" | "flash" (Pallas online-softmax kernel for latent self-attn).
+    # SDTPU_ATTENTION=flash flips the default TPU policy.
+    attention_impl: str = "xla"
+
+
+def _default_attention() -> str:
+    import os
+
+    value = os.environ.get("SDTPU_ATTENTION", "xla").strip().lower()
+    if value not in ("xla", "flash"):
+        import warnings
+
+        warnings.warn(
+            f"SDTPU_ATTENTION={value!r} is not one of ('xla', 'flash'); "
+            "using 'xla'", stacklevel=2)
+        return "xla"
+    return value
 
 
 #: Default policy for real TPU runs.
-TPU = Policy()
+TPU = Policy(attention_impl=_default_attention())
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 

@@ -29,6 +29,12 @@ def add_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
         help="disable TLS certificate verification for remote workers "
         "(reference: preload.py:19-23)",
     )
+    group.add_argument(
+        "--thin-client",
+        action="store_true",
+        help="exclude the local engine from planning: coordinate remotes "
+        "only (reference thin-client mode, world.py:411-412,564-594)",
+    )
     # TPU-native flags (no reference equivalent):
     group.add_argument(
         "--mesh",

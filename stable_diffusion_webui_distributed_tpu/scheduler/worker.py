@@ -101,6 +101,10 @@ class WorkerNode:
         self.state = State.IDLE
         self.loaded_model: Optional[str] = None
         self.loaded_vae: Optional[str] = None
+        # script titles this backend supports (reference queries
+        # /script-info per worker at ping time, world.py:744-763); None =
+        # unknown (send everything)
+        self.supported_scripts: Optional[List[str]] = None
         self.model_override: Optional[str] = None  # runtime-only, ui.py:161-171
         self.response_time: Optional[float] = None
         self._lock = threading.Lock()
@@ -159,6 +163,7 @@ class WorkerNode:
             time.sleep(0.1)
         self.set_state(State.WORKING)
 
+        payload = self.filter_payload_scripts(payload)
         predicted = None
         if self.cal.benchmarked:
             try:
@@ -189,9 +194,37 @@ class WorkerNode:
 
     def reachable(self) -> bool:
         try:
-            return self.backend.reachable()
+            ok = self.backend.reachable()
         except Exception:  # noqa: BLE001
             return False
+        if ok:
+            # re-query at every ping: a restarted worker may have gained or
+            # lost script support (reference re-discovers per ping sweep,
+            # world.py:744-763)
+            try:
+                self.supported_scripts = self.backend.script_info()
+            except Exception:  # noqa: BLE001
+                pass  # keep the previous knowledge
+        return ok
+
+    def filter_payload_scripts(self, payload: GenerationPayload
+                               ) -> GenerationPayload:
+        """Strip alwayson-script args this backend doesn't support — the
+        reference's per-worker compat filter (worker.py:375-404; script
+        discovery at world.py:744-763)."""
+        if not payload.alwayson_scripts or self.supported_scripts is None:
+            return payload
+        supported = {s.lower() for s in self.supported_scripts}
+        kept = {k: v for k, v in payload.alwayson_scripts.items()
+                if k.lower() in supported}
+        if len(kept) == len(payload.alwayson_scripts):
+            return payload
+        dropped = set(payload.alwayson_scripts) - set(kept)
+        get_logger().debug("worker '%s': dropping unsupported script args %s",
+                           self.label, sorted(dropped))
+        payload = payload.model_copy()
+        payload.alwayson_scripts = kept
+        return payload
 
     def load_options(self, model: str, vae: str = "") -> bool:
         """Sync the loaded checkpoint (reference worker.py:646-688)."""
@@ -276,6 +309,9 @@ class LocalBackend:
         # server layer; the engine itself holds one loaded family
         self.engine.model_name = model or self.engine.model_name
 
+    def script_info(self) -> List[str]:
+        return ["controlnet"]  # natively supported in-graph
+
     def available_models(self) -> List[str]:
         return [self.engine.model_name]
 
@@ -304,6 +340,7 @@ class StubBehavior:
     fail_generate: bool = False
     fail_reachable: bool = False
     fail_after_n_requests: Optional[int] = None
+    supported_scripts: Tuple[str, ...] = ("controlnet",)
 
 
 class StubBackend:
@@ -348,6 +385,9 @@ class StubBackend:
         if self.behavior.fail_generate:
             raise ConnectionError("stub: load_options failure")
         self.options = {"model": model, "vae": vae}
+
+    def script_info(self) -> List[str]:
+        return list(self.behavior.supported_scripts)
 
     def available_models(self) -> List[str]:
         return ["stub-model"]
@@ -434,6 +474,17 @@ class HTTPBackend:
             body["sd_vae"] = vae
         r = self.session.post(self.url("options"), json=body, timeout=600)
         r.raise_for_status()
+
+    def script_info(self) -> List[str]:
+        r = self.session.get(self.url("script-info"), timeout=self.timeout)
+        r.raise_for_status()
+        names = []
+        for entry in r.json():
+            if isinstance(entry, dict) and entry.get("name"):
+                names.append(entry["name"])
+            elif isinstance(entry, str):
+                names.append(entry)
+        return names
 
     def available_models(self) -> List[str]:
         r = self.session.get(self.url("sd-models"), timeout=self.timeout)

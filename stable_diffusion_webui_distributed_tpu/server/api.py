@@ -164,10 +164,10 @@ class ApiServer:
         return [{"name": n, "aliases": [], "options": {}} for n in SAMPLERS]
 
     def handle_script_info(self) -> Any:
-        # no auxiliary scripts in this node — the reference uses this to
-        # filter per-worker script args (world.py:744-763); an empty list
-        # means "strip all alwayson scripts for this worker"
-        return []
+        # advertised to masters that filter per-worker script args
+        # (world.py:744-763): this node applies ControlNet units in-graph
+        return [{"name": "controlnet", "is_alwayson": True, "is_img2img": True,
+                 "args": []}]
 
     def handle_refresh(self) -> Dict[str, Any]:
         if self.registry is not None:
@@ -225,8 +225,70 @@ class ApiServer:
 
     # -- HTTP plumbing -------------------------------------------------------
 
+    def handle_internal_status(self) -> Dict[str, Any]:
+        """Everything the status panel shows (reference Status tab data:
+        worker lines at world.py:603-614, log ring at ui.py:72-88)."""
+        from stable_diffusion_webui_distributed_tpu.runtime import trace
+        from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+            get_ring_buffer,
+        )
+
+        workers = []
+        if hasattr(self.source, "workers"):
+            for w in self.source.workers:
+                workers.append({
+                    "label": w.label,
+                    "state": w.state.name,
+                    "avg_ipm": w.cal.avg_ipm,
+                    "master": w.master,
+                })
+        p = self.state.progress
+        return {
+            "model": self.options.get("sd_model_checkpoint", ""),
+            "workers": workers,
+            "progress": {
+                "job": p.job,
+                "sampling_step": p.sampling_step,
+                "sampling_steps": p.sampling_steps,
+                "fraction": p.fraction,
+                "interrupted": p.interrupted,
+            },
+            "timings": trace.STATS.summary(),
+            "logs": get_ring_buffer().dump(),
+        }
+
+    def handle_profile(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Start/stop a jax.profiler capture (runtime/trace.py). The client
+        names the capture, not its location: traces always land under
+        ./profile-traces/<basename> so a network client cannot write to
+        arbitrary filesystem paths."""
+        import os
+
+        from stable_diffusion_webui_distributed_tpu.runtime import trace
+
+        action = body.get("action", "")
+        if action == "start":
+            name = os.path.basename(str(body.get("dir", "trace"))) or "trace"
+            log_dir = os.path.join("profile-traces", name)
+            ok = trace.start_trace(log_dir)
+            return {"started": ok, "dir": log_dir}
+        if action == "stop":
+            return {"stopped_dir": trace.stop_trace()}
+        raise ApiError(422, "action must be 'start' or 'stop'")
+
+    def handle_panel(self) -> str:
+        from stable_diffusion_webui_distributed_tpu.server.panel import (
+            PANEL_HTML,
+        )
+
+        return PANEL_HTML
+
     def routes(self) -> Dict[Tuple[str, str], Callable]:
         return {
+            # _dispatch rstrips trailing slashes, so "/" arrives as ""
+            ("GET", ""): self.handle_panel,
+            ("GET", "/internal/status"): self.handle_internal_status,
+            ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,
             ("POST", "/sdapi/v1/img2img"): self.handle_img2img,
             ("GET", "/sdapi/v1/options"): self.handle_options_get,
@@ -281,7 +343,10 @@ class ApiServer:
                             else fn()
                     else:
                         result = fn()
-                    self._send(200, result if result is not None else {})
+                    if isinstance(result, str):
+                        self._send_html(200, result)
+                    else:
+                        self._send(200, result if result is not None else {})
                 except ApiError as e:
                     self._send(e.status, {"detail": e.detail})
                 except Exception as e:  # noqa: BLE001
@@ -292,6 +357,14 @@ class ApiServer:
                 data = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_html(self, status: int, text: str):
+                data = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

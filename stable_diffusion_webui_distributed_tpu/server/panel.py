@@ -1,0 +1,76 @@
+"""Built-in status panel: the reference's Gradio Status tab, reborn as a
+dependency-free HTML page.
+
+Parity targets (reference ui.py:217-404 + javascript/distributed.js): live
+worker table with states and speeds, the 16-line log ring buffer, generation
+progress, and a periodic auto-refresh (the reference's JS polls a hidden
+refresh button every 1.5 s — distributed.js:7-23; this page fetches
+``/internal/status`` on the same cadence).
+"""
+
+PANEL_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>sdtpu — distributed status</title>
+<style>
+  body { font-family: ui-monospace, monospace; background: #101418;
+         color: #d5dbe1; margin: 2rem; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.4rem; }
+  table { border-collapse: collapse; min-width: 36rem; }
+  td, th { border: 1px solid #2a3138; padding: .35rem .7rem;
+           text-align: left; font-size: .85rem; }
+  th { background: #1a2026; }
+  .IDLE { color: #7bd88f; } .WORKING { color: #ffd866; }
+  .UNAVAILABLE { color: #ff6188; } .DISABLED { color: #727072; }
+  .INTERRUPTED { color: #fc9867; }
+  #logs { white-space: pre; background: #0b0e11; padding: .8rem;
+          border: 1px solid #2a3138; font-size: .8rem; max-width: 72rem;
+          overflow-x: auto; }
+  #bar { height: 6px; background: #2a3138; width: 36rem; }
+  #fill { height: 6px; background: #7bd88f; width: 0; }
+</style>
+</head>
+<body>
+<h1>sdtpu &mdash; TPU-native distributed Stable Diffusion</h1>
+<div>model: <span id="model">?</span> &middot; job: <span id="job"></span>
+  <span id="step"></span></div>
+<div id="bar"><div id="fill"></div></div>
+<h2>workers</h2>
+<table><thead><tr><th>label</th><th>state</th><th>speed</th><th>master</th>
+</tr></thead><tbody id="workers"></tbody></table>
+<h2>stage timings (p50)</h2>
+<table><thead><tr><th>stage</th><th>p50</th><th>mean</th><th>count</th>
+</tr></thead><tbody id="timings"></tbody></table>
+<h2>log</h2>
+<div id="logs"></div>
+<script>
+async function tick() {
+  try {
+    const r = await fetch('/internal/status');
+    const s = await r.json();
+    document.getElementById('model').textContent = s.model || '(none)';
+    document.getElementById('job').textContent = s.progress.job || 'idle';
+    document.getElementById('step').textContent =
+      s.progress.sampling_steps ?
+      ` ${s.progress.sampling_step}/${s.progress.sampling_steps}` : '';
+    document.getElementById('fill').style.width =
+      (100 * (s.progress.fraction || 0)) + '%';
+    document.getElementById('workers').innerHTML = s.workers.map(w =>
+      `<tr><td>${w.label}</td><td class="${w.state}">${w.state}</td>` +
+      `<td>${w.avg_ipm ? w.avg_ipm.toFixed(2) + ' ipm' : '—'}</td>` +
+      `<td>${w.master ? 'yes' : ''}</td></tr>`).join('');
+    document.getElementById('timings').innerHTML =
+      Object.entries(s.timings).map(([k, v]) =>
+        `<tr><td>${k}</td><td>${(v.p50 * 1000).toFixed(1)} ms</td>` +
+        `<td>${(v.mean * 1000).toFixed(1)} ms</td><td>${v.count}</td></tr>`
+      ).join('');
+    document.getElementById('logs').textContent = s.logs.join('\\n');
+  } catch (e) { /* server restarting */ }
+}
+setInterval(tick, 1500);  // reference cadence: distributed.js polls at 1.5 s
+tick();
+</script>
+</body>
+</html>
+"""

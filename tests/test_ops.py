@@ -1,0 +1,98 @@
+"""Kernel tests: Pallas flash attention (interpret mode on CPU) and ring
+attention over the 8-device virtual mesh, both checked against the XLA
+reference attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.ops.flash_attention import (
+    flash_attention,
+)
+from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
+    ring_attention,
+)
+
+RNG = np.random.default_rng(3)
+
+
+def qkv(b, t, h, d, s=None):
+    s = t if s is None else s
+    q = jnp.asarray(RNG.standard_normal((b, t, h, d), np.float32))
+    k = jnp.asarray(RNG.standard_normal((b, s, h, d), np.float32))
+    v = jnp.asarray(RNG.standard_normal((b, s, h, d), np.float32))
+    return q, k, v
+
+
+def reference(q, k, v):
+    return jax.nn.dot_product_attention(
+        q, k, v, scale=1.0 / q.shape[-1] ** 0.5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("t,block", [(256, 128), (128, 64), (64, 64)])
+    def test_matches_xla(self, t, block):
+        q, k, v = qkv(2, t, 4, 32)
+        out = flash_attention(q, k, v, block_q=block, block_k=block,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_non_tiling_falls_back(self):
+        # 77-token cross-attention context: must still be correct via the
+        # XLA fallback path
+        q, k, v = qkv(1, 64, 4, 32, s=77)
+        out = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = qkv(1, 128, 2, 32)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        out = flash_attention(qb, kb, vb, block_q=64, block_k=64,
+                              interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(reference(q, k, v)),
+            rtol=3e-2, atol=3e-2)
+
+    def test_jittable(self):
+        q, k, v = qkv(1, 128, 2, 32)
+        f = jax.jit(lambda a, b, c: flash_attention(a, b, c, block_q=64,
+                                                    block_k=64,
+                                                    interpret=True))
+        np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestRingAttention:
+    def test_matches_single_device(self):
+        """Token-sharded ring attention over sp=8 must equal the dense
+        single-device result — the long-context sequence-parallel path."""
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        mesh = build_mesh("sp=8")
+        q, k, v = qkv(2, 8 * 16, 4, 32)  # 128 tokens over 8 ring stages
+        out = ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_under_jit_with_dp_and_sp(self):
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        mesh = build_mesh("sp=4")  # subset of the 8 virtual devices
+        q, k, v = qkv(2, 64, 2, 16)
+        f = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh))
+        np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)

@@ -332,6 +332,44 @@ class TestBenchmark:
         masters = [e for e in w.cfg.workers if "master" in e]
         assert masters and masters[0]["master"].avg_ipm == 33.0
 
+    def test_script_args_filtered_per_worker(self):
+        """Unsupported alwayson scripts are stripped per backend
+        (reference worker.py:375-404)."""
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            StubBehavior,
+        )
+
+        w = World(ConfigModel())
+        caps = node("caps", 10.0,
+                    behavior=StubBehavior(supported_scripts=("controlnet",)))
+        bare = node("bare", 10.0,
+                    behavior=StubBehavior(supported_scripts=()))
+        w.add_worker(caps)
+        w.add_worker(bare)
+        for n_ in (caps, bare):
+            n_.reachable()  # populates supported_scripts
+        p = payload(batch_size=4, seed=1)
+        p.alwayson_scripts = {"controlnet": {"args": [{"enabled": True}]},
+                              "adetailer": {"args": []}}
+        w.execute(p)
+        sent_caps = caps.backend.requests[-1]["payload"].alwayson_scripts
+        sent_bare = bare.backend.requests[-1]["payload"].alwayson_scripts
+        assert set(sent_caps) == {"controlnet"}  # adetailer stripped
+        assert sent_bare == {}
+
+    def test_thin_client_mode_excludes_master(self):
+        """Thin-client: the master coordinates but generates nothing
+        (reference world.py:411-412; bypass at 564-594)."""
+        w = World(ConfigModel())
+        master = node("m", 60.0, master=True)
+        w.add_worker(master)
+        w.add_worker(node("a", 10.0))
+        w.thin_client_mode = True
+        r = w.execute(payload(batch_size=4, seed=50))
+        assert len(r.images) == 4
+        assert master.backend.requests == []  # no local generation
+        assert all(l == "a" for l in r.worker_labels)
+
     def test_execute_resolves_random_seed_once(self):
         w = World(ConfigModel())
         w.add_worker(node("m", 10.0, master=True))

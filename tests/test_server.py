@@ -85,8 +85,9 @@ class TestRoutes:
         names = {s["name"] for s in samplers}
         assert {"Euler a", "DPM++ 2M Karras"} <= names
 
-    def test_script_info_empty(self, server):
-        assert call(server, "/sdapi/v1/script-info") == []
+    def test_script_info_advertises_controlnet(self, server):
+        info = call(server, "/sdapi/v1/script-info")
+        assert any(s["name"] == "controlnet" for s in info)
 
     def test_options_roundtrip(self, server):
         call(server, "/sdapi/v1/options", {"CLIP_stop_at_last_layers": 2})
@@ -97,6 +98,31 @@ class TestRoutes:
         with pytest.raises(urllib.error.HTTPError) as e:
             call(server, "/sdapi/v1/nope")
         assert e.value.code == 404
+
+    def test_status_panel_html(self, server):
+        url = f"http://127.0.0.1:{server.port}/"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert "text/html" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "sdtpu" in body and "/internal/status" in body
+
+    def test_internal_status(self, server):
+        out = call(server, "/internal/status")
+        assert {"model", "workers", "progress", "timings", "logs"} <= set(out)
+        labels = {w["label"] for w in out["workers"]}
+        assert "m" in labels
+
+    def test_stage_timings_recorded(self, server):
+        from stable_diffusion_webui_distributed_tpu.runtime import trace
+
+        trace.STATS.record("unit-test-stage", 0.25)
+        out = call(server, "/internal/status")
+        assert out["timings"]["unit-test-stage"]["count"] >= 1
+
+    def test_profile_endpoint_validates(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(server, "/internal/profile", {"action": "bogus"})
+        assert e.value.code == 422
 
 
 class TestAuth:
