@@ -277,6 +277,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(debug=args.distributed_debug)
+    if args.fn in (cmd_generate, cmd_serve, cmd_benchmark):
+        # build the native PNG encoder off the request path
+        from stable_diffusion_webui_distributed_tpu.runtime import native
+
+        native.warm_up()
     return args.fn(args)
 
 

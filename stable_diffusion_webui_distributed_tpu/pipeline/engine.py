@@ -132,13 +132,18 @@ class Engine:
         return fn
 
     def _encode_fn(self) -> Callable:
-        """(te_params, te2_params, ids, ids2, clip_skip static) ->
-        (context, pooled). Params are jit ARGUMENTS, never closure constants
-        — so LoRA-patched trees swap in without recompiling and weights are
-        not baked into the executable."""
+        """(te_params, te2_params, ids, weights, clip_skip static) ->
+        (context (1, chunks*77, D), pooled). Params are jit ARGUMENTS, never
+        closure constants — so LoRA-patched trees swap in without
+        recompiling and weights are not baked into the executable.
+
+        ``ids``/``weights`` are (n_chunks, 77): long prompts ride as extra
+        batch rows through the encoder, then concatenate along the sequence
+        axis (webui unlimited-length convention). Emphasis weights scale the
+        embeddings with chunk-mean restoration (webui semantics)."""
 
         def build():
-            def encode(te_params, te2_params, ids, ids2, skip):
+            def encode(te_params, te2_params, ids, weights, skip):
                 # skip=0 -> model default (None); webui clip_skip N maps to N-1.
                 skip_arg = skip if skip else None
                 ctx, pooled = self.text_encoder.apply(
@@ -146,14 +151,25 @@ class Engine:
                 )
                 if self.text_encoder_2 is not None:
                     ctx2, pooled2 = self.text_encoder_2.apply(
-                        {"params": te2_params}, ids2, skip=skip_arg,
+                        {"params": te2_params}, ids, skip=skip_arg,
                     )
                     ctx = jnp.concatenate(
                         [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)],
                         axis=-1,
                     )
                     pooled = pooled2
-                return ctx.astype(jnp.float32), pooled.astype(jnp.float32)
+                ctx = ctx.astype(jnp.float32)
+                # emphasis: scale tokens, restore the chunk mean
+                orig_mean = ctx.mean(axis=(1, 2), keepdims=True)
+                ctx = ctx * weights[:, :, None]
+                new_mean = ctx.mean(axis=(1, 2), keepdims=True)
+                ratio = jnp.where(jnp.abs(new_mean) > 1e-7,
+                                  orig_mean / new_mean, 1.0)
+                ctx = ctx * ratio
+                # chunks -> one long context row
+                ctx = ctx.reshape(1, -1, ctx.shape[-1])
+                pooled = pooled[:1]  # SDXL pooled comes from the first chunk
+                return ctx, pooled.astype(jnp.float32)
 
             return jax.jit(encode, static_argnums=(4,))
 
@@ -405,18 +421,30 @@ class Engine:
         from stable_diffusion_webui_distributed_tpu.models.lora import (
             extract_lora_tags,
         )
+        from stable_diffusion_webui_distributed_tpu.models.prompt import (
+            pad_chunks,
+            tokenize_weighted,
+        )
 
         tok = self.tokenizer
         clean_prompt, _ = extract_lora_tags(payload.prompt)
-        ids_c = jnp.asarray(tok([clean_prompt]))
-        ids_u = jnp.asarray(tok([payload.negative_prompt]))
+        ids_c, w_c = tokenize_weighted(tok, clean_prompt)
+        ids_u, w_u = tokenize_weighted(tok, payload.negative_prompt)
+        # cond and uncond must agree on context length (webui pads both)
+        n = max(ids_c.shape[0], ids_u.shape[0])
+        bos, eos = tok.bos, tok.eos
+        ids_c, w_c = pad_chunks(ids_c, w_c, n, eos, bos)
+        ids_u, w_u = pad_chunks(ids_u, w_u, n, eos, bos)
+
         skip = int(payload.clip_skip or 0)
         enc = self._encode_fn()
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
         with trace.STATS.timer("text_encode"):
-            ctx_c, pooled_c = enc(te, te2, ids_c, ids_c, skip)
-            ctx_u, pooled_u = enc(te, te2, ids_u, ids_u, skip)
+            ctx_c, pooled_c = enc(te, te2, jnp.asarray(ids_c),
+                                  jnp.asarray(w_c), skip)
+            ctx_u, pooled_u = enc(te, te2, jnp.asarray(ids_u),
+                                  jnp.asarray(w_u), skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _added_cond(self, pooled_u, pooled_c, width, height):

@@ -108,12 +108,21 @@ def fix_seed(seed: Optional[int]) -> int:
 # --------------------------------------------------------------------------
 
 def array_to_b64png(img: np.ndarray) -> str:
-    """(H,W,3) uint8 -> base64 PNG string."""
-    from PIL import Image
+    """(H,W,3) uint8 -> base64 PNG string.
 
-    buf = io.BytesIO()
-    Image.fromarray(img).save(buf, format="PNG")
-    return base64.b64encode(buf.getvalue()).decode("ascii")
+    Uses the native C++ encoder (runtime/native.py) when available — PNG
+    encoding is the host-side cost of the wire format after the TPU has
+    finished — and falls back to PIL otherwise."""
+    from stable_diffusion_webui_distributed_tpu.runtime import native
+
+    data = native.encode_png(np.asarray(img))
+    if data is None:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        data = buf.getvalue()
+    return base64.b64encode(data).decode("ascii")
 
 
 def b64png_to_array(data: str) -> np.ndarray:

@@ -21,6 +21,13 @@ from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 CHECKPOINT_EXTENSIONS = (".safetensors", ".ckpt", ".pt")
 
 
+def _mtime_or_none(path: str):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return None
+
+
 class ModelRegistry:
     """Discovers checkpoints and activates one engine at a time."""
 
@@ -81,6 +88,87 @@ class ModelRegistry:
 
     def available_controlnets(self) -> Dict[str, str]:
         return dict(self._controlnet_paths)
+
+    @staticmethod
+    def _family_for(path: str, sd) -> str:
+        """Model family for a checkpoint: an optional ``<file>.json``
+        sidecar ({"family": "..."}) wins; otherwise key-layout detection
+        (webui's convention of sniffing dropped-in checkpoints)."""
+        import json
+
+        sidecar = path + ".json"
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar, encoding="utf-8") as f:
+                    fam = json.load(f).get("family")
+                if fam:
+                    return fam
+            except (OSError, ValueError):
+                pass
+        from stable_diffusion_webui_distributed_tpu.models import convert
+
+        return convert.detect_family(sd)
+
+    # -- orbax converted-params cache ---------------------------------------
+
+    def _cache_dir(self, name: str) -> str:
+        return os.path.abspath(
+            os.path.join(self.model_dir, ".sdtpu-cache", name))
+
+    def _load_param_cache(self, name: str, src_path: str):
+        """(family, params) from the orbax cache, or None when absent/stale."""
+        import json
+
+        cache_dir = self._cache_dir(name)
+        meta_path = os.path.join(cache_dir, "meta.json")
+        try:
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            if meta.get("src_mtime") != os.path.getmtime(src_path):
+                return None
+            # the family sidecar participates in staleness: editing it must
+            # force a re-conversion under the corrected family
+            if meta.get("sidecar_mtime") != _mtime_or_none(src_path + ".json"):
+                return None
+            from stable_diffusion_webui_distributed_tpu.models.configs import (
+                FAMILIES,
+            )
+
+            family = FAMILIES[meta["family"]]
+            import orbax.checkpoint as ocp
+
+            restored = ocp.PyTreeCheckpointer().restore(
+                os.path.join(cache_dir, "params"))
+            restored.setdefault("text_encoder_2", None)
+            return family, restored
+        except Exception as e:  # noqa: BLE001 — any cache problem -> reconvert
+            if os.path.exists(meta_path):
+                get_logger().debug("param cache for '%s' unusable (%s)",
+                                   name, e)
+            return None
+
+    def _save_param_cache(self, name: str, src_path: str, family,
+                          params) -> None:
+        import json
+
+        cache_dir = self._cache_dir(name)
+        try:
+            import orbax.checkpoint as ocp
+
+            os.makedirs(cache_dir, exist_ok=True)
+            to_save = {k: v for k, v in params.items() if v is not None}
+            ocp.PyTreeCheckpointer().save(
+                os.path.join(cache_dir, "params"), to_save, force=True)
+            with open(os.path.join(cache_dir, "meta.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump({"family": family.name,
+                           "src_mtime": os.path.getmtime(src_path),
+                           "sidecar_mtime": _mtime_or_none(
+                               src_path + ".json")}, f)
+            get_logger().debug("param cache for '%s' written", name)
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            get_logger().debug("param cache save for '%s' failed: %s",
+                               name, e)
 
     def controlnet_provider(self, name: str):
         """Load + convert a ControlNet checkpoint by name; cached per
@@ -144,7 +232,14 @@ class ModelRegistry:
             self.current_name = name
 
     def activate(self, name: str):
-        """Load + convert the named checkpoint and build its engine."""
+        """Load + convert the named checkpoint and build its engine.
+
+        Converted Flax trees are cached with orbax under
+        ``<model_dir>/.sdtpu-cache/<name>`` (keyed on the source file's
+        mtime), so re-activating a checkpoint skips the ldm conversion —
+        the calibration-survives-restarts idea (reference world.py:705-722)
+        applied to model weights.
+        """
         with self._lock:
             if name == self.current_name and self._engine is not None:
                 return self._engine
@@ -154,7 +249,6 @@ class ModelRegistry:
                 raise KeyError(f"unknown model '{name}' "
                                f"(have: {list(self._paths)})")
             log = get_logger()
-            log.info("loading checkpoint '%s' from %s", name, path)
 
             from stable_diffusion_webui_distributed_tpu.models import convert
             from stable_diffusion_webui_distributed_tpu.models.configs import (
@@ -167,18 +261,26 @@ class ModelRegistry:
                 Engine,
             )
 
-            if path.lower().endswith(".safetensors"):
-                sd = convert.load_safetensors(path)
+            cached = self._load_param_cache(name, path)
+            if cached is not None:
+                family, params = cached
+                log.info("checkpoint '%s' restored from orbax cache", name)
             else:
-                import torch
+                log.info("loading checkpoint '%s' from %s", name, path)
+                if path.lower().endswith(".safetensors"):
+                    sd = convert.load_safetensors(path)
+                else:
+                    import torch
 
-                raw = torch.load(path, map_location="cpu", weights_only=True)
-                raw = raw.get("state_dict", raw)
-                sd = {k: v.float().numpy() for k, v in raw.items()
-                      if hasattr(v, "numpy")}
-            family = FAMILIES[convert.detect_family(sd)]
-            params = convert.convert_ldm(sd, family)
-            del sd  # free host RAM before device transfer
+                    raw = torch.load(path, map_location="cpu",
+                                     weights_only=True)
+                    raw = raw.get("state_dict", raw)
+                    sd = {k: v.float().numpy() for k, v in raw.items()
+                          if hasattr(v, "numpy")}
+                family = FAMILIES[self._family_for(path, sd)]
+                params = convert.convert_ldm(sd, family)
+                del sd  # free host RAM before device transfer
+                self._save_param_cache(name, path, family, params)
 
             # drop the previous engine's params before building the new one
             self._engine = None

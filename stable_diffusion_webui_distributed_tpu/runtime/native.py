@@ -1,0 +1,112 @@
+"""Native runtime components: build-on-first-use C++ via ctypes.
+
+The reference keeps all of its own code in Python and leans on each
+worker's CUDA substrate for performance (SURVEY.md §2: zero native code in
+the repo). Here the serving path has real host-side work — PNG encoding of
+finished images — done natively (native/png_encoder.cpp, zlib) with a
+silent PIL fallback when no toolchain is available. The library is
+compiled once per machine into ``native/build/`` and memoized.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _build_library() -> Optional[str]:
+    src = os.path.join(_native_dir(), "png_encoder.cpp")
+    if not os.path.exists(src):
+        return None
+    build_dir = os.path.join(_native_dir(), "build")
+    out = os.path.join(build_dir, "libsdtpu_png.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(build_dir, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", src, "-lz", "-o", out]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+            get_logger,
+        )
+
+        get_logger().debug("native png encoder build failed: %s",
+                           proc.stderr.decode(errors="replace")[:400])
+        return None
+    return out
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _build_library()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.sdtpu_encode_png.restype = ctypes.c_long
+            lib.sdtpu_encode_png.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_long,
+            ]
+            _lib = lib
+        except OSError:
+            _lib_failed = True
+        return _lib
+
+
+def warm_up(background: bool = True) -> None:
+    """Build/load the native library ahead of the first request so the
+    compile (up to ~2 min cold) never lands on the serving path."""
+    if background:
+        threading.Thread(target=_get_lib, name="native-warmup",
+                         daemon=True).start()
+    else:
+        _get_lib()
+
+
+def encode_png(img: np.ndarray, compression_level: int = 6
+               ) -> Optional[bytes]:
+    """(H, W, 3|4) uint8 -> PNG bytes via the native encoder, or None when
+    the native path is unavailable (caller falls back to PIL)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    if img.dtype != np.uint8 or img.ndim != 3 or img.shape[2] not in (3, 4):
+        return None
+    img = np.ascontiguousarray(img)
+    h, w, c = img.shape
+    cap = w * h * (c + 1) + 4096
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.sdtpu_encode_png(
+        img.ctypes.data_as(ctypes.c_char_p), w, h, c, compression_level,
+        buf, cap)
+    if n < 0:  # undersized buffer: retry at the reported size
+        cap = -n
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.sdtpu_encode_png(
+            img.ctypes.data_as(ctypes.c_char_p), w, h, c, compression_level,
+            buf, cap)
+    if n <= 0:
+        return None
+    return buf.raw[:n]

@@ -53,6 +53,13 @@ SAMPLERS = {
     "DPM2 a": SamplerSpec("dpm2_a", ancestral=True, evals_per_step=2),
     "DPM++ 2M": SamplerSpec("dpmpp_2m"),
     "DPM++ 2M Karras": SamplerSpec("dpmpp_2m", schedule="karras"),
+    "DPM++ 2S a": SamplerSpec("dpmpp_2s_a", ancestral=True,
+                              evals_per_step=2),
+    "DPM++ 2S a Karras": SamplerSpec("dpmpp_2s_a", schedule="karras",
+                                     ancestral=True, evals_per_step=2),
+    "DPM++ SDE": SamplerSpec("dpmpp_sde", ancestral=True, evals_per_step=2),
+    "DPM++ SDE Karras": SamplerSpec("dpmpp_sde", schedule="karras",
+                                    ancestral=True, evals_per_step=2),
     "Euler a Karras": SamplerSpec("euler_a", schedule="karras", ancestral=True),
     "Euler Karras": SamplerSpec("euler", schedule="karras"),
 }
@@ -164,6 +171,57 @@ def make_sampler_step(
             if algo == "dpm2_a":
                 noise = _step_noise(image_keys, i, x.shape, x.dtype)
                 x_new = x_new + noise * sigma_up
+
+        elif algo == "dpmpp_2s_a":
+            # k-diffusion sample_dpmpp_2s_ancestral: single-step 2nd order
+            # in log-sigma space, then ancestral noise.
+            sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
+
+            def second_order(_):
+                t = -jnp.log(jnp.maximum(sigma, 1e-10))
+                t_next = -jnp.log(jnp.maximum(sigma_down, 1e-10))
+                h = t_next - t
+                s_mid = t + 0.5 * h
+                sig_mid = jnp.exp(-s_mid)
+                x_2 = (sig_mid / sigma) * x - jnp.expm1(-0.5 * h) * denoised
+                denoised_2 = denoise_fn(x_2, sig_mid, i)
+                return (sigma_down / sigma) * x \
+                    - jnp.expm1(-h) * denoised_2
+
+            x_new = jax.lax.cond(sigma_down > 0, second_order,
+                                 lambda _: x + d * (sigma_down - sigma),
+                                 operand=None)
+            noise = _step_noise(image_keys, i, x.shape, x.dtype)
+            x_new = x_new + noise * sigma_up
+
+        elif algo == "dpmpp_sde":
+            # k-diffusion sample_dpmpp_sde (eta=1, r=1/2): two-stage SDE
+            # solver with fresh noise at the midpoint and the endpoint.
+            def sde_step(_):
+                t = -jnp.log(jnp.maximum(sigma, 1e-10))
+                t_next = -jnp.log(jnp.maximum(sigma_next, 1e-10))
+                h = t_next - t
+                s_mid = t + 0.5 * h
+                sig_mid = jnp.exp(-s_mid)
+                # stage 1: ancestral sub-step to the midpoint
+                sd1, su1 = _ancestral_split(sigma, sig_mid)
+                s1 = -jnp.log(jnp.maximum(sd1, 1e-10))
+                x_2 = (sd1 / sigma) * x - jnp.expm1(t - s1) * denoised
+                noise_mid = _step_noise(image_keys, 500_000 + i,
+                                        x.shape, x.dtype)
+                x_2 = x_2 + noise_mid * su1
+                denoised_2 = denoise_fn(x_2, sig_mid, i)
+                # stage 2: combine and step to sigma_next
+                sd2, su2 = _ancestral_split(sigma, sigma_next)
+                s2 = -jnp.log(jnp.maximum(sd2, 1e-10))
+                denoised_d = denoised_2  # fac = 1/(2r) = 1 -> pure stage-2
+                x_n = (sd2 / sigma) * x - jnp.expm1(t - s2) * denoised_d
+                noise_end = _step_noise(image_keys, i, x.shape, x.dtype)
+                return x_n + noise_end * su2
+
+            x_new = jax.lax.cond(sigma_next > 0, sde_step,
+                                 lambda _: x + d * (sigma_next - sigma),
+                                 operand=None)
 
         elif algo == "dpmpp_2m":
             t = -jnp.log(jnp.maximum(sigma, 1e-10))

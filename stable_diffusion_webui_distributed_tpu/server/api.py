@@ -268,7 +268,9 @@ class ApiServer:
 
         action = body.get("action", "")
         if action == "start":
-            name = os.path.basename(str(body.get("dir", "trace"))) or "trace"
+            name = os.path.basename(str(body.get("dir", "trace")))
+            if name in ("", ".", ".."):
+                name = "trace"
             log_dir = os.path.join("profile-traces", name)
             ok = trace.start_trace(log_dir)
             return {"started": ok, "dir": log_dir}

@@ -1,0 +1,46 @@
+"""Native PNG encoder tests: build, correctness vs PIL decode, fallback."""
+
+import io
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.runtime import native
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    array_to_b64png, b64png_to_array,
+)
+
+RNG = np.random.default_rng(11)
+
+
+class TestNativePng:
+    def test_roundtrip_via_pil(self):
+        img = RNG.integers(0, 256, (48, 64, 3), np.uint8)
+        data = native.encode_png(img)
+        if data is None:
+            pytest.skip("native toolchain unavailable")
+        from PIL import Image
+
+        decoded = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        np.testing.assert_array_equal(decoded, img)
+
+    def test_rgba(self):
+        img = RNG.integers(0, 256, (16, 16, 4), np.uint8)
+        data = native.encode_png(img)
+        if data is None:
+            pytest.skip("native toolchain unavailable")
+        from PIL import Image
+
+        decoded = np.asarray(Image.open(io.BytesIO(data)))
+        np.testing.assert_array_equal(decoded, img)
+
+    def test_invalid_inputs_return_none(self):
+        assert native.encode_png(np.zeros((4, 4), np.uint8)) is None
+        assert native.encode_png(np.zeros((4, 4, 3), np.float32)) is None
+
+    def test_payload_helper_roundtrip(self):
+        # whichever path (native or PIL) serves array_to_b64png, the wire
+        # format must decode back to the same pixels
+        img = RNG.integers(0, 256, (32, 32, 3), np.uint8)
+        b64 = array_to_b64png(img)
+        np.testing.assert_array_equal(b64png_to_array(b64), img)

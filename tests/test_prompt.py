@@ -1,0 +1,114 @@
+"""Prompt grammar tests: emphasis parsing, chunking, engine integration."""
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models.prompt import (
+    parse_prompt_attention,
+    tokenize_weighted,
+    pad_chunks,
+)
+from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
+    FallbackTokenizer,
+)
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+from test_pipeline import init_params
+
+
+class TestParse:
+    def test_plain(self):
+        assert parse_prompt_attention("a cow") == [("a cow", 1.0)]
+
+    def test_round_brackets(self):
+        out = parse_prompt_attention("a (cat) walks")
+        assert out == [("a ", 1.0), ("cat", 1.1), (" walks", 1.0)]
+
+    def test_explicit_weight(self):
+        out = parse_prompt_attention("(cat:1.3)")
+        assert out == [("cat", pytest.approx(1.3))]
+
+    def test_square_brackets(self):
+        out = parse_prompt_attention("[dog]")
+        assert out == [("dog", pytest.approx(1 / 1.1))]
+
+    def test_nested(self):
+        out = parse_prompt_attention("((cat))")
+        assert out == [("cat", pytest.approx(1.1 * 1.1))]
+
+    def test_escapes(self):
+        out = parse_prompt_attention(r"a \(literal\) x")
+        assert "".join(s for s, _ in out) == "a (literal) x"
+        assert all(w == 1.0 for _, w in out)
+
+    def test_unclosed_bracket(self):
+        out = parse_prompt_attention("(cat")
+        assert out == [("cat", pytest.approx(1.1))]
+
+    def test_break(self):
+        out = parse_prompt_attention("a BREAK b")
+        assert ("BREAK", -1.0) in [tuple(x) for x in out]
+
+
+class TestTokenizeWeighted:
+    def test_short_prompt_single_chunk(self):
+        tok = FallbackTokenizer(1024)
+        ids, w = tokenize_weighted(tok, "a (cow:1.5) here")
+        assert ids.shape == (1, 77) and w.shape == (1, 77)
+        assert ids[0, 0] == tok.bos
+        assert 1.5 in w  # emphasized token carries its weight
+        assert w[0, 0] == 1.0  # BOS weight untouched
+
+    def test_long_prompt_chunks(self):
+        tok = FallbackTokenizer(1024)
+        prompt = " ".join(f"word{i}" for i in range(150))
+        ids, w = tokenize_weighted(tok, prompt)
+        assert ids.shape[0] == 2  # 150 tokens -> two 75-content chunks
+        assert (ids[:, 0] == tok.bos).all()
+
+    def test_break_forces_chunk(self):
+        tok = FallbackTokenizer(1024)
+        ids, _ = tokenize_weighted(tok, "left BREAK right")
+        assert ids.shape[0] == 2
+
+    def test_pad_chunks(self):
+        tok = FallbackTokenizer(1024)
+        a, wa = tokenize_weighted(tok, "short")
+        b, wb = pad_chunks(a, wa, 3, tok.eos, tok.bos)
+        assert b.shape == (3, 77)
+        assert (b[1:, 0] == tok.bos).all()
+        assert (wb[1:] == 1.0).all()
+
+
+class TestEngineIntegration:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return Engine(TINY, init_params(TINY), chunk_size=4,
+                      state=GenerationState())
+
+    def test_emphasis_changes_output(self, engine):
+        base = engine.txt2img(GenerationPayload(
+            prompt="a red cow", steps=3, width=32, height=32, seed=2))
+        emph = engine.txt2img(GenerationPayload(
+            prompt="a (red:1.8) cow", steps=3, width=32, height=32, seed=2))
+        assert base.images[0] != emph.images[0]
+
+    def test_weight_one_parens_is_identity(self, engine):
+        base = engine.txt2img(GenerationPayload(
+            prompt="a red cow", steps=3, width=32, height=32, seed=2))
+        same = engine.txt2img(GenerationPayload(
+            prompt="a (red:1.0) cow", steps=3, width=32, height=32, seed=2))
+        assert base.images[0] == same.images[0]
+
+    def test_long_prompt_generates(self, engine):
+        prompt = "a cow " + " ".join(f"detail{i}" for i in range(120))
+        r = engine.txt2img(GenerationPayload(
+            prompt=prompt, steps=3, width=32, height=32, seed=4))
+        assert len(r.images) == 1

@@ -1,0 +1,92 @@
+"""ModelRegistry tests: checkpoint discovery, activation from a real
+single-file safetensors checkpoint, the orbax converted-params cache, and
+family sidecar override."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.registry import (
+    ModelRegistry,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+from test_models import (
+    make_ldm_clip_hf,
+    make_ldm_unet,
+    make_ldm_vae,
+)
+
+
+def write_tiny_checkpoint(model_dir, name="tinymodel"):
+    from safetensors.numpy import save_file
+
+    sd = {}
+    sd.update(make_ldm_clip_hf(TINY.text_encoder))
+    sd.update(make_ldm_unet(TINY.unet))
+    sd.update(make_ldm_vae(TINY.vae))
+    os.makedirs(model_dir, exist_ok=True)
+    path = os.path.join(model_dir, f"{name}.safetensors")
+    save_file(sd, path)
+    with open(path + ".json", "w") as f:
+        json.dump({"family": "tiny"}, f)
+    return path
+
+
+class TestRegistry:
+    def test_discovery_and_activation(self, tmp_path):
+        model_dir = str(tmp_path / "models")
+        write_tiny_checkpoint(model_dir)
+        reg = ModelRegistry(model_dir, policy=dtypes.F32,
+                            state=GenerationState())
+        assert "tinymodel" in reg.available()
+        engine = reg.activate("tinymodel")
+        assert reg.current_name == "tinymodel"
+        r = engine.txt2img(GenerationPayload(
+            prompt="cow", steps=3, width=32, height=32, seed=7))
+        assert len(r.images) == 1
+
+    def test_orbax_cache_roundtrip(self, tmp_path):
+        model_dir = str(tmp_path / "models")
+        write_tiny_checkpoint(model_dir)
+        reg = ModelRegistry(model_dir, policy=dtypes.F32,
+                            state=GenerationState())
+        engine1 = reg.activate("tinymodel")
+        img1 = engine1.txt2img(GenerationPayload(
+            prompt="cow", steps=3, width=32, height=32, seed=7)).images[0]
+        cache = tmp_path / "models" / ".sdtpu-cache" / "tinymodel"
+        assert (cache / "meta.json").exists()
+
+        # a fresh registry restores from the cache and reproduces exactly
+        reg2 = ModelRegistry(model_dir, policy=dtypes.F32,
+                             state=GenerationState())
+        engine2 = reg2.activate("tinymodel")
+        img2 = engine2.txt2img(GenerationPayload(
+            prompt="cow", steps=3, width=32, height=32, seed=7)).images[0]
+        assert img1 == img2
+
+    def test_stale_cache_invalidated(self, tmp_path):
+        model_dir = str(tmp_path / "models")
+        path = write_tiny_checkpoint(model_dir)
+        reg = ModelRegistry(model_dir, policy=dtypes.F32,
+                            state=GenerationState())
+        reg.activate("tinymodel")
+        # touch the source: cache must be considered stale, not served
+        os.utime(path, (os.path.getmtime(path) + 10,) * 2)
+        reg2 = ModelRegistry(model_dir, policy=dtypes.F32,
+                             state=GenerationState())
+        assert reg2._load_param_cache("tinymodel", path) is None
+
+    def test_unknown_model_raises(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), policy=dtypes.F32)
+        with pytest.raises(KeyError):
+            reg.activate("nope")

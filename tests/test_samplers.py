@@ -67,7 +67,9 @@ class TestSamplerMath:
         out = self._run(name)
         np.testing.assert_allclose(out, self.X0, rtol=1e-4, atol=1e-4)
 
-    @pytest.mark.parametrize("name", ["Euler a", "DPM2 a"])
+    @pytest.mark.parametrize(
+        "name", ["Euler a", "DPM2 a", "DPM++ 2S a", "DPM++ SDE",
+                 "DPM++ 2S a Karras", "DPM++ SDE Karras"])
     def test_ancestral_converges(self, name):
         # Ancestral noise is annealed by sigma_up -> 0 at the end; the final
         # x must be exactly x0 because the terminal step has sigma_next=0.
