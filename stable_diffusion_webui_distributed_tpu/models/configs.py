@@ -102,6 +102,21 @@ SDModelConfig = ModelFamily
 
 SD15 = ModelFamily(name="sd15")
 
+# SD 2.x: OpenCLIP ViT-H text encoder (penultimate layer + final LN, the
+# ldm FrozenOpenCLIPEmbedder convention), 1024-dim cross-attention,
+# head_dim-64 attention. "sd21" is the 768-v v-prediction model; "sd21-base"
+# the 512 epsilon model (same weights layout — select via the <ckpt>.json
+# family sidecar, as webui selects via the .yaml).
+SD2_TEXT = CLIPTextConfig(hidden_size=1024, intermediate_size=4096,
+                          num_layers=24, num_heads=16, hidden_act="gelu",
+                          default_skip=1, layernorm_skipped=True)
+_SD2_UNET = UNetConfig(cross_attention_dim=1024, num_attention_heads=None)
+
+SD21 = ModelFamily(name="sd21", text_encoder=SD2_TEXT, unet=_SD2_UNET,
+                   prediction_type="v_prediction")
+SD21_BASE = ModelFamily(name="sd21-base", text_encoder=SD2_TEXT,
+                        unet=_SD2_UNET)
+
 SDXL_TEXT_L = CLIPTextConfig(hidden_size=768, intermediate_size=3072,
                              num_layers=12, num_heads=12, default_skip=1,
                              layernorm_skipped=False)
@@ -188,4 +203,34 @@ TINY_XL = ModelFamily(
                   scaling_factor=0.13025),
 )
 
-FAMILIES = {f.name: f for f in (SD15, SDXL_BASE, SDXL_REFINER, TINY, TINY_XL)}
+# Tiny refiner-shaped family: single projected text encoder + the refiner's
+# 5-element micro-conditioning (aesthetic score instead of target size).
+TINY_REFINER = ModelFamily(
+    name="tiny-refiner",
+    text_encoder=CLIPTextConfig(
+        vocab_size=1024, hidden_size=48, intermediate_size=96,
+        num_layers=2, num_heads=4, hidden_act="gelu",
+        projection_dim=48, default_skip=1, layernorm_skipped=False,
+    ),
+    unet=UNetConfig(
+        block_out_channels=(32, 64),
+        down_blocks=(None, 2),
+        layers_per_block=1,
+        cross_attention_dim=48,
+        num_attention_heads=4,
+        mid_block_depth=2,
+        addition_embed_dim=48,
+        addition_time_embed_dim=8,
+        projection_input_dim=48 + 5 * 8,
+    ),
+    vae=VAEConfig(block_out_channels=(32, 32), layers_per_block=1,
+                  scaling_factor=0.13025),
+)
+
+# Tiny v-prediction family: exercises the v-pred denoiser branch on CPU.
+TINY_V = dataclasses.replace(TINY, name="tiny-v",
+                             prediction_type="v_prediction")
+
+FAMILIES = {f.name: f for f in (SD15, SD21, SD21_BASE, SDXL_BASE,
+                                SDXL_REFINER, TINY, TINY_XL, TINY_REFINER,
+                                TINY_V)}

@@ -386,10 +386,14 @@ def convert_ldm(sd: StateDict, family: ModelFamily) -> Dict[str, Optional[Dict]]
         te2 = convert_clip_openai(sd, family.text_encoder_2,
                                   "conditioner.embedders.1.model")
     else:
-        # SDXL-refiner-style single encoder also lives under embedders.0.
+        # single-encoder layouts: SDXL refiner (embedders.0.model), SD2.x
+        # (cond_stage_model.model, OpenCLIP), SD1.x (HF text_model)
         if any(k.startswith("conditioner.embedders.0.model.") for k in sd):
             te = convert_clip_openai(sd, family.text_encoder,
                                      "conditioner.embedders.0.model")
+        elif any(k.startswith("cond_stage_model.model.") for k in sd):
+            te = convert_clip_openai(sd, family.text_encoder,
+                                     "cond_stage_model.model")
         else:
             te = convert_clip_hf(sd, family.text_encoder,
                                  "cond_stage_model.transformer.text_model")
@@ -439,4 +443,9 @@ def detect_family(sd: StateDict) -> str:
         return "sdxl-base"
     if any(k.startswith("conditioner.embedders.0.model.") for k in sd):
         return "sdxl-refiner"
+    if any(k.startswith("cond_stage_model.model.") for k in sd):
+        # SD2.x; v-pred (768-v) vs epsilon (512-base) is not inferable from
+        # keys — default to the v-prediction 768 model, overridable via the
+        # <ckpt>.json family sidecar (webui reads the .yaml the same way)
+        return "sd21"
     return "sd15"

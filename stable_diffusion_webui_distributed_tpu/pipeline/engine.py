@@ -64,6 +64,7 @@ class Engine:
         mesh=None,
         lora_provider: Optional[Callable[[str], Optional[Dict]]] = None,
         controlnet_provider: Optional[Callable[[str], Optional[Dict]]] = None,
+        engine_provider: Optional[Callable[[str], Optional["Engine"]]] = None,
     ):
         self.family = family
         self.policy = policy
@@ -107,6 +108,9 @@ class Engine:
 
         self.controlnet_module = ControlNet(family.unet,
                                             dtype=policy.compute_dtype)
+        # resolves another loaded engine by checkpoint name — the SDXL
+        # base+refiner handoff (BASELINE config #2)
+        self.engine_provider = engine_provider
 
         cd = policy.compute_dtype
         self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
@@ -447,14 +451,28 @@ class Engine:
                                   jnp.asarray(w_u), skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
-    def _added_cond(self, pooled_u, pooled_c, width, height):
+    def _added_cond(self, pooled_u, pooled_c, width, height,
+                    aesthetic_score: float = 6.0):
+        """SDXL micro-conditioning. The id-vector length is derived from the
+        projection width: 6 ids for the base model (orig/crop/target sizes),
+        5 for the refiner (sizes + aesthetic score)."""
         ucfg = self.family.unet
         if not ucfg.addition_embed_dim:
             return None, None
-        time_ids = jnp.asarray(
-            [[height, width, 0, 0, height, width]], jnp.float32)
-        au = make_added_cond(pooled_u, time_ids, ucfg.addition_time_embed_dim)
-        ac = make_added_cond(pooled_c, time_ids, ucfg.addition_time_embed_dim)
+        n_ids = (ucfg.projection_input_dim - ucfg.addition_embed_dim) \
+            // ucfg.addition_time_embed_dim
+        if n_ids == 5:
+            # refiner: the negative branch is conditioned with a LOW
+            # aesthetic score (sgm convention: 6.0 positive, 2.5 negative)
+            ids_c = [height, width, 0, 0, aesthetic_score]
+            ids_u = [height, width, 0, 0, 2.5]
+        else:
+            ids_c = [height, width, 0, 0, height, width][:n_ids]
+            ids_u = ids_c
+        au = make_added_cond(pooled_u, jnp.asarray([ids_u], jnp.float32),
+                             ucfg.addition_time_embed_dim)
+        ac = make_added_cond(pooled_c, jnp.asarray([ids_c], jnp.float32),
+                             ucfg.addition_time_embed_dim)
         return au, ac
 
     # -- generation ---------------------------------------------------------
@@ -476,6 +494,7 @@ class Engine:
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
         self._apply_prompt_loras(payload)
+        self.state.begin_request()  # new request resets the interrupt latch
         count = payload.total_images if count is None else count
         if payload.init_images:
             return self._run_img2img(payload, start_index, count, job)
@@ -526,10 +545,12 @@ class Engine:
 
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
-                       mask_lat, init_lat, controls=()):
+                       mask_lat, init_lat, controls=(), end_step=None):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
-        worker.py:440-448)."""
+        worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
+        [start_step, end_step or steps) — a partial range is how the
+        base half of a base+refiner pass stops at the switch point."""
         (ctx_u, ctx_c) = conds
         au, ac = self._added_cond(*pooleds, width, height)
         batch = x.shape[0]
@@ -538,13 +559,14 @@ class Engine:
         mask_arg = mask_lat if masked else jnp.float32(0)
         init_arg = init_lat if masked else jnp.float32(0)
         carry = kd.init_carry(x)
-        self.state.begin(job, steps - start_step)
+        end = steps if end_step is None else min(end_step, steps)
+        self.state.begin(job, end - start_step)
         done = 0
         pos = start_step
-        while pos < steps:
+        while pos < end:
             if self.state.flag.interrupted:
                 break
-            length = min(self.chunk_size, steps - pos)
+            length = min(self.chunk_size, end - pos)
             # drop units whose guidance window misses this chunk entirely —
             # a gated-off ControlNet forward is ~half a UNet of wasted MXU
             lo = (pos + 0.5) / steps
@@ -581,6 +603,10 @@ class Engine:
 
         conds, pooleds = self.encode_prompts(payload)
         controls = self._prepare_controls(payload, width, height)
+        # refiner engine + its conditioning resolved ONCE per request, not
+        # per batch group
+        refiner = self._refiner_engine(payload)
+        ref_cond = refiner.encode_prompts(payload) if refiner else None
         out = GenerationResult(parameters=payload.model_dump())
 
         # Generate in groups of batch_size so the compiled batch dim is
@@ -588,6 +614,7 @@ class Engine:
         group = max(1, payload.batch_size)
         pos = start
         remaining = count
+        pending = []
         while remaining > 0 and not self.state.flag.interrupted:
             n = min(group, remaining)
             noise = rng.batch_noise(
@@ -595,19 +622,63 @@ class Engine:
                 pos, n, (h, w, C))
             x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
             keys = self._image_keys(payload, pos, n)
-            latents = self._denoise(
-                payload, x, keys, conds, pooleds, width, height,
-                0, payload.steps, job, controls)
+            latents = self._split_denoise(
+                payload, x, keys, conds, pooleds, width, height, job,
+                controls, refiner, ref_cond, payload.steps, 0)
             out_w, out_h = width, height
-            if payload.enable_hr:
+            if payload.enable_hr and not self.state.flag.interrupted:
                 latents, out_w, out_h = self._hires_pass(
-                    payload, latents, keys, conds, pooleds, job)
-            self._append_decoded(out, payload, latents, pos, n, out_w, out_h)
+                    payload, latents, keys, conds, pooleds, job,
+                    refiner, ref_cond)
+            pending.append(self._queue_decoded(latents, pos, n, out_w, out_h))
+            # depth-1 pipeline: keep only the newest decode in flight so
+            # large n_iter jobs don't accumulate decoded buffers in HBM
+            if len(pending) > 1:
+                self._flush_decoded(out, payload, pending[:-1])
+                pending = pending[-1:]
             pos += n
             remaining -= n
+        self._flush_decoded(out, payload, pending)
         return out
 
-    def _hires_pass(self, payload, latents, image_keys, conds, pooleds, job):
+    def _refiner_engine(self, payload) -> Optional["Engine"]:
+        if not payload.refiner_checkpoint or payload.refiner_switch_at >= 1.0:
+            return None
+        if self.engine_provider is None:
+            return None
+        return self.engine_provider(payload.refiner_checkpoint)
+
+    def _split_denoise(self, payload, x, keys, conds, pooleds, width, height,
+                       job, controls, refiner, ref_cond, steps, start_step):
+        """Denoise [start_step, steps) with an optional refiner handoff: the
+        base model runs up to the switch point, then the refiner — its own
+        text conditioning and aesthetic micro-conditioning — finishes on the
+        same latents and sigma ladder (webui refiner_switch_at semantics;
+        BASELINE config #2's base+refiner pass). Applies to the hires second
+        pass as well, like webui. The sampler's multistep history resets at
+        the switch, like a fresh sampling run. An interrupt during the base
+        phase skips the refiner phase."""
+        if refiner is None or ref_cond is None:
+            return self._denoise_range(payload, x, keys, conds, pooleds,
+                                       width, height, start_step, steps, job,
+                                       None, None, controls)
+        switch = int(steps * payload.refiner_switch_at)
+        switch = max(start_step, min(steps - 1, switch))
+        latents = x
+        if switch > start_step:
+            latents = self._denoise_range(
+                payload, latents, keys, conds, pooleds, width, height,
+                start_step, steps, job, None, None, controls,
+                end_step=switch)
+        if self.state.flag.interrupted:
+            return latents
+        ref_conds, ref_pooleds = ref_cond
+        return refiner._denoise_range(
+            payload, latents, keys, ref_conds, ref_pooleds, width, height,
+            switch, steps, job + "+refiner", None, None)
+
+    def _hires_pass(self, payload, latents, image_keys, conds, pooleds, job,
+                    refiner=None, ref_cond=None):
         """Latent-space hires fix: bilinear latent upscale, re-noise to the
         strength point, second denoise pass at the target resolution
         (webui's "Latent" upscaler; reference ETA semantics at
@@ -639,11 +710,12 @@ class Engine:
         hires = payload.model_copy()
         hires.steps = steps2
         # ControlNet conditions the hires pass too (webui behavior); hints
-        # re-prepared at the target resolution
+        # re-prepared at the target resolution; the refiner switch applies
+        # within the hires pass as well
         controls2 = self._prepare_controls(payload, tw, th)
-        latents2 = self._denoise_range(
-            hires, x, image_keys, conds, pooleds, tw, th,
-            start2, steps2, job + "+hr", None, None, controls2)
+        latents2 = self._split_denoise(
+            hires, x, image_keys, conds, pooleds, tw, th, job + "+hr",
+            controls2, refiner, ref_cond, steps2, start2)
         return latents2, tw, th
 
     def _run_img2img(self, payload, start, count, job) -> GenerationResult:
@@ -671,6 +743,7 @@ class Engine:
         out = GenerationResult(parameters=payload.model_dump())
         group = max(1, payload.batch_size)
         pos, remaining = start, count
+        pending = []
         while remaining > 0 and not self.state.flag.interrupted:
             n = min(group, remaining)
             enc = self._encode_image_fn(width, height, n)
@@ -685,16 +758,38 @@ class Engine:
             latents = self._denoise_range(
                 payload, x, keys, conds, pooleds, width, height,
                 start_step, payload.steps, job, mask_lat, init_lat, controls)
-            self._append_decoded(out, payload, latents, pos, n, width, height)
+            pending.append(self._queue_decoded(latents, pos, n, width,
+                                               height))
+            if len(pending) > 1:  # depth-1 decode pipeline (see txt2img)
+                self._flush_decoded(out, payload, pending[:-1])
+                pending = pending[-1:]
             pos += n
             remaining -= n
+        self._flush_decoded(out, payload, pending)
         return out
 
     def _append_decoded(self, out, payload, latents, pos, n, width, height):
+        """Dispatch decode + materialize immediately (single-group path)."""
+        self._flush_decoded(out, payload, [self._queue_decoded(
+            latents, pos, n, width, height)])
+
+    def _queue_decoded(self, latents, pos, n, width, height):
+        """Dispatch the VAE decode WITHOUT waiting: the returned device
+        array materializes later, so the decode of group i pipelines with
+        the denoise of group i+1 (SURVEY.md §7 hard part #6 overlap)."""
         decode = self._decode_fn(width, height, n)
-        with trace.STATS.timer("vae_decode"):
-            imgs = np.asarray(decode(self.params["vae"], latents))
-        imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
+        with trace.STATS.timer("vae_decode_dispatch"):
+            imgs = decode(self.params["vae"], latents)
+        return (imgs, pos, n, width, height)
+
+    def _flush_decoded(self, out, payload, pending) -> None:
+        for imgs_dev, pos, n, width, height in pending:
+            with trace.STATS.timer("vae_decode_fetch"):
+                imgs = np.asarray(imgs_dev)
+            imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
+            self._append_images(out, payload, imgs, pos, n, width, height)
+
+    def _append_images(self, out, payload, imgs, pos, n, width, height):
         for j in range(n):
             i = pos + j
             seed_i = payload.seed + (0 if payload.subseed_strength > 0 else i)

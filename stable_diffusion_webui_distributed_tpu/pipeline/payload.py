@@ -49,6 +49,10 @@ class GenerationPayload(BaseModel):
     hr_resize_x: int = 0
     hr_resize_y: int = 0
 
+    # SDXL base+refiner two-model pass (webui sdapi field names)
+    refiner_checkpoint: str = ""
+    refiner_switch_at: float = 1.0   # fraction of steps where refiner takes over
+
     # model / misc
     override_settings: Dict[str, Any] = Field(default_factory=dict)
     styles: List[str] = Field(default_factory=list)

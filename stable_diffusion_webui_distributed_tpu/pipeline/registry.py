@@ -47,6 +47,7 @@ class ModelRegistry:
         self._controlnet_cache: Dict[tuple, Dict] = {}
         self._lora_cache: Dict[str, Dict] = {}
         self._engine = None
+        self._secondary: Dict[str, object] = {}
         self.current_name: str = ""
         self._lock = threading.Lock()
         self.refresh()
@@ -231,68 +232,93 @@ class ModelRegistry:
             self._engine = engine
             self.current_name = name
 
-    def activate(self, name: str):
-        """Load + convert the named checkpoint and build its engine.
+    def _build_engine(self, name: str):
+        """Load + convert + construct an Engine for ``name`` (no registry
+        state change). Converted Flax trees are cached with orbax under
+        ``<model_dir>/.sdtpu-cache/<name>`` (keyed on the source and family
+        sidecar mtimes), so re-activation skips the ldm conversion — the
+        calibration-survives-restarts idea (reference world.py:705-722)
+        applied to model weights."""
+        path = self._paths.get(name) or self._paths.get(
+            os.path.splitext(name)[0])
+        if path is None:
+            raise KeyError(f"unknown model '{name}' "
+                           f"(have: {list(self._paths)})")
+        log = get_logger()
 
-        Converted Flax trees are cached with orbax under
-        ``<model_dir>/.sdtpu-cache/<name>`` (keyed on the source file's
-        mtime), so re-activating a checkpoint skips the ldm conversion —
-        the calibration-survives-restarts idea (reference world.py:705-722)
-        applied to model weights.
-        """
+        from stable_diffusion_webui_distributed_tpu.models import convert
+        from stable_diffusion_webui_distributed_tpu.models.configs import (
+            FAMILIES,
+        )
+        from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
+            load_tokenizer,
+        )
+        from stable_diffusion_webui_distributed_tpu.pipeline.engine import (
+            Engine,
+        )
+
+        cached = self._load_param_cache(name, path)
+        if cached is not None:
+            family, params = cached
+            log.info("checkpoint '%s' restored from orbax cache", name)
+        else:
+            log.info("loading checkpoint '%s' from %s", name, path)
+            if path.lower().endswith(".safetensors"):
+                sd = convert.load_safetensors(path)
+            else:
+                import torch
+
+                raw = torch.load(path, map_location="cpu",
+                                 weights_only=True)
+                raw = raw.get("state_dict", raw)
+                sd = {k: v.float().numpy() for k, v in raw.items()
+                      if hasattr(v, "numpy")}
+            family = FAMILIES[self._family_for(path, sd)]
+            params = convert.convert_ldm(sd, family)
+            del sd  # free host RAM before device transfer
+            self._save_param_cache(name, path, family, params)
+
+        tokenizer = load_tokenizer(self.model_dir,
+                                   family.text_encoder.vocab_size)
+        return Engine(
+            family, params, tokenizer=tokenizer, policy=self.policy,
+            model_name=name, chunk_size=self.chunk_size,
+            state=self.state, mesh=self.mesh,
+            lora_provider=self.lora_provider,
+            controlnet_provider=self.controlnet_provider,
+            engine_provider=self.secondary_engine,
+        )
+
+    def activate(self, name: str):
+        """Make ``name`` the primary engine (dropping the previous one's
+        params first — HBM rarely fits two primaries). A secondary engine
+        already loaded under this name is promoted instead of duplicated."""
         with self._lock:
             if name == self.current_name and self._engine is not None:
                 return self._engine
-            path = self._paths.get(name) or self._paths.get(
-                os.path.splitext(name)[0])
-            if path is None:
-                raise KeyError(f"unknown model '{name}' "
-                               f"(have: {list(self._paths)})")
-            log = get_logger()
-
-            from stable_diffusion_webui_distributed_tpu.models import convert
-            from stable_diffusion_webui_distributed_tpu.models.configs import (
-                FAMILIES,
-            )
-            from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
-                load_tokenizer,
-            )
-            from stable_diffusion_webui_distributed_tpu.pipeline.engine import (
-                Engine,
-            )
-
-            cached = self._load_param_cache(name, path)
-            if cached is not None:
-                family, params = cached
-                log.info("checkpoint '%s' restored from orbax cache", name)
-            else:
-                log.info("loading checkpoint '%s' from %s", name, path)
-                if path.lower().endswith(".safetensors"):
-                    sd = convert.load_safetensors(path)
-                else:
-                    import torch
-
-                    raw = torch.load(path, map_location="cpu",
-                                     weights_only=True)
-                    raw = raw.get("state_dict", raw)
-                    sd = {k: v.float().numpy() for k, v in raw.items()
-                          if hasattr(v, "numpy")}
-                family = FAMILIES[self._family_for(path, sd)]
-                params = convert.convert_ldm(sd, family)
-                del sd  # free host RAM before device transfer
-                self._save_param_cache(name, path, family, params)
-
-            # drop the previous engine's params before building the new one
+            promoted = self._secondary.pop(name, None)
             self._engine = None
-            tokenizer = load_tokenizer(self.model_dir,
-                                       family.text_encoder.vocab_size)
-            self._engine = Engine(
-                family, params, tokenizer=tokenizer, policy=self.policy,
-                model_name=name, chunk_size=self.chunk_size,
-                state=self.state, mesh=self.mesh,
-                lora_provider=self.lora_provider,
-                controlnet_provider=self.controlnet_provider,
-            )
+            self._engine = promoted or self._build_engine(name)
             self.current_name = name
-            log.info("checkpoint '%s' active (%s)", name, family.name)
+            get_logger().info("checkpoint '%s' active (%s)", name,
+                              self._engine.family.name)
             return self._engine
+
+    def secondary_engine(self, name: str):
+        """A second concurrently-loaded engine (the SDXL refiner role).
+        One secondary is kept at a time; requesting another evicts it."""
+        with self._lock:
+            if name == self.current_name and self._engine is not None:
+                return self._engine
+            cached = self._secondary.get(name)
+            if cached is not None:
+                return cached
+            try:
+                engine = self._build_engine(name)
+            except KeyError:
+                get_logger().warning("refiner checkpoint '%s' not found",
+                                     name)
+                return None
+            self._secondary.clear()  # bound HBM: one secondary at a time
+            self._secondary[name] = engine
+            return engine

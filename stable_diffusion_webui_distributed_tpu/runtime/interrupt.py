@@ -77,11 +77,19 @@ class GenerationState:
         self._lock = threading.Lock()
 
     def begin(self, job: str, steps: int) -> None:
+        """Start a phase's progress record. Does NOT clear the interrupt
+        flag — a request may span several phases (base, refiner, hires) and
+        an interrupt must survive phase boundaries; clear it at request
+        scope with :meth:`begin_request`."""
         with self._lock:
-            self.flag.clear()
             self.progress = Progress(
                 job=job, sampling_steps=steps, started_at=time.time()
             )
+
+    def begin_request(self) -> None:
+        """New top-level request: reset the interrupt latch (webui clears
+        ``state.interrupted`` the same way when a generation starts)."""
+        self.flag.clear()
 
     def step(self, completed_steps: int) -> None:
         # Snapshot under the lock, invoke listeners outside it: a listener

@@ -58,9 +58,8 @@ def devices():
 
 @pytest.fixture(scope="session")
 def mesh8():
-    import jax
-    from jax.sharding import Mesh
-    import numpy as np
+    """dp=4 x tp=2 mesh over the virtual devices, built through the
+    production mesh constructor (runtime/mesh.py)."""
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import build_mesh
 
-    devs = np.array(jax.devices()[:8]).reshape(4, 2)
-    return Mesh(devs, ("dp", "tp"))
+    return build_mesh("dp=4,tp=2")

@@ -288,6 +288,30 @@ class TestCLIP:
         ctx, _ = model.apply({"params": converted}, ids)
         assert np.isfinite(np.asarray(ctx)).all()
 
+    def test_conversion_sd2_layout(self):
+        # SD2.x single file: OpenCLIP under cond_stage_model.model
+        cfg = TINY.text_encoder
+        import dataclasses as dc
+
+        cfg2 = dc.replace(cfg, hidden_act="gelu", default_skip=1)
+        sd = make_ldm_clip_openai(cfg2, prefix="cond_stage_model.model")
+        sd.update(make_ldm_unet(TINY.unet))
+        sd.update(make_ldm_vae(TINY.vae))
+        from stable_diffusion_webui_distributed_tpu.models.configs import (
+            ModelFamily,
+        )
+
+        fam = ModelFamily(name="tiny-sd2", text_encoder=cfg2,
+                          unet=TINY.unet, vae=TINY.vae,
+                          prediction_type="v_prediction")
+        assert convert.detect_family(sd) == "sd21"
+        converted = convert.convert_ldm(sd, fam)
+        assert converted["text_encoder_2"] is None
+        ids = jnp.asarray(FallbackTokenizer(cfg2.vocab_size)(["x"]))
+        model = CLIPTextModel(cfg2)
+        ctx, _ = model.apply({"params": converted["text_encoder"]}, ids)
+        assert np.isfinite(np.asarray(ctx)).all()
+
     def test_conversion_openclip(self):
         cfg = TINY_XL.text_encoder_2
         sd = make_ldm_clip_openai(cfg)

@@ -168,17 +168,33 @@ class TestXL:
         assert decode(r.images[0]).shape == (32, 32, 3)
 
 
-class TestMeshEngine:
-    def test_sharded_engine_matches_unsharded(self, engine):
-        """Engine on a dp=4,tp=2 mesh must reproduce the meshless images
-        exactly — sharding is a placement decision, never a numerics one."""
-        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
-            build_mesh,
+class TestVPrediction:
+    def test_v_pred_runs_and_differs_from_epsilon(self):
+        """Same weights under v-prediction vs epsilon parameterization must
+        both generate, and differently (SD2.x 768-v support)."""
+        from stable_diffusion_webui_distributed_tpu.models.configs import (
+            TINY_V,
         )
 
-        mesh = build_mesh("dp=4,tp=2")
+        params = init_params(TINY)
+        p = GenerationPayload(prompt="v", steps=4, width=32, height=32,
+                              seed=3)
+        eps_engine = Engine(TINY, params, chunk_size=4,
+                            state=GenerationState())
+        v_engine = Engine(TINY_V, params, chunk_size=4,
+                          state=GenerationState())
+        a = eps_engine.txt2img(p)
+        b = v_engine.txt2img(p)
+        assert a.images[0] != b.images[0]
+        assert decode(b.images[0]).shape == (32, 32, 3)
+
+
+class TestMeshEngine:
+    def test_sharded_engine_matches_unsharded(self, engine, mesh8):
+        """Engine on a dp=4,tp=2 mesh must reproduce the meshless images
+        exactly — sharding is a placement decision, never a numerics one."""
         sharded = Engine(TINY, init_params(TINY), chunk_size=4,
-                         state=GenerationState(), mesh=mesh)
+                         state=GenerationState(), mesh=mesh8)
         p = GenerationPayload(prompt="mesh cow", steps=4, width=32,
                               height=32, batch_size=4, seed=21)
         a = engine.txt2img(p)
@@ -189,17 +205,56 @@ class TestMeshEngine:
         # order differences across device boundaries
         assert np.abs(ia - ib).max() <= 1
 
-    def test_sharded_engine_odd_batch_falls_back(self, engine):
-        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
-            build_mesh,
-        )
-
+    def test_sharded_engine_odd_batch_falls_back(self, engine, mesh8):
         sharded = Engine(TINY, init_params(TINY), chunk_size=4,
-                         state=GenerationState(), mesh=build_mesh("dp=4,tp=2"))
+                         state=GenerationState(), mesh=mesh8)
         p = GenerationPayload(prompt="odd", steps=4, width=32, height=32,
                               batch_size=3, seed=22)
         r = sharded.txt2img(p)
         assert len(r.images) == 3
+
+
+class TestRefiner:
+    """SDXL base+refiner handoff (BASELINE config #2's two-model pass)."""
+
+    @pytest.fixture(scope="class")
+    def engines(self):
+        from stable_diffusion_webui_distributed_tpu.models.configs import (
+            TINY_REFINER, TINY_XL,
+        )
+
+        refiner = Engine(TINY_REFINER, init_params(TINY_REFINER),
+                         chunk_size=4, state=GenerationState(),
+                         model_name="tiny-ref")
+        provider = lambda name: refiner if name == "tiny-ref" else None
+        base = Engine(TINY_XL, init_params(TINY_XL), chunk_size=4,
+                      state=GenerationState(), engine_provider=provider)
+        return base, refiner
+
+    def test_refiner_changes_output(self, engines):
+        base_engine, _ = engines
+        plain = base_engine.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=9))
+        refined = base_engine.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=9,
+            refiner_checkpoint="tiny-ref", refiner_switch_at=0.5))
+        assert refined.images[0] != plain.images[0]
+
+    def test_switch_at_one_is_base_only(self, engines):
+        base_engine, _ = engines
+        plain = base_engine.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=9))
+        same = base_engine.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=9,
+            refiner_checkpoint="tiny-ref", refiner_switch_at=1.0))
+        assert same.images[0] == plain.images[0]
+
+    def test_unknown_refiner_falls_back(self, engines):
+        base_engine, _ = engines
+        r = base_engine.txt2img(GenerationPayload(
+            prompt="c", steps=4, width=32, height=32, seed=9,
+            refiner_checkpoint="missing", refiner_switch_at=0.5))
+        assert len(r.images) == 1
 
 
 class TestInterrupt:
