@@ -100,6 +100,8 @@ def main() -> None:
 
     avg = sum(samples) / len(samples)
     ipm = bp.batch_size / (avg / 60.0)
+    # median request wall-time (lower median) — a latency, not throughput/img
+    p50 = sorted(samples)[(len(samples) - 1) // 2]
     metric = ("tiny_logiccheck_ipm" if tiny
               else "sd15_512x512_20step_euler_a_ipm")
     print(json.dumps({
@@ -107,6 +109,7 @@ def main() -> None:
         "value": round(ipm, 2),
         "unit": "images/min",
         "vs_baseline": round(ipm / NOMINAL_SINGLE_GPU_IPM, 3),
+        "p50_latency_s": round(p50, 3),
     }))
 
 

@@ -119,7 +119,8 @@ class Engine:
             if family.text_encoder_2 else None
         )
         self.unet = UNet(family.unet, dtype=cd,
-                         attention_impl=policy.attention_impl)
+                         attention_impl=policy.attention_impl,
+                         use_remat=policy.use_remat)
         self.vae = VAE(family.vae, dtype=cd)
 
         self._cache: Dict[Tuple, Callable] = {}
@@ -354,6 +355,25 @@ class Engine:
         _, tags = extract_lora_tags(payload.prompt)
         if tags or self._active_loras:
             self.set_loras(tags)
+
+    # -- VAE override -------------------------------------------------------
+
+    def set_vae(self, vae_params: Optional[Dict]) -> None:
+        """Swap in a standalone VAE (webui's sd_vae option; the reference
+        syncs the choice across workers via /options, worker.py:646-688).
+        ``None`` restores the checkpoint's own VAE."""
+        if not hasattr(self, "_checkpoint_vae"):
+            self._checkpoint_vae = self._base_params["vae"]
+        target = self._checkpoint_vae if vae_params is None else \
+            dtypes.cast_floating(vae_params, self.policy.param_dtype)
+        if self.mesh is not None:
+            from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+                shard_params,
+            )
+
+            target = shard_params(target, self.mesh)
+        self._base_params = {**self._base_params, "vae": target}
+        self.params = {**self.params, "vae": target}
 
     # -- ControlNet ---------------------------------------------------------
 

@@ -46,6 +46,9 @@ class ModelRegistry:
         self._controlnet_paths: Dict[str, str] = {}
         self._controlnet_cache: Dict[tuple, Dict] = {}
         self._lora_cache: Dict[str, Dict] = {}
+        self._vae_paths: Dict[str, str] = {}
+        self._vae_cache: Dict[tuple, Dict] = {}
+        self._active_vae = None
         self._engine = None
         self._secondary: Dict[str, object] = {}
         self.current_name: str = ""
@@ -79,9 +82,18 @@ class ModelRegistry:
                     if name.lower().endswith(".safetensors"):
                         self._controlnet_paths[os.path.splitext(name)[0]] = \
                             os.path.join(cn_dir, name)
+        self._vae_paths = {}
+        for vae_dir in (os.path.join(self.model_dir, "VAE"),
+                        os.path.join(self.model_dir, "vae")):
+            if os.path.isdir(vae_dir):
+                for name in sorted(os.listdir(vae_dir)):
+                    if name.lower().endswith(".safetensors"):
+                        self._vae_paths[os.path.splitext(name)[0]] = \
+                            os.path.join(vae_dir, name)
         # adapters may have been replaced on disk — drop converted caches
         self._controlnet_cache.clear()
         self._lora_cache.clear()
+        self._vae_cache.clear()
         return found
 
     def available_loras(self) -> Dict[str, str]:
@@ -89,6 +101,45 @@ class ModelRegistry:
 
     def available_controlnets(self) -> Dict[str, str]:
         return dict(self._controlnet_paths)
+
+    def available_vaes(self) -> Dict[str, str]:
+        return dict(self._vae_paths)
+
+    def set_vae(self, name: str) -> bool:
+        """Apply a standalone VAE to the active engine ('Automatic'/'None'/
+        empty restores the checkpoint's own). Standalone files use the bare
+        encoder./decoder. key layout; first_stage_model.-prefixed files work
+        too. Converted trees are cached per (name, family) and a repeat of
+        the active choice is a no-op (Worker.load_options dedupes for the
+        same reason, worker.py:646-688)."""
+        if self._engine is None:
+            return False
+        if not name or name in ("Automatic", "None"):
+            if self._active_vae is not None:
+                self._engine.set_vae(None)
+                self._active_vae = None
+            return True
+        if name == self._active_vae:
+            return True
+        cache_key = (name, self._engine.family.name)
+        params = self._vae_cache.get(cache_key)
+        if params is None:
+            path = self._vae_paths.get(name) or self._vae_paths.get(
+                os.path.splitext(name)[0])
+            if path is None:
+                get_logger().warning("vae '%s' not found", name)
+                return False
+            from stable_diffusion_webui_distributed_tpu.models import convert
+
+            sd = convert.load_safetensors(path)
+            if not any(k.startswith("first_stage_model.") for k in sd):
+                sd = {f"first_stage_model.{k}": v for k, v in sd.items()}
+            params = convert.convert_vae(sd, self._engine.family.vae)
+            self._vae_cache[cache_key] = params
+        self._engine.set_vae(params)
+        self._active_vae = name
+        get_logger().info("vae '%s' applied", name)
+        return True
 
     @staticmethod
     def _family_for(path: str, sd) -> str:
@@ -300,6 +351,7 @@ class ModelRegistry:
             self._engine = None
             self._engine = promoted or self._build_engine(name)
             self.current_name = name
+            self._active_vae = None  # fresh engine carries its own VAE
             get_logger().info("checkpoint '%s' active (%s)", name,
                               self._engine.family.name)
             return self._engine

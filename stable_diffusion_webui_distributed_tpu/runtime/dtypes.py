@@ -20,6 +20,9 @@ class Policy:
     # "xla" | "flash" (Pallas online-softmax kernel for latent self-attn).
     # SDTPU_ATTENTION=flash flips the default TPU policy.
     attention_impl: str = "xla"
+    # rematerialize transformer blocks: trades UNet FLOPs for HBM at large
+    # batch/resolution (SDTPU_REMAT=1 flips the default TPU policy).
+    use_remat: bool = False
 
 
 def _default_attention() -> str:
@@ -36,8 +39,15 @@ def _default_attention() -> str:
     return value
 
 
+def _env_flag(name: str) -> bool:
+    import os
+
+    return os.environ.get(name, "") not in ("", "0")
+
+
 #: Default policy for real TPU runs.
-TPU = Policy(attention_impl=_default_attention())
+TPU = Policy(attention_impl=_default_attention(),
+             use_remat=_env_flag("SDTPU_REMAT"))
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 

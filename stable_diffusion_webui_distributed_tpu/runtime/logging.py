@@ -106,7 +106,13 @@ def configure(
                         branded.msg = f"[sdtpu] {record.msg}"
                         super().emit(branded)
 
-                console = BrandedRichHandler(show_path=False, show_time=True)
+                from rich.console import Console
+
+                # stderr, NOT stdout: machine-parseable output (bench.py's
+                # JSON line, CLI file listings) owns stdout
+                console = BrandedRichHandler(
+                    console=Console(stderr=True),
+                    show_path=False, show_time=True)
             except Exception:  # pragma: no cover - rich unavailable
                 console = logging.StreamHandler()
                 console.setFormatter(fmt)

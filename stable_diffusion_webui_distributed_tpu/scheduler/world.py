@@ -78,10 +78,11 @@ class World:
         self.complement_production: bool = self.cfg.complement_production
         self.step_scaling: bool = self.cfg.step_scaling
         self.thin_client_mode = False
-        # checkpoint the fleet should be on; synced to non-master backends
-        # before each fan-out (reference option_payload per request,
-        # distributed.py:260-318 + worker.py:342-343)
+        # checkpoint + VAE the fleet should be on; synced to non-master
+        # backends before each fan-out (reference option_payload per
+        # request, distributed.py:260-318 + worker.py:342-343)
         self.current_model: str = self.cfg.default_model
+        self.current_vae: str = ""
 
     # -- registry -----------------------------------------------------------
 
@@ -353,7 +354,8 @@ class World:
         # differs, worker.py:342-343,646-688); load_options no-ops when the
         # cache matches and respects per-worker model_override
         if self.current_model and not job.worker.master:
-            if not job.worker.load_options(self.current_model):
+            if not job.worker.load_options(self.current_model,
+                                           self.current_vae):
                 job.result = None
                 return
         job.result = job.worker.request(payload, job.start_index,

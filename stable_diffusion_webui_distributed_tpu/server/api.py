@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import signal
 import threading
 import time
@@ -59,6 +60,7 @@ class ApiServer:
             self._auth = f"Basic {token}"
         self.options: Dict[str, Any] = {
             "sd_model_checkpoint": getattr(registry, "current_name", "") or
+            getattr(source, "current_model", "") or
             getattr(source, "model_name", ""),
             "sd_vae": "Automatic",
             "CLIP_stop_at_last_layers": 1,
@@ -66,6 +68,7 @@ class ApiServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._busy = threading.Lock()
         self.restart_requested = False
+        self._styles_cache: Tuple = ((None, None), {})
 
     # -- request execution --------------------------------------------------
 
@@ -75,17 +78,27 @@ class ApiServer:
         return self.source.generate_range(payload)  # Engine
 
     def _generation_response(self, result: GenerationResult) -> Dict[str, Any]:
+        images = list(result.images)
+        infotexts = list(result.infotexts)
+        # webui prepends a grid image when return_grid is on and more than
+        # one image came back (the reference's thin-client path rebuilds the
+        # same grid, world.py:588-591)
+        if self.options.get("return_grid") and len(images) > 1:
+            grid = _make_grid_b64(images)
+            if grid is not None:
+                images.insert(0, grid)
+                infotexts.insert(0, infotexts[0] if infotexts else "")
         info = {
             "all_seeds": result.seeds,
             "all_subseeds": result.subseeds,
             "all_prompts": result.prompts,
             "all_negative_prompts": result.negative_prompts,
-            "infotexts": result.infotexts,
+            "infotexts": infotexts,
             "seed": result.seeds[0] if result.seeds else -1,
             "subseed": result.subseeds[0] if result.subseeds else -1,
         }
         return {
-            "images": result.images,
+            "images": images,
             "parameters": result.parameters,
             # webui encodes info as a JSON string; the reference re-parses it
             "info": json.dumps(info),
@@ -93,8 +106,27 @@ class ApiServer:
 
     # -- handlers ------------------------------------------------------------
 
+    def _apply_styles(self, payload: GenerationPayload) -> None:
+        if not payload.styles:
+            return
+        from stable_diffusion_webui_distributed_tpu.pipeline.styles import (
+            apply_styles, load_styles,
+        )
+
+        model_dir = getattr(self.registry, "model_dir", ".") \
+            if self.registry is not None else "."
+        path = os.path.join(model_dir, "styles.csv")
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = None
+        if self._styles_cache[0] != (path, mtime):
+            self._styles_cache = ((path, mtime), load_styles(path))
+        apply_styles(payload, self._styles_cache[1])
+
     def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
         payload = GenerationPayload(**body)
+        self._apply_styles(payload)
         with self._busy:
             result = self._execute(payload)
         return self._generation_response(result)
@@ -103,6 +135,7 @@ class ApiServer:
         payload = GenerationPayload(**body)
         if not payload.init_images:
             raise ApiError(422, "img2img requires init_images")
+        self._apply_styles(payload)
         with self._busy:
             result = self._execute(payload)
         return self._generation_response(result)
@@ -112,16 +145,34 @@ class ApiServer:
 
     def handle_options_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
         model = body.get("sd_model_checkpoint")
+        vae = body.get("sd_vae")
         if model:
             if self.registry is not None:
                 # blocking load, like webui's POST /options (the reference
                 # waits on it when syncing checkpoints, worker.py:646-688)
                 self.registry.activate(model)
+                # sd_vae is sticky across model loads (webui behavior):
+                # re-apply the standing override to the fresh engine
+                standing = vae if vae is not None else \
+                    self.options.get("sd_vae")
+                if standing and standing not in ("Automatic", "None") \
+                        and hasattr(self.registry, "set_vae"):
+                    self.registry.set_vae(standing)
             self.options["sd_model_checkpoint"] = model
-            if hasattr(self.source, "sync_models"):
-                # checkpoint-change fan-out to the fleet (world.py:784-811)
-                self.source.current_model = model
-                self.source.sync_models(model)
+        if vae is not None and model is None and self.registry is not None \
+                and hasattr(self.registry, "set_vae"):
+            self.registry.set_vae(vae)
+        if (model or vae is not None) and hasattr(self.source, "sync_models"):
+            # checkpoint/VAE-change fan-out to the fleet (world.py:784-811)
+            sync_model = model or self.options.get("sd_model_checkpoint", "")
+            sync_vae = vae if vae is not None else \
+                self.options.get("sd_vae", "")
+            if model:
+                self.source.current_model = sync_model
+            if hasattr(self.source, "current_vae") and vae is not None:
+                self.source.current_vae = sync_vae
+            if sync_model:
+                self.source.sync_models(sync_model, _vae_for_sync(sync_vae))
         for k, v in body.items():
             if k != "sd_model_checkpoint":
                 self.options[k] = v
@@ -430,3 +481,36 @@ class ApiError(Exception):
         super().__init__(detail)
         self.status = status
         self.detail = detail
+
+
+def _vae_for_sync(vae: str) -> str:
+    """'Automatic'/'None' mean "checkpoint default" — send empty on the wire."""
+    return "" if vae in ("Automatic", "None") else (vae or "")
+
+
+def _make_grid_b64(images_b64) -> Optional[str]:
+    """Assemble a near-square grid of equally sized images (webui
+    image_grid semantics; reference world.py:588-591)."""
+    import math
+
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        array_to_b64png, b64png_to_array,
+    )
+
+    try:
+        arrays = [b64png_to_array(b) for b in images_b64]
+        h, w, c = arrays[0].shape
+        if any(a.shape != (h, w, c) for a in arrays):
+            return None
+        n = len(arrays)
+        cols = math.ceil(math.sqrt(n))
+        rows = math.ceil(n / cols)
+        grid = np.zeros((rows * h, cols * w, c), arrays[0].dtype)
+        for i, a in enumerate(arrays):
+            r, col = divmod(i, cols)
+            grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = a
+        return array_to_b64png(grid)
+    except Exception:  # noqa: BLE001 — a grid is decorative, never fatal
+        return None

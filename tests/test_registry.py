@@ -90,3 +90,31 @@ class TestRegistry:
         reg = ModelRegistry(str(tmp_path), policy=dtypes.F32)
         with pytest.raises(KeyError):
             reg.activate("nope")
+
+    def test_vae_override_and_restore(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        from test_models import make_ldm_vae
+
+        model_dir = str(tmp_path / "models")
+        write_tiny_checkpoint(model_dir)
+        # standalone VAE with the bare key layout (no first_stage_model.)
+        vae_sd = {k[len("first_stage_model."):]: v
+                  for k, v in make_ldm_vae(TINY.vae).items()}
+        os.makedirs(os.path.join(model_dir, "VAE"))
+        save_file(vae_sd, os.path.join(model_dir, "VAE", "alt.safetensors"))
+
+        reg = ModelRegistry(model_dir, policy=dtypes.F32,
+                            state=GenerationState())
+        engine = reg.activate("tinymodel")
+        assert "alt" in reg.available_vaes()
+        p = GenerationPayload(prompt="v", steps=2, width=32, height=32,
+                              seed=3)
+        base = engine.txt2img(p).images[0]
+        assert reg.set_vae("alt")
+        swapped = engine.txt2img(p).images[0]
+        assert swapped != base
+        assert reg.set_vae("Automatic")
+        restored = engine.txt2img(p).images[0]
+        assert restored == base
+        assert not reg.set_vae("nonexistent")

@@ -125,6 +125,57 @@ class TestRoutes:
         assert e.value.code == 422
 
 
+class TestStylesAndGrid:
+    def test_styles_applied(self, tmp_path):
+        from stable_diffusion_webui_distributed_tpu.pipeline.styles import (
+            apply_styles, load_styles,
+        )
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            GenerationPayload,
+        )
+
+        csv_path = tmp_path / "styles.csv"
+        csv_path.write_text(
+            "name,prompt,negative_prompt\n"
+            "anime,\"{prompt}, anime style\",\"ugly\"\n"
+            "suffix-only,\"best quality\",\"\"\n")
+        styles = load_styles(str(csv_path))
+        p = GenerationPayload(prompt="a cow", styles=["anime", "suffix-only",
+                                                      "missing"])
+        apply_styles(p, styles)
+        assert p.prompt == "a cow, anime style, best quality"
+        assert p.negative_prompt == "ugly"
+        assert p.styles == []
+
+    def test_return_grid_option(self, server):
+        call(server, "/sdapi/v1/options", {"return_grid": True})
+        try:
+            out = call(server, "/sdapi/v1/txt2img",
+                       {"prompt": "g", "batch_size": 3, "seed": 5,
+                        "steps": 2, "width": 64, "height": 64})
+            # stub images aren't decodable PNGs -> grid skipped gracefully
+            assert len(out["images"]) == 3
+        finally:
+            call(server, "/sdapi/v1/options", {"return_grid": False})
+
+    def test_make_grid(self):
+        import numpy as np
+
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            array_to_b64png, b64png_to_array,
+        )
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            _make_grid_b64,
+        )
+
+        imgs = [array_to_b64png(np.full((8, 8, 3), i * 40, np.uint8))
+                for i in range(3)]
+        grid = b64png_to_array(_make_grid_b64(imgs))
+        assert grid.shape == (16, 16, 3)  # 2x2 grid with one empty cell
+        assert grid[0, 0, 0] == 0 and grid[0, 8, 0] == 40
+        assert grid[8, 0, 0] == 80 and grid[8, 8, 0] == 0
+
+
 class TestAuth:
     def test_basic_auth(self):
         srv = ApiServer(make_world(), host="127.0.0.1", port=0,
