@@ -282,6 +282,13 @@ def main(argv: Optional[list] = None) -> int:
         from stable_diffusion_webui_distributed_tpu.runtime import native
 
         native.warm_up()
+        # persistent XLA cache + (optional) multi-host DCN runtime
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            enable_compilation_cache, init_multihost,
+        )
+
+        enable_compilation_cache()
+        init_multihost()
     return args.fn(args)
 
 

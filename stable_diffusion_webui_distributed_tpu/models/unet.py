@@ -77,13 +77,16 @@ class Attention(nn.Module):
     """Self- or cross-attention over flattened spatial tokens.
 
     ``impl``: "xla" (compiler-fused), "flash" (Pallas online-softmax kernel
-    for the latent self-attention hot spot — cross-attention's 77-token
-    context always takes the XLA path).
+    for the latent self-attention hot spot), or "ring" (sequence-parallel
+    over the mesh's ``sp`` axis for token counts beyond one chip — requires
+    ``mesh``). Cross-attention's 77-token context always takes the XLA path,
+    as does any shape the chosen impl can't tile.
     """
 
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     impl: str = "xla"
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
@@ -102,7 +105,17 @@ class Attention(nn.Module):
         q = q.reshape(B, T, self.num_heads, head_dim)
         k = k.reshape(B, ctx_len, self.num_heads, head_dim)
         v = v.reshape(B, ctx_len, self.num_heads, head_dim)
-        if self.impl == "flash" and context is None:
+        sp = (self.mesh.shape.get("sp", 1)
+              if (self.impl == "ring" and self.mesh is not None) else 1)
+        if self.impl == "ring" and context is None and sp > 1 \
+                and T % sp == 0:
+            from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
+                ring_attention,
+            )
+
+            out = ring_attention(q, k, v, self.mesh,
+                                 scale=1.0 / head_dim**0.5)
+        elif self.impl == "flash" and context is None:
             from stable_diffusion_webui_distributed_tpu.ops.flash_attention import (
                 flash_attention,
             )
@@ -132,12 +145,14 @@ class TransformerBlock(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         C = x.shape[-1]
         x = x + Attention(self.num_heads, dtype=self.dtype,
-                          impl=self.attention_impl, name="attn1")(
+                          impl=self.attention_impl, mesh=self.mesh,
+                          name="attn1")(
             nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         )
         x = x + Attention(self.num_heads, dtype=self.dtype, name="attn2")(
@@ -157,6 +172,7 @@ class SpatialTransformer(nn.Module):
     use_remat: bool = False
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -169,7 +185,7 @@ class SpatialTransformer(nn.Module):
             block = nn.remat(TransformerBlock, static_argnums=())
         for i in range(self.depth):
             h = block(self.num_heads, dtype=self.dtype,
-                      attention_impl=self.attention_impl,
+                      attention_impl=self.attention_impl, mesh=self.mesh,
                       name=f"block_{i}")(h, context)
         h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
         return residual + h.reshape(B, H, W, C)
@@ -210,6 +226,7 @@ class UNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_remat: bool = False
     attention_impl: str = "xla"
+    mesh: Optional[object] = None
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -258,7 +275,7 @@ class UNet(nn.Module):
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
-                        self.attention_impl,
+                        self.attention_impl, self.mesh,
                         name=f"down_{level}_attn_{i}")(x, context)
                 skips.append(x)
             if level < len(c.block_out_channels) - 1:
@@ -271,7 +288,8 @@ class UNet(nn.Module):
         if c.mid_block_depth is not None:
             x = SpatialTransformer(
                 c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
-                self.dtype, self.attention_impl, name="mid_attn")(x, context)
+                self.dtype, self.attention_impl, self.mesh,
+                name="mid_attn")(x, context)
         x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
 
         # ControlNet residual injection: one residual per skip + one for the
@@ -296,7 +314,7 @@ class UNet(nn.Module):
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
-                        self.attention_impl,
+                        self.attention_impl, self.mesh,
                         name=f"up_{level}_attn_{i}")(x, context)
             if level > 0:
                 x = Upsample(ch, dtype=self.dtype, name=f"up_{level}_us")(x)

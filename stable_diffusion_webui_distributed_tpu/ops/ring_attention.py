@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _ring_body(q, k, v, axis_name: str, scale: float):
+def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
     """Per-device computation: local Q against the rotating K/V ring."""
     n = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -28,10 +28,11 @@ def _ring_body(q, k, v, axis_name: str, scale: float):
     b, t_loc, h, d = q.shape
     qf = q.astype(jnp.float32) * scale
 
-    # fresh accumulators must be marked device-varying over the ring axis or
-    # the fori_loop carry types disagree under shard_map
+    # fresh accumulators must be marked device-varying over every mesh axis
+    # the inputs vary over (the ring axis, plus dp on combined dp+sp
+    # meshes) or the fori_loop carry types disagree under shard_map
     def varying(x):
-        return lax.pcast(x, axis_name, to="varying")
+        return lax.pcast(x, vary_axes or axis_name, to="varying")
 
     m0 = varying(jnp.full((b, h, t_loc, 1), -jnp.inf, jnp.float32))
     l0 = varying(jnp.zeros((b, h, t_loc, 1), jnp.float32))
@@ -80,10 +81,15 @@ def ring_attention(
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    spec = P(None, axis_name, None, None)
+    # carry the dp axis on the batch dim when the mesh has one — otherwise
+    # shard_map would declare the batch replicated and XLA would all-gather
+    # activations across dp at every layer
+    dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
+    spec = P(dp, axis_name, None, None)
+    vary_axes = (axis_name, dp) if dp else (axis_name,)
 
     def body(q_l, k_l, v_l):
-        return _ring_body(q_l, k_l, v_l, axis_name, scale)
+        return _ring_body(q_l, k_l, v_l, axis_name, scale, vary_axes)
 
     return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -118,9 +118,18 @@ class Engine:
             CLIPTextModel(family.text_encoder_2, dtype=cd)
             if family.text_encoder_2 else None
         )
+        attn_impl = policy.attention_impl
+        attn_mesh = None
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # sequence parallelism: latent-token self-attention rides the
+            # sp ring (ops/ring_attention.py); other impls keep their role
+            # for meshes without an sp axis
+            attn_impl = "ring"
+            attn_mesh = mesh
         self.unet = UNet(family.unet, dtype=cd,
-                         attention_impl=policy.attention_impl,
-                         use_remat=policy.use_remat)
+                         attention_impl=attn_impl,
+                         use_remat=policy.use_remat,
+                         mesh=attn_mesh)
         self.vae = VAE(family.vae, dtype=cd)
 
         self._cache: Dict[Tuple, Callable] = {}
@@ -751,6 +760,11 @@ class Engine:
         init = _resize_image(init, width, height)
         conds, pooleds = self.encode_prompts(payload)
         controls = self._prepare_controls(payload, width, height)
+        # inpainting never uses the refiner (mask pinning is tied to the
+        # base chunk loop) — don't load a refiner checkpoint for it
+        refiner = None if payload.mask is not None \
+            else self._refiner_engine(payload)
+        ref_cond = refiner.encode_prompts(payload) if refiner else None
 
         mask_lat = None
         if payload.mask is not None:
@@ -775,9 +789,18 @@ class Engine:
             x = self._place_batch(
                 init_lat + noise.astype(jnp.float32) * sigmas[start_step])
             keys = self._image_keys(payload, pos, n)
-            latents = self._denoise_range(
-                payload, x, keys, conds, pooleds, width, height,
-                start_step, payload.steps, job, mask_lat, init_lat, controls)
+            if mask_lat is None:
+                # plain img2img honors the refiner switch too (webui does);
+                # inpainting stays base-only — the per-step mask pinning is
+                # tied to the base chunk loop
+                latents = self._split_denoise(
+                    payload, x, keys, conds, pooleds, width, height, job,
+                    controls, refiner, ref_cond, payload.steps, start_step)
+            else:
+                latents = self._denoise_range(
+                    payload, x, keys, conds, pooleds, width, height,
+                    start_step, payload.steps, job, mask_lat, init_lat,
+                    controls)
             pending.append(self._queue_decoded(latents, pos, n, width,
                                                height))
             if len(pending) > 1:  # depth-1 decode pipeline (see txt2img)

@@ -42,7 +42,8 @@ def _default_attention() -> str:
 def _env_flag(name: str) -> bool:
     import os
 
-    return os.environ.get(name, "") not in ("", "0")
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
 
 
 #: Default policy for real TPU runs.

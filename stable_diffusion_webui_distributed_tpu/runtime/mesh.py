@@ -22,6 +22,58 @@ import numpy as np
 AXIS_ORDER = ("dp", "tp", "sp")
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+    """Persist XLA executables across process restarts (first SDXL compile
+    costs ~minutes on TPU; a restarted node re-serves in seconds). The
+    reference's workers pay webui's model-load on every restart with no
+    equivalent escape hatch."""
+    import os
+
+    import jax
+
+    cache_dir = cache_dir or os.environ.get(
+        "SDTPU_XLA_CACHE", os.path.expanduser("~/.cache/sdtpu-xla"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> bool:
+    """Join a multi-host JAX runtime over DCN (``jax.distributed``).
+
+    Within a host, parallelism is the mesh's problem (ICI collectives);
+    across hosts this makes every chip of every host visible to one global
+    mesh — the DCN tier the reference approximates with its HTTP worker
+    pool (SURVEY.md §2 distributed backend). No-ops (returning False) when
+    no coordinator is configured, so single-host flows never pay it.
+    Environment fallbacks: SDTPU_COORDINATOR, SDTPU_NUM_PROCESSES,
+    SDTPU_PROCESS_ID (or the cloud auto-detection jax.distributed ships).
+    """
+    import os
+
+    import jax
+
+    coordinator = coordinator or os.environ.get("SDTPU_COORDINATOR")
+    if not coordinator:
+        return False
+    kwargs = {"coordinator_address": coordinator}
+    num_processes = num_processes if num_processes is not None else \
+        os.environ.get("SDTPU_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else \
+        os.environ.get("SDTPU_PROCESS_ID")
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
 def parse_mesh_spec(spec: Optional[str]) -> Dict[str, int]:
     """'dp=4,tp=2' -> {'dp': 4, 'tp': 2}. Empty/None -> {} (all devices on dp)."""
     if not spec:

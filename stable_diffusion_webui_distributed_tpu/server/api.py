@@ -170,7 +170,9 @@ class ApiServer:
             if model:
                 self.source.current_model = sync_model
             if hasattr(self.source, "current_vae") and vae is not None:
-                self.source.current_vae = sync_vae
+                # store the normalized wire form so the per-job dedupe in
+                # Worker.load_options compares like with like
+                self.source.current_vae = _vae_for_sync(sync_vae)
             if sync_model:
                 self.source.sync_models(sync_model, _vae_for_sync(sync_vae))
         for k, v in body.items():

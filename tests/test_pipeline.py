@@ -205,6 +205,25 @@ class TestMeshEngine:
         # order differences across device boundaries
         assert np.abs(ia - ib).max() <= 1
 
+    def test_sp_mesh_ring_attention_matches(self, engine):
+        """Engine on an sp=4 mesh routes latent self-attention through the
+        ring — output must match the meshless run (sequence parallelism is
+        a placement decision, not a numerics one)."""
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        sharded = Engine(TINY, init_params(TINY), chunk_size=4,
+                         state=GenerationState(), mesh=build_mesh("sp=4"))
+        assert sharded.unet.attention_impl == "ring"
+        p = GenerationPayload(prompt="ring cow", steps=3, width=32,
+                              height=32, batch_size=2, seed=31)
+        a = engine.txt2img(p)
+        b = sharded.txt2img(p)
+        ia = np.stack([decode(x) for x in a.images]).astype(np.int32)
+        ib = np.stack([decode(x) for x in b.images]).astype(np.int32)
+        assert np.abs(ia - ib).max() <= 1
+
     def test_sharded_engine_odd_batch_falls_back(self, engine, mesh8):
         sharded = Engine(TINY, init_params(TINY), chunk_size=4,
                          state=GenerationState(), mesh=mesh8)
