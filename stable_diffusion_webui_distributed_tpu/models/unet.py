@@ -107,8 +107,10 @@ class Attention(nn.Module):
         v = v.reshape(B, ctx_len, self.num_heads, head_dim)
         sp = (self.mesh.shape.get("sp", 1)
               if (self.impl == "ring" and self.mesh is not None) else 1)
+        dp_ok = (self.mesh is None
+                 or B % max(1, self.mesh.shape.get("dp", 1)) == 0)
         if self.impl == "ring" and context is None and sp > 1 \
-                and T % sp == 0:
+                and T % sp == 0 and dp_ok:
             from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
                 ring_attention,
             )

@@ -145,6 +145,16 @@ class Engine:
                 self._cache[key] = fn
         return fn
 
+    def _has_batch_bucket(self, sampler: str, steps: int, width: int,
+                          height: int, batch: int) -> bool:
+        """Is a chunk executable for this (payload, batch) bucket already
+        compiled? Drives the pad-and-drop remainder policy."""
+        with self._cache_lock:
+            return any(
+                k[0] == "chunk" and k[1] == sampler and k[2] == steps
+                and k[3] == width and k[4] == height and k[5] == batch
+                for k in self._cache)
+
     def _encode_fn(self) -> Callable:
         """(te_params, te2_params, ids, weights, clip_skip static) ->
         (context (1, chunks*77, D), pooled). Params are jit ARGUMENTS, never
@@ -646,11 +656,21 @@ class Engine:
         pending = []
         while remaining > 0 and not self.state.flag.interrupted:
             n = min(group, remaining)
+            gen_n = n
+            if n < group and self._has_batch_bucket(
+                    payload.sampler_name, payload.steps, width, height,
+                    group):
+                # pad-and-drop: reuse the already-compiled full-group
+                # executable instead of compiling a remainder bucket (the
+                # TPU replacement for the reference's remainder round-robin,
+                # SURVEY.md §7 layer 5; extra images cost FLOPs once, a new
+                # compile costs minutes)
+                gen_n = group
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
-                pos, n, (h, w, C))
+                pos, gen_n, (h, w, C))
             x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
-            keys = self._image_keys(payload, pos, n)
+            keys = self._image_keys(payload, pos, gen_n)
             latents = self._split_denoise(
                 payload, x, keys, conds, pooleds, width, height, job,
                 controls, refiner, ref_cond, payload.steps, 0)
@@ -819,8 +839,12 @@ class Engine:
     def _queue_decoded(self, latents, pos, n, width, height):
         """Dispatch the VAE decode WITHOUT waiting: the returned device
         array materializes later, so the decode of group i pipelines with
-        the denoise of group i+1 (SURVEY.md §7 hard part #6 overlap)."""
-        decode = self._decode_fn(width, height, n)
+        the denoise of group i+1 (SURVEY.md §7 hard part #6 overlap).
+
+        ``n`` is how many images to KEEP; latents may carry extra
+        pad-and-drop rows — the decode executable is keyed on the actual
+        row count so padded remainders reuse the full-group compile."""
+        decode = self._decode_fn(width, height, latents.shape[0])
         with trace.STATS.timer("vae_decode_dispatch"):
             imgs = decode(self.params["vae"], latents)
         return (imgs, pos, n, width, height)

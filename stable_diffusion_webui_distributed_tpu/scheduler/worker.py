@@ -107,6 +107,9 @@ class WorkerNode:
         self.supported_scripts: Optional[List[str]] = None
         self.model_override: Optional[str] = None  # runtime-only, ui.py:161-171
         self.response_time: Optional[float] = None
+        # free accelerator memory observed at first contact (the reference
+        # queries /memory on a worker's first request, worker.py:319-340)
+        self.free_memory: Optional[int] = None
         self._lock = threading.Lock()
 
     # -- state machine ------------------------------------------------------
@@ -164,6 +167,8 @@ class WorkerNode:
         self.set_state(State.WORKING)
 
         payload = self.filter_payload_scripts(payload)
+        if self.free_memory is None:
+            self._probe_memory()
         predicted = None
         if self.cal.benchmarked:
             try:
@@ -183,6 +188,34 @@ class WorkerNode:
             eta_mod.record_eta_error(self.cal, predicted, elapsed)
         self.set_state(State.IDLE)
         return result
+
+    def _probe_memory(self) -> None:
+        """First-contact memory probe (reference worker.py:319-340): record
+        free accelerator memory, warn when it looks too tight for the
+        workload; failures are non-fatal."""
+        try:
+            info = self.backend.memory_info()
+        except Exception:  # noqa: BLE001
+            self.free_memory = -1
+            return
+        free = None
+        cuda = info.get("cuda") or {}
+        if isinstance(cuda, dict):
+            free = (cuda.get("system") or {}).get("free")
+        if free is None:
+            tpu = info.get("tpu") or {}
+            # devices without memory stats (bytes_limit 0, e.g. CPU test
+            # platforms) don't count as "0 bytes free"
+            devs = [d for d in (tpu.get("devices") or [])
+                    if d.get("bytes_limit", 0) > 0]
+            if devs:
+                free = sum(max(0, d["bytes_limit"]
+                               - d.get("bytes_in_use", 0)) for d in devs)
+        self.free_memory = int(free) if free is not None else -1
+        if 0 <= self.free_memory < 2 << 30:
+            get_logger().warning(
+                "worker '%s' reports only %.1f GiB free accelerator memory",
+                self.label, self.free_memory / (1 << 30))
 
     def interrupt(self) -> None:
         try:
@@ -318,18 +351,20 @@ class LocalBackend:
     def memory_info(self) -> Dict[str, Any]:
         import jax
 
-        out: Dict[str, Any] = {"devices": []}
+        devices = []
         for d in jax.devices():
             try:
                 stats = d.memory_stats() or {}
             except Exception:  # noqa: BLE001 — CPU backends lack stats
                 stats = {}
-            out["devices"].append({
+            devices.append({
                 "id": d.id, "kind": d.device_kind,
                 "bytes_in_use": stats.get("bytes_in_use", 0),
                 "bytes_limit": stats.get("bytes_limit", 0),
             })
-        return out
+        # same shape the sdapi /memory route serves, so _probe_memory
+        # parses local and remote backends identically
+        return {"tpu": {"devices": devices}}
 
 
 @dataclasses.dataclass

@@ -90,6 +90,38 @@ class TestTxt2Img:
         assert part12.images == full.images[1:]
         assert part12.seeds == full.seeds[1:]
 
+    def test_remainder_group_pad_and_drop(self, engine):
+        """7 images at batch_size 2: the final odd group reuses the
+        compiled 2-batch executable (pad-and-drop) and must produce the
+        same images as a clean run."""
+        p = GenerationPayload(prompt="pad", steps=3, width=32, height=32,
+                              batch_size=2, n_iter=4, seed=60)
+        full = engine.txt2img(p)  # 8 images, seeds 60..67
+        p7 = p.model_copy()
+        r7 = engine.generate_range(p7, 0, 7)
+        assert len(r7.images) == 7
+        assert r7.images == full.images[:7]
+        assert r7.seeds == full.seeds[:7]
+
+    def test_flash_attention_engine_end_to_end(self):
+        """The engine with the Pallas flash-attention policy must reproduce
+        the XLA-attention engine's output (interpret mode on CPU)."""
+        from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+
+        params = init_params(TINY)
+        p = GenerationPayload(prompt="f", steps=3, width=32, height=32,
+                              seed=13)
+        xla_eng = Engine(TINY, params, chunk_size=4, state=GenerationState())
+        flash_eng = Engine(
+            TINY, params, chunk_size=4, state=GenerationState(),
+            policy=dtypes.Policy(compute_dtype=np.float32,
+                                 attention_impl="flash"))
+        a = xla_eng.txt2img(p)
+        b = flash_eng.txt2img(p)
+        ia = decode(a.images[0]).astype(np.int32)
+        ib = decode(b.images[0]).astype(np.int32)
+        assert np.abs(ia - ib).max() <= 1
+
     def test_n_iter(self, engine):
         p = GenerationPayload(prompt="y", steps=4, width=32, height=32,
                               batch_size=2, n_iter=2, seed=5)

@@ -250,6 +250,52 @@ class TestExecute:
         assert flaky.state == State.IDLE
 
 
+class TestConcurrency:
+    """Race coverage for the shared World/worker state (SURVEY §5 notes the
+    reference mutates cross-thread without locks; we exercise ours)."""
+
+    def test_parallel_executes_and_sweeps(self):
+        import threading
+
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("a", 10.0))
+        w.add_worker(node("b", 10.0))
+        errors = []
+
+        def do_execute(i):
+            try:
+                r = w.execute(payload(batch_size=3, seed=1000 + i * 10))
+                assert len(r.images) == 3
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def do_sweep():
+            try:
+                for _ in range(5):
+                    w.ping_workers()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=do_execute, args=(i,))
+                   for i in range(4)]
+        threads.append(threading.Thread(target=do_sweep))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+    def test_first_contact_memory_probe(self):
+        w = node("m", 10.0)
+        assert w.free_memory is None
+        w.request(payload(batch_size=1, seed=1), 0, 1)
+        assert w.free_memory is not None  # probed exactly once
+        probed = w.free_memory
+        w.request(payload(batch_size=1, seed=2), 0, 1)
+        assert w.free_memory == probed
+
+
 class TestBenchmark:
     def test_stub_benchmark_records_ipm(self):
         w = node("w", None)
