@@ -331,6 +331,19 @@ class ApiServer:
             return {"stopped_dir": trace.stop_trace()}
         raise ApiError(422, "action must be 'start' or 'stop'")
 
+    def handle_reset_mpe(self) -> Dict[str, Any]:
+        """Clear every worker's ETA error history (the reference's
+        debug-mode 'reset mpe' button, ui.py:282-287)."""
+        cleared = []
+        if hasattr(self.source, "workers"):
+            for w in self.source.workers:
+                if w.cal.eta_percent_error:
+                    w.cal.eta_percent_error.clear()
+                    cleared.append(w.label)
+            if hasattr(self.source, "save_config"):
+                self.source.save_config()
+        return {"cleared": cleared}
+
     def handle_panel(self) -> str:
         from stable_diffusion_webui_distributed_tpu.server.panel import (
             PANEL_HTML,
@@ -344,6 +357,7 @@ class ApiServer:
             ("GET", ""): self.handle_panel,
             ("GET", "/internal/status"): self.handle_internal_status,
             ("POST", "/internal/profile"): self.handle_profile,
+            ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
             ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,
             ("POST", "/sdapi/v1/img2img"): self.handle_img2img,
             ("GET", "/sdapi/v1/options"): self.handle_options_get,

@@ -119,6 +119,13 @@ class TestRoutes:
         out = call(server, "/internal/status")
         assert out["timings"]["unit-test-stage"]["count"] >= 1
 
+    def test_reset_mpe(self, server):
+        w = server.source.workers[0]
+        w.cal.eta_percent_error.extend([5.0, -3.0])
+        out = call(server, "/internal/reset-mpe", {})
+        assert out["cleared"] == ["m"]
+        assert w.cal.eta_percent_error == []
+
     def test_profile_endpoint_validates(self, server):
         with pytest.raises(urllib.error.HTTPError) as e:
             call(server, "/internal/profile", {"action": "bogus"})
