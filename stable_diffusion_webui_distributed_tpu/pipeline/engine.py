@@ -746,7 +746,8 @@ class Engine:
         start2 = steps2 - t_enc
 
         n, _, _, C = latents.shape
-        up = jax.image.resize(latents, (n, th // f, tw // f, C), "bilinear")
+        up = jax.image.resize(latents, (n, th // f, tw // f, C),
+                              _latent_resize_method(payload.hr_upscaler))
         # Fresh per-image noise for the second pass, disjoint from both the
         # init-noise stream and the sampler's ancestral stream.
         def hr_noise(k):
@@ -870,6 +871,27 @@ class Engine:
                 payload, int(seed_i), int(sub_i), self.model_name,
                 width, height))
             out.worker_labels.append("")
+
+
+def _latent_resize_method(hr_upscaler: str) -> str:
+    """webui latent-upscaler names -> jax.image.resize methods. Non-latent
+    upscalers (ESRGAN-family model files) aren't shipped; those names fall
+    back to bilinear latent upscaling with a log line — the
+    degraded-capability pattern (reference worker.py:457-467)."""
+    name = (hr_upscaler or "Latent").lower()
+    if "latent" in name:
+        if "nearest" in name:
+            return "nearest"
+        if "bicubic" in name:
+            return "cubic"
+        return "linear"
+    from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+        get_logger,
+    )
+
+    get_logger().warning(
+        "hires upscaler '%s' unavailable; using latent bilinear", hr_upscaler)
+    return "linear"
 
 
 def _resize_image(img: np.ndarray, width: int, height: int) -> np.ndarray:

@@ -336,12 +336,15 @@ class ApiServer:
         debug-mode 'reset mpe' button, ui.py:282-287)."""
         cleared = []
         if hasattr(self.source, "workers"):
-            for w in self.source.workers:
-                if w.cal.eta_percent_error:
-                    w.cal.eta_percent_error.clear()
-                    cleared.append(w.label)
-            if hasattr(self.source, "save_config"):
-                self.source.save_config()
+            # under _busy: save_config must not interleave with the
+            # end-of-generation save (both write the same .tmp file)
+            with self._busy:
+                for w in self.source.workers:
+                    if w.cal.eta_percent_error:
+                        w.cal.eta_percent_error.clear()
+                        cleared.append(w.label)
+                if hasattr(self.source, "save_config"):
+                    self.source.save_config()
         return {"cleared": cleared}
 
     def handle_panel(self) -> str:

@@ -191,6 +191,18 @@ class TestImg2Img:
         r = engine.txt2img(p)
         assert decode(r.images[0]).shape == (64, 64, 3)
 
+    def test_hires_upscaler_variants(self, engine):
+        base = dict(prompt="h", steps=3, width=32, height=32, seed=4,
+                    enable_hr=True, hr_scale=2.0, denoising_strength=0.7)
+        bilinear = engine.txt2img(GenerationPayload(**base))
+        nearest = engine.txt2img(GenerationPayload(
+            **base, hr_upscaler="Latent (nearest)"))
+        assert nearest.images[0] != bilinear.images[0]
+        # unknown model-based upscaler falls back to latent bilinear
+        fallback = engine.txt2img(GenerationPayload(
+            **base, hr_upscaler="R-ESRGAN 4x+"))
+        assert fallback.images[0] == bilinear.images[0]
+
 
 class TestXL:
     def test_txt2img(self, engine_xl):
