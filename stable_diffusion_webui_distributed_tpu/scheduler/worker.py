@@ -470,6 +470,14 @@ class HTTPBackend:
         body["n_iter"] = 1
         route = "img2img" if payload.init_images else "txt2img"
         r = self.session.post(self.url(route), json=body, timeout=3600)
+        if r.status_code == 404 and "sampler" in r.text.lower():
+            # legacy remote doesn't know this sampler: retry with Euler a,
+            # the reference's degraded-capability fallback (worker.py:457-467)
+            get_logger().warning(
+                "remote %s:%d lacks sampler '%s'; retrying with Euler a",
+                self.address, self.port, body.get("sampler_name"))
+            body["sampler_name"] = "Euler a"
+            r = self.session.post(self.url(route), json=body, timeout=3600)
         r.raise_for_status()
         data = r.json()
         result = GenerationResult(images=data.get("images", []))

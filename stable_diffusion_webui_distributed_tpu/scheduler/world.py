@@ -167,14 +167,29 @@ class World:
         share = self.default_batch_size(payload.total_images)
         total = payload.total_images
 
-        # phase 1: stall detection — defer slow backends
+        # phase 1: stall detection — defer slow backends. The base share is
+        # also clamped to each worker's pixel cap (the reference only guards
+        # *additional* work, world.py:62-72, letting the equal split itself
+        # exceed the cap — an oversight we fix; overflow joins the deferred
+        # pool for redistribution)
+        per_image_px = payload.width * payload.height
         deferred = 0
         checked = 0
         for job in self.jobs:
-            lag = self.job_stall(job.worker, payload, batch_size=share)
+            cap = job.worker.pixel_cap
+            fit = share if cap <= 0 else min(share, cap // per_image_px)
+            # stall is judged on what the worker would actually run — a
+            # slow-but-capped worker may well finish its small clamped
+            # batch inside the timeout
+            lag = self.job_stall(job.worker, payload,
+                                 batch_size=fit if fit > 0 else share)
             if lag < self.job_timeout or lag == 0:
-                job.batch_size = share
-                checked += share
+                job.batch_size = fit
+                checked += fit
+                deferred += share - fit
+                if cap > 0 and fit == 0 and share > 0:
+                    # cap too small for even one image of this request
+                    job.complementary = True
                 continue
             log.debug("worker '%s' would stall the gallery by ~%.2fs; "
                       "deferring", job.worker.label, lag)
@@ -272,11 +287,22 @@ class World:
         return self.jobs
 
     def plan(self, payload: GenerationPayload) -> List[Job]:
-        """make_jobs + optimize_jobs (reference update(), world.py:394-403)."""
+        """make_jobs + optimize_jobs (reference update(), world.py:394-403).
+
+        Raises instead of silently planning zero images when the request
+        cannot be placed (e.g. every worker's pixel cap is below one image
+        of this resolution) — an empty gallery must be an error, not a 200.
+        """
         self.make_jobs(payload)
         if not self.jobs:
             raise RuntimeError("no benchmarked, reachable backends")
-        return self.optimize_jobs(payload)
+        jobs = self.optimize_jobs(payload)
+        if payload.total_images > 0 and not any(
+                j.batch_size > 0 for j in jobs):
+            raise RuntimeError(
+                "no backend can accept this request (pixel caps below one "
+                f"image at {payload.width}x{payload.height}?)")
+        return jobs
 
     # -- execution ----------------------------------------------------------
 

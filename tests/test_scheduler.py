@@ -199,6 +199,31 @@ class TestOptimizer:
         assert comp and comp[0].step_override is not None
         assert 0 < comp[0].step_override < 20
 
+    def test_unplaceable_request_raises(self):
+        """Every cap below one image: plan() must raise, not quietly return
+        an empty gallery."""
+        w = self.make_world(node("m", 10.0, master=True,
+                                 pixel_cap=100_000))  # < one 512x512 image
+        with pytest.raises(RuntimeError, match="pixel caps"):
+            w.plan(payload(batch_size=4))
+
+    def test_slow_capped_worker_keeps_its_clamped_batch(self):
+        """A slow worker whose cap limits it to a small batch is judged on
+        THAT batch's stall, not the uncapped share (improvement the
+        invariant sweep surfaced)."""
+        w = self.make_world(
+            node("m", 60.0, master=True),
+            node("slowcap", 6.0, pixel_cap=1 * 512 * 512))
+        w.job_timeout = 15
+        w.complement_production = False
+        jobs = w.plan(payload(batch_size=8))
+        by_label = {j.worker.label: j for j in jobs}
+        # share=4: uncapped stall would be 40s-4s >> 15s and defer it; the
+        # clamped single image takes 10s vs fastest 4s -> stall 6s < 15s
+        assert "slowcap" in by_label
+        assert by_label["slowcap"].batch_size == 1
+        assert by_label["m"].batch_size == 7
+
     def test_unavailable_worker_excluded(self):
         a, b = node("m", 10.0, master=True), node("b", 10.0)
         w = self.make_world(a, b)
@@ -206,6 +231,54 @@ class TestOptimizer:
         jobs = w.plan(payload(batch_size=4))
         assert len(jobs) == 1 and jobs[0].worker is a
         assert jobs[0].batch_size == 4
+
+
+class TestOptimizerInvariants:
+    """Property-style sweep: random fleets and workloads, invariants that
+    must hold for EVERY plan (the optimizer is deterministic given speeds,
+    payload, timeout, caps — SURVEY.md §4 test strategy)."""
+
+    def test_random_scenarios(self):
+        import random
+
+        rng = random.Random(42)
+        for trial in range(60):
+            n_workers = rng.randint(1, 6)
+            total = rng.randint(1, 24)
+            w = World(ConfigModel())
+            w.job_timeout = rng.choice([1, 3, 10])
+            w.complement_production = rng.random() < 0.7
+            w.step_scaling = rng.random() < 0.3
+            for i in range(n_workers):
+                cap = rng.choice([0, 0, 0, 2 * 512 * 512, 6 * 512 * 512])
+                w.add_worker(node(f"w{i}", rng.uniform(0.5, 60.0),
+                                  master=(i == 0), pixel_cap=cap))
+            p = payload(batch_size=total, steps=rng.choice([10, 20, 40]))
+            jobs = w.plan(p)
+            ctx = f"trial {trial}: {[(j.worker.label, j.batch_size, j.complementary) for j in jobs]}"
+
+            realtime_total = sum(j.batch_size for j in jobs
+                                 if not j.complementary)
+            # realtime jobs never overshoot the request
+            assert realtime_total <= total, ctx
+            # every surviving job carries work
+            assert all(j.batch_size >= 1 for j in jobs), ctx
+            # pixel caps respected by every realtime job's assignment
+            for j in jobs:
+                if j.worker.pixel_cap > 0 and not j.complementary:
+                    assert j.batch_size * p.width * p.height \
+                        <= j.worker.pixel_cap, ctx
+            # ranges are contiguous and non-overlapping from 0
+            starts = sorted((j.start_index, j.batch_size) for j in jobs)
+            pos = 0
+            for s, b in starts:
+                assert s == pos, ctx
+                pos += b
+            # step overrides only appear with step scaling on, and reduced
+            for j in jobs:
+                if j.step_override is not None:
+                    assert w.step_scaling and j.complementary, ctx
+                    assert 0 < j.step_override < p.steps, ctx
 
 
 class TestExecute:

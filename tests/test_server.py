@@ -228,6 +228,44 @@ class TestLoopClosure:
         assert r.seeds == [300, 301, 302]
         assert all("Worker Label: remote" in t for t in r.infotexts)
 
+    def test_sampler_404_retries_with_euler_a(self):
+        """A legacy remote that 404s an unknown sampler gets one retry with
+        Euler a (reference worker.py:457-467)."""
+        import http.server
+        import threading
+
+        seen = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def do_POST(self):
+                body = json.loads(self.rfile.read(
+                    int(self.headers["Content-Length"])))
+                seen.append(body["sampler_name"])
+                if len(seen) == 1:
+                    payload = b'{"detail": "Sampler not found"}'
+                    self.send_response(404)
+                else:
+                    payload = json.dumps(
+                        {"images": ["ok"], "info": "{}"}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            backend = HTTPBackend("127.0.0.1", httpd.server_port)
+            result = backend.generate(GenerationPayload(
+                prompt="x", sampler_name="Fancy New Sampler", seed=1), 0, 1)
+            assert result.images == ["ok"]
+            assert seen == ["Fancy New Sampler", "Euler a"]
+        finally:
+            httpd.shutdown()
+
     def test_models_and_options_via_backend(self, server):
         backend = HTTPBackend("127.0.0.1", server.port)
         assert isinstance(backend.available_models(), list)
