@@ -576,6 +576,36 @@ class Engine:
         return jax.vmap(
             lambda i: rng.key_for_image(payload.seed, i))(idx)
 
+    def _apply_inpaint_fill(self, payload, init_lat, mask_lat, image_keys):
+        """webui ``inpainting_fill`` masked-content modes (the enum the
+        reference ships untouched in payloads): 1 = original (default),
+        0 = fill with the unmasked region's mean color, 2 = latent noise,
+        3 = latent nothing (zeros)."""
+        fill = payload.inpainting_fill
+        if mask_lat is None or fill == 1:
+            return init_lat
+        m = mask_lat  # 1 = repaint
+        if fill == 3:
+            return init_lat * (1.0 - m)
+        if fill == 2:
+            def fill_noise(k):
+                return jax.random.normal(
+                    jax.random.fold_in(k, 3_000_000), init_lat.shape[1:],
+                    jnp.float32)
+
+            # UNIT-variance fill (webui create_random_tensors): the img2img
+            # loop adds sigma-scaled sampling noise on top, landing the
+            # masked region at std sqrt(1+sigma^2) like webui
+            extra = jax.vmap(fill_noise)(image_keys)
+            return init_lat * (1.0 - m) + m * extra
+        if fill == 0:
+            keep = jnp.maximum(1e-6, (1.0 - m).sum(axis=(1, 2),
+                                                   keepdims=True))
+            mean = (init_lat * (1.0 - m)).sum(axis=(1, 2),
+                                              keepdims=True) / keep
+            return init_lat * (1.0 - m) + m * mean
+        return init_lat
+
     def _denoise(self, payload, x, image_keys, conds, pooleds, width, height,
                  start_step, steps, job, controls=()):
         return self._denoise_range(payload, x, image_keys, conds, pooleds,
@@ -791,9 +821,15 @@ class Engine:
         if payload.mask is not None:
             m = b64png_to_array(payload.mask).astype(np.float32) / 255.0
             m = _resize_image(m, width, height)[..., :1]
+            if payload.mask_blur > 0:
+                # soften the seam (webui gaussian-blurs the pixel mask by
+                # mask_blur); the soft values survive into the latent mask
+                # so per-step pinning blends smoothly at the boundary
+                m = _box_blur(m, payload.mask_blur)
             mask_lat = jnp.asarray(
-                np.asarray(jax.image.resize(m, (h, w, 1), "bilinear")) > 0.5,
+                np.asarray(jax.image.resize(m, (h, w, 1), "bilinear")),
                 jnp.float32)[None]
+            mask_lat = jnp.clip(mask_lat * 1.02, 0.0, 1.0)  # keep core at 1
 
         out = GenerationResult(parameters=payload.model_dump())
         group = max(1, payload.batch_size)
@@ -804,12 +840,14 @@ class Engine:
             enc = self._encode_image_fn(width, height, n)
             init_lat = enc(self.params["vae"],
                            jnp.asarray(init)[None].repeat(n, axis=0))
+            keys = self._image_keys(payload, pos, n)
+            init_lat = self._apply_inpaint_fill(
+                payload, init_lat, mask_lat, keys)
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
                 pos, n, init_lat.shape[1:])
             x = self._place_batch(
                 init_lat + noise.astype(jnp.float32) * sigmas[start_step])
-            keys = self._image_keys(payload, pos, n)
             if mask_lat is None:
                 # plain img2img honors the refiner switch too (webui does);
                 # inpainting stays base-only — the per-step mask pinning is
@@ -871,6 +909,19 @@ class Engine:
                 payload, int(seed_i), int(sub_i), self.model_name,
                 width, height))
             out.worker_labels.append("")
+
+
+def _box_blur(img: np.ndarray, radius: int) -> np.ndarray:
+    """Three separable box passes ~ gaussian blur of the given radius."""
+    k = 2 * max(1, int(radius)) + 1
+    kernel = np.ones(k, np.float32) / k
+    out = img.astype(np.float32)
+    for _ in range(3):
+        out = np.apply_along_axis(
+            lambda r: np.convolve(r, kernel, "same"), 0, out)
+        out = np.apply_along_axis(
+            lambda r: np.convolve(r, kernel, "same"), 1, out)
+    return out
 
 
 def _latent_resize_method(hr_upscaler: str) -> str:

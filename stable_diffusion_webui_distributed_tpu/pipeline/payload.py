@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import io
+import re
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -94,6 +95,70 @@ class GenerationResult(BaseModel):
         self.negative_prompts.extend(other.negative_prompts)
         self.infotexts.extend(other.infotexts)
         self.worker_labels.extend(other.worker_labels)
+
+
+_INFOTEXT_FIELD_RE = re.compile(r'\s*([\w ]+):\s*("(?:\\.|[^"])*"|[^,]*)(?:,|$)')
+
+#: infotext key -> payload field + parser (webui parameter-text grammar).
+_INFOTEXT_KEYS = {
+    "steps": ("steps", int),
+    "sampler": ("sampler_name", str),
+    "cfg scale": ("cfg_scale", float),
+    "seed": ("seed", int),
+    "variation seed": ("subseed", int),
+    "variation seed strength": ("subseed_strength", float),
+    "denoising strength": ("denoising_strength", float),
+    "clip skip": ("clip_skip", int),
+}
+
+
+def parse_infotext(text: str) -> "GenerationPayload":
+    """Generation-parameters text -> payload (the "send to txt2img"
+    round-trip; webui's ``parse_generation_parameters``). The reference
+    rewrites these strings per gallery image (distributed.py:343-349) and
+    relies on webui to read them back; here the framework owns both sides,
+    so ``parse_infotext(build_infotext(p, ...))`` reproduces ``p``'s core
+    fields — including any ``<lora:...>`` tags kept in the prompt."""
+    lines = text.split("\n")
+    # only the LAST line can be the parameter list (webui grammar); prompt
+    # text containing "Steps: 3 of the ritual" must survive the round trip
+    params_line = ""
+    if lines and re.match(r"^Steps: \d+", lines[-1].strip()):
+        params_line = lines.pop()
+    prompt_lines: List[str] = []
+    neg_lines: List[str] = []
+    in_negative = False
+    for line in lines:
+        if not in_negative and line.startswith("Negative prompt:"):
+            in_negative = True
+            neg_lines.append(line[len("Negative prompt:"):].strip())
+        elif in_negative:
+            # multi-line negative prompts continue until the params line
+            neg_lines.append(line)
+        else:
+            prompt_lines.append(line)
+    payload = GenerationPayload(
+        prompt="\n".join(prompt_lines).strip(),
+        negative_prompt="\n".join(neg_lines).strip())
+    for m in _INFOTEXT_FIELD_RE.finditer(params_line):
+        key = m.group(1).strip().lower()
+        value = m.group(2).strip().strip('"')
+        if key == "size" and "x" in value:
+            w, _, h = value.partition("x")
+            try:
+                payload.width, payload.height = int(w), int(h)
+            except ValueError:
+                pass
+            continue
+        target = _INFOTEXT_KEYS.get(key)
+        if target is None:
+            continue
+        field, conv = target
+        try:
+            setattr(payload, field, conv(value))
+        except ValueError:
+            pass
+    return payload
 
 
 def fix_seed(seed: Optional[int]) -> int:

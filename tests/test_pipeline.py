@@ -191,6 +191,60 @@ class TestImg2Img:
         r = engine.txt2img(p)
         assert decode(r.images[0]).shape == (64, 64, 3)
 
+    def test_inpaint_fill_modes(self, engine):
+        """webui inpainting_fill enum: original/latent-noise/latent-nothing/
+        fill all produce valid, distinct repaints; the unmasked region stays
+        pinned in every mode."""
+        src = GenerationPayload(prompt="s", steps=4, width=32, height=32,
+                                seed=1)
+        base_img = engine.txt2img(src).images[0]
+        from PIL import Image
+
+        m = np.zeros((32, 32, 3), np.uint8)
+        m[:, :16] = 255
+        buf = io.BytesIO()
+        Image.fromarray(m).save(buf, format="PNG")
+        mask_b64 = base64.b64encode(buf.getvalue()).decode()
+
+        outs = {}
+        for fill in (1, 2, 3, 0):
+            p = GenerationPayload(prompt="s", steps=6, width=32, height=32,
+                                  seed=3, init_images=[base_img],
+                                  mask=mask_b64, mask_blur=0,
+                                  denoising_strength=0.9,
+                                  inpainting_fill=fill)
+            r = engine.img2img(p)
+            outs[fill] = decode(r.images[0]).astype(np.int32)
+            orig = decode(base_img).astype(np.int32)
+            # pinned (right) side must move less than the repainted left
+            right_diff = np.abs(outs[fill][:, 20:] - orig[:, 20:]).mean()
+            left_diff = np.abs(outs[fill][:, :12] - orig[:, :12]).mean()
+            assert right_diff < left_diff, (fill, right_diff, left_diff)
+        assert not np.array_equal(outs[1], outs[3])  # nothing != original
+        assert not np.array_equal(outs[1], outs[2])  # noise != original
+
+    def test_infotext_round_trip(self):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            build_infotext, parse_infotext,
+        )
+
+        p = GenerationPayload(
+            prompt="a (red:1.3) cow <lora:style:0.8>\nSteps: 3 of the "
+                   "ritual\nsecond line",
+            negative_prompt="ugly, blurry\nlowres second line",
+            steps=25, width=640, height=512, seed=1234,
+            sampler_name="DPM++ 2M Karras", cfg_scale=5.5,
+            subseed=99, subseed_strength=0.4)
+        text = build_infotext(p, p.seed, p.subseed, "model-x")
+        back = parse_infotext(text)
+        assert back.prompt == p.prompt
+        assert back.negative_prompt == p.negative_prompt
+        assert (back.steps, back.width, back.height) == (25, 640, 512)
+        assert back.sampler_name == "DPM++ 2M Karras"
+        assert back.cfg_scale == 5.5
+        assert (back.seed, back.subseed) == (1234, 99)
+        assert back.subseed_strength == 0.4
+
     def test_hires_upscaler_variants(self, engine):
         base = dict(prompt="h", steps=3, width=32, height=32, seed=4,
                     enable_hr=True, hr_scale=2.0, denoising_strength=0.7)
