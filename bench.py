@@ -1,116 +1,436 @@
-"""Benchmark: BASELINE config #1 on the real TPU chip.
+"""Benchmark: the five BASELINE.md configs on the real TPU chip.
 
 Protocol is the reference's own self-benchmark
 (/root/reference/scripts/spartan/worker.py:506-575, shared.py:63-77):
-the fixed "herd of cows" payload — SD 1.5 txt2img, 512x512, 20 steps,
-Euler a, batch 1 — measured as 2 warmup + 3 recorded samples, metric
-images-per-minute (ipm = batch / (seconds/60), worker.py:522-533).
+2 warmup + 3 recorded samples, metric images-per-minute
+(ipm = batch / (seconds/60), worker.py:522-533). Config #1 is the
+reference's fixed "herd of cows" calibration payload; configs #2-#5 extend
+the same protocol to BASELINE.md's target workloads:
 
-Weights are zero-initialized SD 1.5 architecture: throughput is
+  1  SD 1.5 txt2img 512x512, 20 steps Euler a, batch 1        (default)
+  2  SDXL base+refiner txt2img 1024x1024, 30 steps, batch 8
+  3  SD 1.5 img2img + ControlNet canny, 512x512, batch 4
+  4  SDXL txt2img with 3 stacked LoRA adapters, batch 4
+  5  SDXL hires-fix two-pass (1024 -> latent 2x -> img2img), batch 1
+
+Weights are zero-initialized architectures: throughput is
 weight-value-independent (same graphs, same FLOPs), and the image has no
 network egress to fetch trained checkpoints.
 
-Prints exactly ONE JSON line on stdout. ``vs_baseline`` compares against a
-nominal 30 ipm — the ballpark a single CUDA sdwui worker of the reference's
-era sustains on this payload (the reference publishes no numbers at all,
-BASELINE.md; its ipm is measured per-installation).
+Prints exactly ONE JSON line on stdout. ``vs_baseline`` compares ipm
+against a nominal 30 ipm for config #1 — the ballpark a single CUDA sdwui
+worker of the reference's era sustains on that payload (the reference
+publishes no numbers at all, BASELINE.md) — scaled for the other configs
+by their step/pixel cost relative to config #1 using the reference's own
+ETA arithmetic (worker.py:230-286). Extra keys: per-image p50 latency,
+images/sec/chip, and a UNet-FLOPs MFU estimate against the chip's peak.
+
+Env knobs: SDTPU_BENCH_TINY=1 (tiny logic-check mode for CPU-only runs),
+SDTPU_BENCH_INIT_TIMEOUT (seconds before a wedged TPU claim aborts with a
+clear error instead of hanging into the driver's timeout; default 480).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
+import threading
 import time
 
 NOMINAL_SINGLE_GPU_IPM = 30.0
 
+# bf16 peak FLOPs/s per chip, by device_kind substring (public specs).
+_PEAK_FLOPS = {
+    "v6e": 918e12, "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5": 197e12,
+    "v4": 275e12,
+}
 
-def main() -> None:
-    import os
 
+def _peak_for(device_kind: str):
+    dk = device_kind.lower().replace(" ", "")
+    for key, val in _PEAK_FLOPS.items():
+        if key in dk:
+            return val
+    return None
+
+
+def _start_init_watchdog():
+    """Abort with a readable error if TPU backend init wedges on the chip
+    claim (the relay has been seen to hang indefinitely; rc=3 + stderr beats
+    the driver's opaque kill)."""
+    timeout = float(os.environ.get("SDTPU_BENCH_INIT_TIMEOUT", "480"))
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout):
+            print(
+                f"bench: FATAL: jax backend init did not complete within "
+                f"{timeout:.0f}s — TPU claim relay wedged? "
+                "(see memory: axon chip claim has no client timeout)",
+                file=sys.stderr, flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done
+
+
+def _zeros(mod, *args):
     import jax
     import jax.numpy as jnp
 
-    from stable_diffusion_webui_distributed_tpu.models.configs import SD15, TINY
-    from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
-    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
-        GenerationPayload,
-    )
-    from stable_diffusion_webui_distributed_tpu.runtime import dtypes
-    from stable_diffusion_webui_distributed_tpu.runtime.config import (
-        BenchmarkPayload,
-        WARMUP_SAMPLES,
-        RECORDED_SAMPLES,
-    )
+    shapes = jax.eval_shape(lambda: mod.init(jax.random.key(0), *args))
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)["params"]
 
-    dev = jax.devices()[0]
-    print(f"bench: device={dev.device_kind} platform={dev.platform}",
-          file=sys.stderr)
 
-    # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
-    # (same protocol and code path, tiny model + payload; NOT a perf claim).
-    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
-    family = TINY if tiny else SD15
-    zeros = lambda mod, *args: jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype),
-        jax.eval_shape(lambda: mod.init(jax.random.key(0), *args)))["params"]
+def _family_params(family):
+    """Zero-init the full component dict for one model family."""
+    import jax
+    import jax.numpy as jnp
 
     from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
     from stable_diffusion_webui_distributed_tpu.models.unet import UNet
     from stable_diffusion_webui_distributed_tpu.models.vae import VAE
 
-    t0 = time.time()
     ids = jnp.zeros((1, 77), jnp.int32)
-    # init spatial dims are irrelevant to param shapes — keep them minimal
-    params = {
-        "text_encoder": zeros(CLIPTextModel(family.text_encoder), ids),
-        "text_encoder_2": None,
-        "unet": zeros(
-            UNet(family.unet),
-            jnp.zeros((2, 16, 16, 4)), jnp.ones((2,)),
-            jnp.zeros((2, 77, family.unet.cross_attention_dim))),
-        "vae": zeros(
-            VAE(family.vae),
-            jnp.zeros((1, 64, 64, 3)), jax.random.key(1)),
+    ucfg = family.unet
+    uargs = [jnp.zeros((2, 16, 16, ucfg.in_channels)), jnp.ones((2,)),
+             jnp.zeros((2, 77, ucfg.cross_attention_dim))]
+    if ucfg.addition_embed_dim:
+        from stable_diffusion_webui_distributed_tpu.models.unet import (
+            make_added_cond,
+        )
+
+        # 6 time ids for the base model, 5 for the refiner (aesthetic
+        # score replaces target size) — derive from the projection width
+        n_ids = ((ucfg.projection_input_dim - ucfg.addition_embed_dim)
+                 // ucfg.addition_time_embed_dim)
+        uargs.append(make_added_cond(
+            jnp.zeros((2, ucfg.addition_embed_dim)),
+            jnp.zeros((2, n_ids)), ucfg.addition_time_embed_dim))
+    return {
+        "text_encoder": _zeros(CLIPTextModel(family.text_encoder), ids),
+        "text_encoder_2": (_zeros(CLIPTextModel(family.text_encoder_2), ids)
+                           if family.text_encoder_2 else None),
+        "unet": _zeros(UNet(ucfg), *uargs),
+        "vae": _zeros(VAE(family.vae),
+                      jnp.zeros((1, 64, 64, 3)), jax.random.key(1)),
     }
-    print(f"bench: zero-init params in {time.time()-t0:.1f}s", file=sys.stderr)
 
-    engine = Engine(family, params, policy=dtypes.TPU,
-                    model_name=f"{family.name}-bench")
 
-    bp = BenchmarkPayload()  # the reference's fixed calibration workload
-    if tiny:
-        bp = BenchmarkPayload(width=64, height=64, steps=4)
-    payload = GenerationPayload(
-        prompt=bp.prompt, negative_prompt=bp.negative_prompt, steps=bp.steps,
-        width=bp.width, height=bp.height, batch_size=bp.batch_size,
-        sampler_name=bp.sampler_name, seed=1,
+def _make_engine(family, refiner_family=None, lora_names=(),
+                 controlnet=False):
+    import jax
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+    from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+
+    policy = dtypes.TPU if jax.devices()[0].platform != "cpu" else dtypes.F32
+
+    t0 = time.time()
+    params = _family_params(family)
+    print(f"bench: zero-init {family.name} params in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    lora_provider = None
+    if lora_names:
+        loras = {n: _stack_lora(family, params, seed=i)
+                 for i, n in enumerate(lora_names)}
+        lora_provider = loras.get
+
+    controlnet_provider = None
+    if controlnet:
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            ControlNet,
+        )
+        import jax.numpy as jnp
+
+        ucfg = family.unet
+        cargs = [jnp.zeros((1, 8, 8, ucfg.in_channels)), jnp.ones((1,)),
+                 jnp.zeros((1, 77, ucfg.cross_attention_dim)),
+                 jnp.zeros((1, 64, 64, 3))]
+        cn_params = _zeros(ControlNet(ucfg), *cargs)
+        controlnet_provider = lambda name: cn_params
+
+    engines = {}
+
+    def engine_provider(name):
+        return engines.get(name)
+
+    engine = Engine(family, params, policy=policy,
+                    model_name=f"{family.name}-bench",
+                    lora_provider=lora_provider,
+                    controlnet_provider=controlnet_provider,
+                    engine_provider=engine_provider)
+    if refiner_family is not None:
+        engines["refiner"] = Engine(
+            refiner_family, _family_params(refiner_family), policy=policy,
+            model_name=f"{refiner_family.name}-bench")
+    return engine
+
+
+def _stack_lora(family, params, rank=8, seed=0):
+    """Synthetic kohya-format adapter hitting every resolvable attention
+    q projection of this family's UNet (valid keys found by probing the
+    real key resolver, so this works for SD1.5, SDXL, and TINY alike)."""
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for i in range(12):
+        for attn in ("attn1", "attn2"):
+            mod = (f"lora_unet_input_blocks_{i}_1_transformer_blocks_0_"
+                   f"{attn}_to_q")
+            hit = lora_mod._resolve_unet_key(mod, family.unet)
+            if hit is None:
+                continue
+            path, _ = hit
+            leaf = params["unet"]
+            for p in path:
+                leaf = leaf[p]
+            d = int(leaf["kernel"].shape[0])
+            sd[f"{mod}.lora_down.weight"] = (
+                rng.standard_normal((rank, d)).astype("float32") * 0.01)
+            sd[f"{mod}.lora_up.weight"] = (
+                rng.standard_normal((d, rank)).astype("float32") * 0.01)
+            sd[f"{mod}.alpha"] = np.float32(rank)
+    return sd
+
+
+def _synth_b64_image(width, height):
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        array_to_b64png,
     )
+
+    y, x = np.mgrid[0:height, 0:width]
+    img = np.stack([x % 256, y % 256, (x + y) % 256], axis=-1)
+    return array_to_b64png(img.astype(np.uint8))
+
+
+def _controlnet_scripts(image_b64):
+    return {"controlnet": {"args": [{
+        "enabled": True, "image": image_b64, "module": "canny",
+        "model": "canny-bench", "weight": 1.0,
+    }]}}
+
+
+def _build_config(n, tiny):
+    """-> (metric_name, engine, payload, flop_segments, rel_cost).
+
+    ``flop_segments``: [(engine_for_unet, batch, width, height, steps)] used
+    for the UNet cost-analysis MFU estimate. ``rel_cost`` scales the nominal
+    config-#1 baseline ipm by the reference's ETA arithmetic
+    (steps/20 * pixels/512^2, worker.py:230-286) for vs_baseline.
+    """
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        BenchmarkPayload,
+    )
+
+    sd, xl, rf = ((C.TINY, C.TINY_XL, C.TINY_REFINER) if tiny
+                  else (C.SD15, C.SDXL_BASE, C.SDXL_REFINER))
+    size_sd = 64 if tiny else 512
+    size_xl = 64 if tiny else 1024
+    steps_sd = 4 if tiny else 20
+    steps_xl = 4 if tiny else 30
+    prefix = "tiny_" if tiny else ""
+
+    bp = BenchmarkPayload()
+    if n == 1:
+        engine = _make_engine(sd)
+        payload = GenerationPayload(
+            prompt=bp.prompt, negative_prompt=bp.negative_prompt,
+            steps=steps_sd, width=size_sd, height=size_sd,
+            batch_size=1, sampler_name=bp.sampler_name, seed=1)
+        name = ("tiny_logiccheck_ipm" if tiny
+                else "sd15_512x512_20step_euler_a_ipm")
+        return (name, engine, payload,
+                [(engine, 1, size_sd, size_sd, steps_sd)], 1.0)
+    if n == 2:
+        batch = 2 if tiny else 8
+        engine = _make_engine(xl, refiner_family=rf)
+        payload = GenerationPayload(
+            prompt=bp.prompt, steps=steps_xl, width=size_xl, height=size_xl,
+            batch_size=batch, sampler_name=bp.sampler_name, seed=1,
+            refiner_checkpoint="refiner", refiner_switch_at=0.8)
+        switch = int(steps_xl * 0.8)
+        segs = [(engine, batch, size_xl, size_xl, switch),
+                (engine.engine_provider("refiner"), batch, size_xl, size_xl,
+                 steps_xl - switch)]
+        rel = (steps_xl / 20.0) * (size_xl / 512.0) ** 2
+        return prefix + "sdxl_base_refiner_1024_b8_ipm", engine, payload, \
+            segs, rel
+    if n == 3:
+        batch = 2 if tiny else 4
+        engine = _make_engine(sd, controlnet=True)
+        init = _synth_b64_image(size_sd, size_sd)
+        payload = GenerationPayload(
+            prompt=bp.prompt, steps=steps_sd, width=size_sd, height=size_sd,
+            batch_size=batch, sampler_name=bp.sampler_name, seed=1,
+            init_images=[init], denoising_strength=0.75,
+            alwayson_scripts=_controlnet_scripts(init))
+        # img2img runs ~denoising_strength * steps real steps
+        eff_steps = max(1, int(steps_sd * 0.75))
+        return prefix + "sd15_img2img_controlnet_b4_ipm", engine, payload, \
+            [(engine, batch, size_sd, size_sd, eff_steps)], eff_steps / 20.0
+    if n == 4:
+        batch = 2 if tiny else 4
+        names = ("bench0", "bench1", "bench2")
+        engine = _make_engine(xl, lora_names=names)
+        tags = " ".join(f"<lora:{t}:0.8>" for t in names)
+        payload = GenerationPayload(
+            prompt=f"{bp.prompt} {tags}", steps=steps_xl,
+            width=size_xl, height=size_xl, batch_size=batch,
+            sampler_name=bp.sampler_name, seed=1)
+        rel = (steps_xl / 20.0) * (size_xl / 512.0) ** 2
+        return prefix + "sdxl_lora_stack_b4_ipm", engine, payload, \
+            [(engine, batch, size_xl, size_xl, steps_xl)], rel
+    if n == 5:
+        engine = _make_engine(xl)
+        payload = GenerationPayload(
+            prompt=bp.prompt, steps=steps_xl, width=size_xl, height=size_xl,
+            batch_size=1, sampler_name=bp.sampler_name, seed=1,
+            enable_hr=True, hr_scale=2.0, hr_upscaler="Latent",
+            denoising_strength=0.7)
+        hr = size_xl * 2
+        hr_steps = max(1, int(steps_xl * 0.7))
+        segs = [(engine, 1, size_xl, size_xl, steps_xl),
+                (engine, 1, hr, hr, hr_steps)]
+        rel = (steps_xl / 20.0) * (size_xl / 512.0) ** 2 \
+            + (hr_steps / 20.0) * (hr / 512.0) ** 2
+        return prefix + "sdxl_hires_2pass_ipm", engine, payload, segs, rel
+    raise SystemExit(f"unknown config {n} (valid: 1-5)")
+
+
+def _unet_flops_per_image(segments):
+    """Analytic-by-compiler FLOPs: XLA cost analysis of one CFG UNet call
+    per segment, x steps, / batch. Text encoder + VAE excluded (noted in
+    stderr; the UNet dominates). None when cost analysis is unavailable."""
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    for engine, batch, width, height, steps in segments:
+        ucfg = engine.family.unet
+        f = engine.family.vae_scale_factor
+        lh, lw = height // f, width // f
+        lat = jnp.zeros((2 * batch, lh, lw, ucfg.in_channels),
+                        engine.policy.compute_dtype)
+        t = jnp.ones((2 * batch,), jnp.float32)
+        ctx = jnp.zeros((2 * batch, 77, ucfg.cross_attention_dim),
+                        jnp.float32)
+        args = [lat, t, ctx]
+        if ucfg.addition_embed_dim:
+            from stable_diffusion_webui_distributed_tpu.models.unet import (
+                make_added_cond,
+            )
+
+            n_ids = ((ucfg.projection_input_dim - ucfg.addition_embed_dim)
+                     // ucfg.addition_time_embed_dim)
+            args.append(make_added_cond(
+                jnp.zeros((2 * batch, ucfg.addition_embed_dim)),
+                jnp.zeros((2 * batch, n_ids)), ucfg.addition_time_embed_dim))
+        params = {"params": engine.params["unet"]}
+
+        def call(p, *a):
+            return engine.unet.apply(p, *a)
+
+        cost = jax.jit(call).lower(params, *args).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float((cost or {}).get("flops", 0.0))
+        if flops <= 0:
+            return None
+        total += flops * steps / batch
+    return total
+
+
+def run_config(n, tiny):
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"bench: device={dev.device_kind} platform={dev.platform} "
+          f"config={n} tiny={tiny}", file=sys.stderr)
+
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        RECORDED_SAMPLES,
+        WARMUP_SAMPLES,
+    )
+
+    metric, engine, payload, segments, rel_cost = _build_config(n, tiny)
+    run = engine.img2img if payload.init_images else engine.txt2img
 
     samples = []
     for i in range(WARMUP_SAMPLES + RECORDED_SAMPLES):
         t0 = time.time()
-        result = engine.txt2img(payload)
+        result = run(payload)
         elapsed = time.time() - t0
-        assert len(result.images) == bp.batch_size
+        assert len(result.images) == payload.batch_size, \
+            f"expected {payload.batch_size} images, got {len(result.images)}"
         kind = "warmup" if i < WARMUP_SAMPLES else "sample"
-        print(f"bench: {kind} {i}: {elapsed:.2f}s", file=sys.stderr)
+        print(f"bench: {kind} {i}: {elapsed:.2f}s "
+              f"({elapsed / payload.batch_size:.2f}s/image)", file=sys.stderr)
         if i >= WARMUP_SAMPLES:
             samples.append(elapsed)
 
     avg = sum(samples) / len(samples)
-    ipm = bp.batch_size / (avg / 60.0)
-    # median request wall-time (lower median) — a latency, not throughput/img
-    p50 = sorted(samples)[(len(samples) - 1) // 2]
-    metric = ("tiny_logiccheck_ipm" if tiny
-              else "sd15_512x512_20step_euler_a_ipm")
-    print(json.dumps({
+    ipm = payload.batch_size / (avg / 60.0)
+    # per-IMAGE p50: median request wall-time / batch (BASELINE.md metric)
+    p50_image = sorted(samples)[(len(samples) - 1) // 2] / payload.batch_size
+
+    out = {
         "metric": metric,
         "value": round(ipm, 2),
         "unit": "images/min",
-        "vs_baseline": round(ipm / NOMINAL_SINGLE_GPU_IPM, 3),
-        "p50_latency_s": round(p50, 3),
-    }))
+        "vs_baseline": round(ipm / (NOMINAL_SINGLE_GPU_IPM / rel_cost), 3),
+        "p50_image_latency_s": round(p50_image, 3),
+        "images_per_sec_chip": round(ipm / 60.0, 4),
+        "config": n,
+        "device": dev.device_kind,
+    }
+    try:
+        flops_per_img = _unet_flops_per_image(segments)
+        peak = _peak_for(dev.device_kind)
+        if flops_per_img and peak:
+            out["unet_mfu"] = round(
+                flops_per_img * (ipm / 60.0) / peak, 4)
+            print(f"bench: unet flops/image={flops_per_img:.3e}, "
+                  f"peak={peak:.0e} FLOPs/s (text encoder + VAE excluded "
+                  "from MFU)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — MFU is best-effort metadata
+        print(f"bench: cost analysis unavailable: {e}", file=sys.stderr)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", type=int, default=1, choices=range(1, 6),
+                    help="BASELINE.md config number (default 1)")
+    args = ap.parse_args()
+
+    # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
+    # (same protocol and code path, tiny models + payloads; NOT a perf claim).
+    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+
+    init_done = _start_init_watchdog()
+    import jax
+
+    jax.devices()
+    init_done.set()
+
+    print(json.dumps(run_config(args.config, tiny)))
 
 
 if __name__ == "__main__":

@@ -109,7 +109,27 @@ def cmd_generate(args) -> int:
 
         with open(args.init_image, "rb") as f:
             payload.init_images = [base64.b64encode(f.read()).decode()]
-    result = world.execute(payload)
+
+    # Ctrl-C interrupts the whole fleet (local chunk loop + remote
+    # /interrupt fan-out), not just this process — the reference's "master
+    # interrupt reaches every worker" semantics (worker.py:440-448).
+    import signal
+
+    from stable_diffusion_webui_distributed_tpu.runtime import (
+        interrupt as interrupt_mod,
+    )
+
+    def on_sigint(signum, frame):
+        print("interrupt: stopping local + remote generation...",
+              file=sys.stderr)
+        interrupt_mod.STATE.flag.interrupt()
+        world.interrupt_all()
+
+    previous = signal.signal(signal.SIGINT, on_sigint)
+    try:
+        result = world.execute(payload)
+    finally:
+        signal.signal(signal.SIGINT, previous)
 
     os.makedirs(args.outdir, exist_ok=True)
     from PIL import Image
@@ -191,7 +211,7 @@ def cmd_workers(args) -> int:
         cfg.workers.append({args.label: config_mod.WorkerModel(
             address=args.address, port=args.api_port, tls=args.tls,
             user=args.user, password=args.password,
-            pixel_cap=args.pixel_cap)})
+            pixel_cap=args.pixel_cap or 0)})
         config_mod.save_config(cfg, path)
         print(f"worker '{args.label}' saved to {path}")
         return 0
@@ -201,6 +221,45 @@ def cmd_workers(args) -> int:
         config_mod.save_config(cfg, path)
         print(f"removed {before - len(cfg.workers)} worker(s)")
         return 0
+    if args.action == "set":
+        # per-worker runtime fields (reference Worker Config tab,
+        # ui.py:161-214): checkpoint pin, pixel cap, enable/disable
+        if not args.label:
+            print("--label required", file=sys.stderr)
+            return 2
+        for entry in cfg.workers:
+            if args.label in entry:
+                wm = entry[args.label]
+                if args.model_override is not None:
+                    wm.model_override = args.model_override or None
+                if args.pixel_cap is not None:
+                    wm.pixel_cap = max(0, args.pixel_cap)
+                if args.disable:
+                    wm.disabled = True
+                if args.enable:
+                    wm.disabled = False
+                config_mod.save_config(cfg, path)
+                print(f"worker '{args.label}': "
+                      f"model_override={wm.model_override} "
+                      f"pixel_cap={wm.pixel_cap} disabled={wm.disabled}")
+                return 0
+        print(f"no worker '{args.label}' in {path}", file=sys.stderr)
+        return 1
+    if args.action == "restart":
+        # fleet restart fan-out over the live backends (reference
+        # ui.py:274-280 "Restart All Workers")
+        from stable_diffusion_webui_distributed_tpu.scheduler.world import (
+            World,
+        )
+
+        world = World.from_config(cfg, path)
+        results = world.restart_all()
+        if not results:
+            print("no restartable (non-master, enabled) workers")
+            return 0
+        for label, ok in sorted(results.items()):
+            print(f"{label:24s} {'restarting' if ok else 'FAILED'}")
+        return 0 if all(results.values()) else 1
     print(f"unknown action {args.action}", file=sys.stderr)
     return 2
 
@@ -256,15 +315,20 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("interrupt", help="interrupt a serving node").set_defaults(
         fn=cmd_interrupt)
 
-    wk = sub.add_parser("workers", help="worker registry CRUD")
-    wk.add_argument("action", choices=["list", "add", "remove"])
+    wk = sub.add_parser("workers", help="worker registry CRUD + control")
+    wk.add_argument("action",
+                    choices=["list", "add", "remove", "set", "restart"])
     wk.add_argument("--label")
     wk.add_argument("--address", default="localhost")
     wk.add_argument("--api-port", type=int, default=7860)
     wk.add_argument("--tls", action="store_true")
     wk.add_argument("--user", default=None)
     wk.add_argument("--password", default=None)
-    wk.add_argument("--pixel-cap", type=int, default=0)
+    wk.add_argument("--pixel-cap", type=int, default=None)
+    wk.add_argument("--model-override", default=None,
+                    help="pin this worker to a checkpoint ('' clears)")
+    wk.add_argument("--disable", action="store_true")
+    wk.add_argument("--enable", action="store_true")
     wk.set_defaults(fn=cmd_workers)
 
     s = sub.add_parser("serve", help="run the sdapi-v1 node server")

@@ -28,8 +28,15 @@ BOS = 49406
 EOS = 49407
 MAX_LEN = 77
 
+# OpenAI CLIP's pretokenizer: contractions, letter-only runs, SINGLE digits,
+# punctuation runs (underscore counts as punctuation, not a word char).
+# Original pattern: 's|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+
+# expressed with Python-re unicode classes: [^\W\d_]+ == \p{L}+, \d == one
+# decimal digit, (?:[^\s\w]|_)+ == run of non-space non-letter non-digit.
+# Digits tokenize one-by-one ('4k' -> '4','k') exactly like every webui
+# worker's bundled CLIP tokenizer, keeping conditioning seed-exact fleet-wide.
 _WORD_RE = re.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d|[\w]+|[^\s\w]+",
+    r"'s|'t|'re|'ve|'m|'ll|'d|[^\W\d_]+|\d|(?:[^\s\w]|_)+",
     re.IGNORECASE,
 )
 

@@ -48,6 +48,13 @@ from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
 
 
+# Truthy "params are dirty" sentinel for Engine._active_loras: latched when a
+# requested adapter set could not be fully resolved, so the next request (even
+# a tag-less one) re-merges from the pristine base instead of keeping a
+# partial merge. Never compares equal to a real spec tuple.
+_UNRESOLVED = ("<unresolved-lora-set>",)
+
+
 class Engine:
     """One loaded model family + its compiled stages on the local device(s)."""
 
@@ -361,7 +368,11 @@ class Engine:
             params, applied, skipped = lora_mod.merge_lora(
                 params, sd, weight, self.family, te_weight=te_weight)
         self.params = params
-        self._active_loras = key if all_resolved else None
+        # An unresolved set latches the truthy _UNRESOLVED sentinel (never
+        # equal to a spec tuple): the next request — even one with no lora
+        # tags — always re-merges from _base_params, so a partial merge can
+        # never leak into later images.
+        self._active_loras = key if all_resolved else _UNRESOLVED
 
     def _apply_prompt_loras(self, payload: GenerationPayload) -> None:
         """Activate adapters named in the prompt. The payload keeps its tags
@@ -533,16 +544,21 @@ class Engine:
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
         self._apply_prompt_loras(payload)
-        self.state.begin_request()  # new request resets the interrupt latch
         count = payload.total_images if count is None else count
         if payload.init_images:
             return self._run_img2img(payload, start_index, count, job)
         return self._run_txt2img(payload, start_index, count, job)
 
     def txt2img(self, payload: GenerationPayload) -> GenerationResult:
+        # top-level request: reset the interrupt latch. generate_range must
+        # NOT — it is the per-worker unit of a fleet fan-out, and clearing
+        # there would race the remote watchdogs out of a live interrupt
+        # (World.execute owns the latch at fleet scope).
+        self.state.begin_request()
         return self.generate_range(payload, 0, None, "txt2img")
 
     def img2img(self, payload: GenerationPayload) -> GenerationResult:
+        self.state.begin_request()
         return self.generate_range(payload, 0, None, "img2img")
 
     # -- internals -----------------------------------------------------------
@@ -911,16 +927,27 @@ class Engine:
             out.worker_labels.append("")
 
 
+def _box1d(a: np.ndarray, r: int, axis: int) -> np.ndarray:
+    """Zero-padded box filter of width 2r+1 along ``axis`` via a cumsum
+    sliding window — one vectorized pass instead of a Python call per row."""
+    k = 2 * r + 1
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (r + 1, r)
+    c = np.cumsum(np.pad(a, pad), axis=axis, dtype=np.float32)
+    hi = [slice(None)] * a.ndim
+    hi[axis] = slice(k, None)
+    lo = [slice(None)] * a.ndim
+    lo[axis] = slice(0, c.shape[axis] - k)
+    return (c[tuple(hi)] - c[tuple(lo)]) / np.float32(k)
+
+
 def _box_blur(img: np.ndarray, radius: int) -> np.ndarray:
     """Three separable box passes ~ gaussian blur of the given radius."""
-    k = 2 * max(1, int(radius)) + 1
-    kernel = np.ones(k, np.float32) / k
+    r = max(1, int(radius))
     out = img.astype(np.float32)
     for _ in range(3):
-        out = np.apply_along_axis(
-            lambda r: np.convolve(r, kernel, "same"), 0, out)
-        out = np.apply_along_axis(
-            lambda r: np.convolve(r, kernel, "same"), 1, out)
+        out = _box1d(out, r, 0)
+        out = _box1d(out, r, 1)
     return out
 
 

@@ -69,6 +69,10 @@ class WorkerModel(BaseModel):
     # (reference: world.py:62-72 pixel-cap guard in Job.add_work; the
     # reference's -1 "no limit" sentinel is normalized to 0 on load).
     pixel_cap: int = 0
+    # Pin this worker to a specific checkpoint: model sync sends this name
+    # instead of the fleet's current model (reference ui.py:161-171 exposes
+    # it per worker; persisted here so the pin survives restarts).
+    model_override: Optional[str] = None
     # TPU-native extension: which local devices this backend drives
     # (empty = all visible devices; remote workers leave it empty).
     device_ids: List[int] = Field(default_factory=list)
@@ -98,6 +102,9 @@ class ConfigModel(BaseModel):
     # If a complementary worker can't fit one image in the slack window,
     # give it one image at reduced step count (world.py:547-557).
     step_scaling: bool = False
+    # Master schedules only remotes, producing no images itself
+    # (reference "thin-client mode", world.py:109-110 analogue).
+    thin_client_mode: bool = False
     # TPU-native additions (absent from the reference's schema):
     model_dir: str = "models"
     default_model: str = ""

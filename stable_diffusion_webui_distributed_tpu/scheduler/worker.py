@@ -25,7 +25,7 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Protocol
+from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
@@ -69,6 +69,8 @@ class Backend(Protocol):
 
     def interrupt(self) -> None: ...
 
+    def restart(self) -> None: ...
+
     def load_options(self, model: str, vae: str = "") -> None: ...
 
     def available_models(self) -> List[str]: ...
@@ -88,6 +90,7 @@ class WorkerNode:
         avg_ipm: Optional[float] = None,
         eta_percent_error: Optional[List[float]] = None,
         benchmark_payload: Optional[BenchmarkPayload] = None,
+        model_override: Optional[str] = None,
     ):
         self.label = label
         self.backend = backend
@@ -105,11 +108,18 @@ class WorkerNode:
         # /script-info per worker at ping time, world.py:744-763); None =
         # unknown (send everything)
         self.supported_scripts: Optional[List[str]] = None
-        self.model_override: Optional[str] = None  # runtime-only, ui.py:161-171
+        # checkpoint pin for this worker (reference ui.py:161-171); honored
+        # by load_options and persisted via World.save_config
+        self.model_override: Optional[str] = model_override
         self.response_time: Optional[float] = None
         # free accelerator memory observed at first contact (the reference
         # queries /memory on a worker's first request, worker.py:319-340)
         self.free_memory: Optional[int] = None
+        # interrupt rendezvous polled while a remote request is in flight
+        # (None = the process-wide runtime.interrupt.STATE)
+        self.interrupt_state = None
+        self.interrupt_poll_s = 0.5  # reference's poll cadence
+
         self._lock = threading.Lock()
 
     # -- state machine ------------------------------------------------------
@@ -176,18 +186,56 @@ class WorkerNode:
             except ValueError:
                 predicted = None
         started = time.monotonic()
+        stop_watch = self._start_interrupt_watchdog()
         try:
             result = self.backend.generate(payload, start_index, count)
         except Exception as e:  # noqa: BLE001 — any backend failure demotes
             log.error("worker '%s' failed request: %s", self.label, e)
             self.set_state(State.UNAVAILABLE)
             return None
+        finally:
+            if stop_watch is not None:
+                stop_watch.set()
         elapsed = time.monotonic() - started
         self.response_time = elapsed
         if predicted is not None:
             eta_mod.record_eta_error(self.cal, predicted, elapsed)
         self.set_state(State.IDLE)
         return result
+
+    def _start_interrupt_watchdog(self) -> Optional[threading.Event]:
+        """Poll the local interrupt flag every 0.5 s while a request is in
+        flight and fire ``backend.interrupt()`` the moment it latches — the
+        reference's mid-request propagation loop
+        (/root/reference/scripts/spartan/worker.py:440-448). The master's
+        LocalBackend needs no watchdog: its chunked denoise loop reads the
+        same flag between dispatches."""
+        if self.master:
+            return None
+        from stable_diffusion_webui_distributed_tpu.runtime import (
+            interrupt as interrupt_mod,
+        )
+
+        state = self.interrupt_state or interrupt_mod.STATE
+        stop = threading.Event()
+
+        def watch():
+            while not stop.wait(self.interrupt_poll_s):
+                if state.flag.interrupted:
+                    get_logger().info(
+                        "interrupt: aborting in-flight request on '%s'",
+                        self.label)
+                    try:
+                        self.backend.interrupt()
+                    except Exception as e:  # noqa: BLE001
+                        get_logger().error(
+                            "in-flight interrupt of '%s' failed: %s",
+                            self.label, e)
+                    return
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"interrupt-watch-{self.label}").start()
+        return stop
 
     def _probe_memory(self) -> None:
         """First-contact memory probe (reference worker.py:319-340): record
@@ -224,6 +272,18 @@ class WorkerNode:
         except Exception as e:  # noqa: BLE001
             get_logger().error("interrupt of '%s' failed: %s", self.label, e)
             self.set_state(State.UNAVAILABLE)
+
+    def restart(self) -> bool:
+        """Ask this backend's server process to restart (reference
+        worker.py:690-717). The node goes UNAVAILABLE with its model cache
+        invalidated; the next ping sweep revives it once it's back."""
+        try:
+            self.backend.restart()
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("restart of '%s' failed: %s", self.label, e)
+            return False
+        self.set_state(State.UNAVAILABLE)
+        return True
 
     def reachable(self) -> bool:
         try:
@@ -337,6 +397,11 @@ class LocalBackend:
     def interrupt(self) -> None:
         self.engine.state.flag.interrupt()
 
+    def restart(self) -> None:
+        # the master restarts through its own /server-restart route (the
+        # serve loop re-execs); a cluster restart fan-out skips it
+        raise RuntimeError("local master cannot restart itself")
+
     def load_options(self, model: str, vae: str = "") -> None:
         # local model switching is handled by the ModelRegistry at the
         # server layer; the engine itself holds one loaded family
@@ -385,6 +450,7 @@ class StubBackend:
         self.behavior = behavior or StubBehavior()
         self.requests: List[Dict[str, Any]] = []
         self.interrupted = False
+        self.restarted = False
         self.options: Dict[str, str] = {}
 
     def generate(self, payload, start_index, count):
@@ -397,10 +463,16 @@ class StubBackend:
             and n >= b.fail_after_n_requests
         ):
             raise ConnectionError("stub backend injected failure")
-        if b.seconds_per_image:
-            time.sleep(b.seconds_per_image * count)
         result = GenerationResult()
         for i in range(start_index, start_index + count):
+            if b.seconds_per_image:
+                # sleep in slices so an interrupt lands mid-flight, like a
+                # real remote that returns the images finished so far
+                deadline = time.monotonic() + b.seconds_per_image
+                while time.monotonic() < deadline and not self.interrupted:
+                    time.sleep(0.01)
+            if self.interrupted:
+                break
             result.images.append(f"stub-image-{payload.seed + i}")
             result.seeds.append(payload.seed + i)
             result.subseeds.append(payload.subseed + i)
@@ -415,6 +487,11 @@ class StubBackend:
 
     def interrupt(self) -> None:
         self.interrupted = True
+
+    def restart(self) -> None:
+        if self.behavior.fail_reachable:
+            raise ConnectionError("stub: restart failure")
+        self.restarted = True
 
     def load_options(self, model: str, vae: str = "") -> None:
         if self.behavior.fail_generate:
@@ -510,6 +587,20 @@ class HTTPBackend:
 
     def interrupt(self) -> None:
         self.session.post(self.url("interrupt"), timeout=self.timeout)
+
+    def restart(self) -> None:
+        """POST /server-restart (the reference's fleet-restart leg,
+        worker.py:690-717). A server that re-execs before answering drops
+        the connection — that still counts as delivered."""
+        try:
+            self.session.post(self.url("server-restart"),
+                              timeout=self.timeout)
+        except Exception as e:  # noqa: BLE001
+            import requests
+
+            if isinstance(e, requests.exceptions.ConnectionError):
+                return  # process went down to restart: expected
+            raise
 
     def load_options(self, model: str, vae: str = "") -> None:
         body = {"sd_model_checkpoint": model}

@@ -77,7 +77,7 @@ class World:
         self.job_timeout: float = self.cfg.job_timeout
         self.complement_production: bool = self.cfg.complement_production
         self.step_scaling: bool = self.cfg.step_scaling
-        self.thin_client_mode = False
+        self.thin_client_mode = self.cfg.thin_client_mode
         # checkpoint + VAE the fleet should be on; synced to non-master
         # backends before each fan-out (reference option_payload per
         # request, distributed.py:260-318 + worker.py:342-343)
@@ -316,7 +316,16 @@ class World:
             fix_seed,
         )
 
+        from stable_diffusion_webui_distributed_tpu.runtime import (
+            interrupt as interrupt_mod,
+        )
+
         log = get_logger()
+        # a new top-level request resets the interrupt latch (webui clears
+        # shared.state the same way at generation start) — otherwise a past
+        # interrupt would make every remote's in-flight watchdog abort the
+        # fresh fan-out at its first poll
+        interrupt_mod.STATE.begin_request()
         # resolve random seeds ONCE before fan-out so every backend derives
         # the same contiguous per-image seed range (the reference fixes the
         # seed before building per-worker payloads, distributed.py:252-254)
@@ -342,21 +351,14 @@ class World:
         for job in jobs:
             job.thread.join()
 
-        # re-queue failed ranges on surviving workers (elastic recovery)
-        failed = [j for j in jobs if j.result is None and not j.complementary]
-        for job in failed:
-            survivor = next(
-                (w for w in self.get_workers() if w is not job.worker), None)
-            if survivor is None:
-                log.error("no survivor to re-queue %d image(s) from '%s'",
-                          job.batch_size, job.worker.label)
-                continue
-            log.warning("re-queueing %d image(s) from failed '%s' to '%s'",
-                        job.batch_size, job.worker.label, survivor.label)
-            job.result = survivor.request(payload, job.start_index,
-                                          job.batch_size)
-            if job.result is not None:
-                job.worker = survivor  # attribute images to the producer
+        # re-queue failed ranges on surviving workers (elastic recovery) —
+        # but never after an interrupt: a job that died because the user
+        # cancelled must not be re-fanned-out as fresh work
+        if not interrupt_mod.STATE.flag.interrupted:
+            failed = [j for j in jobs
+                      if j.result is None and not j.complementary]
+            for job in failed:
+                jobs.extend(self._requeue_failed(job, payload))
 
         merged = GenerationResult(parameters=payload.model_dump())
         for job in sorted(jobs, key=lambda j: j.start_index):
@@ -373,6 +375,65 @@ class World:
             merged.extend(r)
         self.save_config()
         return merged
+
+    def _requeue_failed(self, job: Job,
+                        payload: GenerationPayload) -> List[Job]:
+        """Recover a failed job's image range on surviving backends.
+
+        The range is split across survivors under their pixel caps (same
+        arithmetic as :meth:`Job.add_work`), fastest backend first so the
+        recovery adds minimal wall-clock; a survivor that itself fails is
+        skipped and the remainder tried on the next one. The failed job's
+        ``step_override`` is re-applied so recovered images match what the
+        original plan promised. Returns new result-carrying jobs covering
+        as much of [start_index, start_index+batch_size) as survivors could
+        absorb. (The reference drops failed ranges outright,
+        /root/reference/scripts/distributed.py:158-169.)
+        """
+        log = get_logger()
+        job_payload = payload
+        if job.step_override is not None:
+            job_payload = payload.model_copy()
+            job_payload.steps = job.step_override
+
+        per_image_px = payload.width * payload.height
+        remaining = job.batch_size
+        start = job.start_index
+        dead = {id(job.worker)}
+        recovered: List[Job] = []
+
+        candidates = [w for w in self.get_workers() if id(w) not in dead]
+        candidates.sort(key=lambda w: -(w.cal.avg_ipm or 0.0))
+        for w in candidates:
+            if remaining <= 0:
+                break
+            fit = remaining if w.pixel_cap <= 0 else min(
+                remaining, w.pixel_cap // per_image_px)
+            if fit <= 0:
+                continue  # capped below one image of this resolution
+            if self.current_model and not w.master:
+                if not w.load_options(self.current_model, self.current_vae):
+                    dead.add(id(w))
+                    continue
+            log.warning(
+                "re-queueing %d image(s) [%d..%d) from failed '%s' to '%s'",
+                fit, start, start + fit, job.worker.label, w.label)
+            result = w.request(job_payload, start, fit)
+            if result is None:
+                dead.add(id(w))  # second failure: move on to the next
+                continue
+            nj = Job(w, fit)
+            nj.start_index = start
+            nj.step_override = job.step_override
+            nj.result = result
+            recovered.append(nj)
+            start += fit
+            remaining -= fit
+        if remaining > 0:
+            log.error("no survivor could absorb %d image(s) [%d..%d) from "
+                      "failed '%s'", remaining, start, start + remaining,
+                      job.worker.label)
+        return recovered
 
     def _run_job(self, job: Job, payload: GenerationPayload) -> None:
         # sync the loaded checkpoint before generating (the reference sends
@@ -419,6 +480,68 @@ class World:
         for w in self.workers:
             if w.state == State.WORKING:
                 threading.Thread(target=w.interrupt, daemon=True).start()
+
+    def restart_all(self) -> Dict[str, bool]:
+        """Fleet restart fan-out (reference ui.py:274-280 "Restart All
+        Workers" -> worker.py:690-717 per-node /server-restart). The master
+        is skipped — it restarts via its own /server-restart route."""
+        results: Dict[str, bool] = {}
+        threads = []
+
+        def run(w: WorkerNode):
+            results[w.label] = w.restart()
+
+        for w in self.workers:
+            if w.master or w.state == State.DISABLED:
+                continue
+            t = threading.Thread(target=run, args=(w,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return results
+
+    _UNSET = object()
+
+    def configure_worker(self, label: str, model_override=_UNSET,
+                         pixel_cap=_UNSET, disabled=_UNSET) -> bool:
+        """Runtime worker-config surface (the reference's Worker Config tab,
+        ui.py:90-214): set a checkpoint pin, pixel cap, or enable/disable —
+        applied live and persisted. Returns False for an unknown label."""
+        w = self.get_worker(label)
+        if w is None:
+            return False
+        if model_override is not self._UNSET:
+            w.model_override = model_override or None
+        if pixel_cap is not self._UNSET and pixel_cap is not None:
+            w.pixel_cap = max(0, int(pixel_cap))
+        if disabled is not self._UNSET and disabled is not None:
+            if disabled:
+                w.set_state(State.DISABLED)
+            elif w.state == State.DISABLED:
+                w.set_state(State.IDLE)
+        self.save_config()
+        return True
+
+    def apply_settings(self, settings: Dict) -> Dict:
+        """Runtime scheduler settings (the reference's Settings tab fields,
+        ui.py:26-55): job_timeout / complement_production / step_scaling,
+        applied live and persisted. Returns the applied subset."""
+        applied = {}
+        if "job_timeout" in settings and settings["job_timeout"] is not None:
+            self.job_timeout = float(settings["job_timeout"])
+            applied["job_timeout"] = self.job_timeout
+        for key in ("complement_production", "step_scaling"):
+            if key in settings and settings[key] is not None:
+                setattr(self, key, bool(settings[key]))
+                applied[key] = getattr(self, key)
+        if "thin_client_mode" in settings \
+                and settings["thin_client_mode"] is not None:
+            self.thin_client_mode = bool(settings["thin_client_mode"])
+            applied["thin_client_mode"] = self.thin_client_mode
+        if applied:
+            self.save_config()
+        return applied
 
     def benchmark_all(self, rebenchmark: bool = False) -> Dict[str, float]:
         """Benchmark every schedulable backend; remotes in parallel, master
@@ -479,6 +602,7 @@ class World:
                 eta_percent_error=list(w.cal.eta_percent_error),
                 pixel_cap=w.pixel_cap,
                 disabled=w.state == State.DISABLED,
+                model_override=w.model_override,
             )
             # keep address/port/credentials when the backend is remote
             backend = w.backend
@@ -493,6 +617,7 @@ class World:
         self.cfg.job_timeout = int(self.job_timeout)
         self.cfg.complement_production = self.complement_production
         self.cfg.step_scaling = self.step_scaling
+        self.cfg.thin_client_mode = self.thin_client_mode
         if self.config_path:
             config_mod.save_config(self.cfg, self.config_path)
 
@@ -538,6 +663,7 @@ class World:
                     pixel_cap=wm.pixel_cap, avg_ipm=wm.avg_ipm,
                     eta_percent_error=wm.eta_percent_error,
                     benchmark_payload=cfg.benchmark_payload,
+                    model_override=wm.model_override,
                 )
                 if wm.disabled:
                     node.state = State.DISABLED

@@ -74,8 +74,10 @@ class ApiServer:
 
     def _execute(self, payload: GenerationPayload) -> GenerationResult:
         if hasattr(self.source, "execute"):
-            return self.source.execute(payload)
-        return self.source.generate_range(payload)  # Engine
+            return self.source.execute(payload)  # World resets the latch
+        # bare Engine: this request is the top level — reset the latch here
+        self.state.begin_request()
+        return self.source.generate_range(payload)
 
     def _generation_response(self, result: GenerationResult) -> Dict[str, Any]:
         images = list(result.images)
@@ -175,6 +177,19 @@ class ApiServer:
                 self.source.current_vae = _vae_for_sync(sync_vae)
             if sync_model:
                 self.source.sync_models(sync_model, _vae_for_sync(sync_vae))
+        # runtime scheduler settings (the reference's Settings tab fields,
+        # ui.py:26-55), accepted bare or with the webui-style
+        # ``distributed_`` prefix and applied live to the World
+        if hasattr(self.source, "apply_settings"):
+            settings = {}
+            for key in ("job_timeout", "complement_production",
+                        "step_scaling", "thin_client_mode"):
+                if key in body:
+                    settings[key] = body[key]
+                elif f"distributed_{key}" in body:
+                    settings[key] = body[f"distributed_{key}"]
+            if settings:
+                self.source.apply_settings(settings)
         for k, v in body.items():
             if k != "sd_model_checkpoint":
                 self.options[k] = v
@@ -347,6 +362,47 @@ class ApiServer:
                     self.source.save_config()
         return {"cleared": cleared}
 
+    def handle_restart_all(self) -> Dict[str, Any]:
+        """Fleet restart fan-out (the reference's 'Restart All Workers'
+        button, ui.py:274-280 + javascript/distributed.js:2-4 — its confirm
+        dialog lives client-side; API callers are their own confirmation)."""
+        if not hasattr(self.source, "restart_all"):
+            raise ApiError(400, "no fleet attached to this node")
+        return {"restarted": self.source.restart_all()}
+
+    def handle_workers_get(self) -> Any:
+        """Worker-config read surface (reference Worker Config tab,
+        ui.py:90-214)."""
+        if not hasattr(self.source, "workers"):
+            return []
+        return [{
+            "label": w.label,
+            "state": w.state.name,
+            "master": w.master,
+            "avg_ipm": w.cal.avg_ipm,
+            "pixel_cap": w.pixel_cap,
+            "model_override": w.model_override,
+            "disabled": w.state.name == "DISABLED",
+        } for w in self.source.workers]
+
+    def handle_workers_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Set per-worker runtime fields: model_override / pixel_cap /
+        disabled (reference ui.py:161-214 'Update Worker' flow)."""
+        if not hasattr(self.source, "configure_worker"):
+            raise ApiError(400, "no fleet attached to this node")
+        label = body.get("label", "")
+        if not label:
+            raise ApiError(422, "label required")
+        kwargs = {}
+        for key in ("model_override", "pixel_cap", "disabled"):
+            if key in body:
+                kwargs[key] = body[key]
+        with self._busy:
+            ok = self.source.configure_worker(label, **kwargs)
+        if not ok:
+            raise ApiError(404, f"no worker '{label}'")
+        return {"updated": label, **kwargs}
+
     def handle_panel(self) -> str:
         from stable_diffusion_webui_distributed_tpu.server.panel import (
             PANEL_HTML,
@@ -361,6 +417,9 @@ class ApiServer:
             ("GET", "/internal/status"): self.handle_internal_status,
             ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
+            ("POST", "/internal/restart-all"): self.handle_restart_all,
+            ("GET", "/internal/workers"): self.handle_workers_get,
+            ("POST", "/internal/workers"): self.handle_workers_post,
             ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,
             ("POST", "/sdapi/v1/img2img"): self.handle_img2img,
             ("GET", "/sdapi/v1/options"): self.handle_options_get,

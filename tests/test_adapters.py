@@ -111,6 +111,24 @@ class TestLoraPromptSyntax:
             prompt="a cow", steps=4, width=32, height=32, seed=3))
         assert again.images[0] == base.images[0]
 
+    def test_partial_resolve_never_leaks_into_tagless_request(self):
+        # Regression (advisor r2, medium): an adapter set where one tag
+        # fails to resolve must NOT leave partially-merged params latched —
+        # the very next tag-less request has to render from pristine base.
+        params = init_params(TINY)
+        loras = {"good": make_lora_sd(scale=2.0)}
+        eng = Engine(TINY, params, chunk_size=4, state=GenerationState(),
+                     lora_provider=loras.get)
+        base = eng.txt2img(GenerationPayload(
+            prompt="a cow", steps=4, width=32, height=32, seed=3))
+        # 'good' merges, 'nope' fails -> unresolved set, params are dirty
+        eng.txt2img(GenerationPayload(
+            prompt="a cow <lora:good:1.0> <lora:nope:1.0>", steps=4,
+            width=32, height=32, seed=3))
+        clean = eng.txt2img(GenerationPayload(
+            prompt="a cow", steps=4, width=32, height=32, seed=3))
+        assert clean.images[0] == base.images[0]
+
     def test_missing_lora_warns_and_continues(self):
         eng = Engine(TINY, init_params(TINY), chunk_size=4,
                      state=GenerationState(), lora_provider=lambda n: None)

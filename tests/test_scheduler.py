@@ -307,6 +307,86 @@ class TestExecute:
         assert r.seeds == [100, 101, 102, 103]
         assert bad.state == State.UNAVAILABLE
 
+    def test_failed_range_split_across_capped_survivors(self):
+        # bad is uncapped and ends up with 2 images; the survivors can only
+        # take 1 image each (pixel cap), so recovery must SPLIT the range
+        one_img = 512 * 512
+        w = World(ConfigModel())
+        bad = node("bad", 10.0, master=True,
+                   behavior=StubBehavior(fail_generate=True))
+        c1 = node("c1", 10.0, pixel_cap=one_img)
+        c2 = node("c2", 10.0, pixel_cap=one_img)
+        for n in (bad, c1, c2):
+            w.add_worker(n)
+        r = w.execute(payload(batch_size=4, seed=100))
+        assert sorted(r.seeds) == [100, 101, 102, 103]
+        assert len(r.images) == 4
+        # each capped survivor served its original image + one recovered
+        assert len(c1.backend.requests) == 2
+        assert len(c2.backend.requests) == 2
+        # no recovery request exceeded the survivor's cap
+        for b in (c1.backend, c2.backend):
+            assert all(req["count"] == 1 for req in b.requests)
+
+    def test_second_failure_falls_through_to_next_survivor(self):
+        w = World(ConfigModel())
+        m = node("m", 10.0, master=True)
+        f1 = node("f1", 10.0, behavior=StubBehavior(fail_generate=True))
+        # f2 serves its first (planned) request, then fails the re-queue try
+        f2 = node("f2", 12.0, behavior=StubBehavior(fail_after_n_requests=1))
+        for n in (m, f1, f2):
+            w.add_worker(n)
+        r = w.execute(payload(batch_size=6, seed=100))
+        assert sorted(r.seeds) == [100, 101, 102, 103, 104, 105]
+        # f2 (fastest) was tried first for the recovery and failed; the
+        # remainder landed on m
+        assert len(f2.backend.requests) == 2
+        assert f2.state == State.UNAVAILABLE
+
+    def test_requeue_reapplies_step_override(self):
+        w = World(ConfigModel())
+        s = node("s", 10.0)
+        w.add_worker(s)
+        bad = node("bad", 10.0, behavior=StubBehavior(fail_generate=True))
+        job = Job(bad, 2)
+        job.start_index = 3
+        job.step_override = 7
+        recovered = w._requeue_failed(job, payload(steps=20))
+        assert len(recovered) == 1 and recovered[0].worker is s
+        req = s.backend.requests[-1]
+        assert req["payload"].steps == 7
+        assert (req["start"], req["count"]) == (3, 2)
+        assert recovered[0].step_override == 7
+
+    def test_inflight_interrupt_aborts_remote_request(self):
+        # While an HTTP-style request is in flight, the watchdog polls the
+        # master's interrupt flag and fires backend.interrupt() — the
+        # remote returns early with the images finished so far
+        # (reference worker.py:440-448 mid-request propagation).
+        import threading
+        import time as time_mod
+
+        from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+            GenerationState,
+        )
+
+        slow = node("slow", 10.0,
+                    behavior=StubBehavior(seconds_per_image=0.4))
+        state = GenerationState()
+        slow.interrupt_state = state
+        slow.interrupt_poll_s = 0.05
+
+        t = threading.Timer(0.3, state.flag.interrupt)
+        t.start()
+        t0 = time_mod.monotonic()
+        result = slow.request(payload(batch_size=8, seed=1), 0, 8)
+        elapsed = time_mod.monotonic() - t0
+        t.cancel()
+        assert slow.backend.interrupted
+        # aborted mid-flight: far fewer than 8 images, far sooner than 3.2s
+        assert result is not None and len(result.images) < 8
+        assert elapsed < 1.5
+
     def test_ping_revives_and_demotes(self):
         w = World(ConfigModel())
         good = node("good", 10.0)
@@ -321,6 +401,79 @@ class TestExecute:
         res = w.ping_workers()
         assert res["flaky"] is True
         assert flaky.state == State.IDLE
+
+
+class TestWorkerControl:
+    def test_restart_all_skips_master_and_disabled(self):
+        w = World(ConfigModel())
+        m = node("m", 10.0, master=True)
+        a = node("a", 10.0)
+        d = node("d", 10.0)
+        for n_ in (m, a, d):
+            w.add_worker(n_)
+        d.set_state(State.DISABLED)
+        results = w.restart_all()
+        assert results == {"a": True}
+        assert a.backend.restarted and not d.backend.restarted
+        assert a.state == State.UNAVAILABLE  # until the next ping revives
+        # master untouched: LocalBackend-style restart is its own route
+        assert m.state != State.UNAVAILABLE
+
+    def test_restart_failure_reports_false(self):
+        w = World(ConfigModel())
+        bad = node("bad", 10.0,
+                   behavior=StubBehavior(fail_reachable=True))
+        w.add_worker(bad)
+        assert w.restart_all() == {"bad": False}
+
+    def test_configure_worker_roundtrips_and_load_options_honors(self,
+                                                                 tmp_path):
+        path = str(tmp_path / "cfg.json")
+        w = World(ConfigModel(), config_path=path)
+        a = node("a", 10.0)
+        w.add_worker(a)
+        assert w.configure_worker("a", model_override="anime-v3",
+                                  pixel_cap=4 * 512 * 512)
+        assert not w.configure_worker("ghost")
+        # persisted...
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            load_config,
+        )
+
+        cfg2 = load_config(path)
+        w2 = World.from_config(
+            cfg2, backend_factory=lambda label, wm: StubBackend())
+        a2 = w2.get_worker("a")
+        assert a2.model_override == "anime-v3"
+        assert a2.pixel_cap == 4 * 512 * 512
+        # ...and honored: model sync sends the pin, not the fleet model
+        a2.load_options("fleet-model")
+        assert a2.backend.options["model"] == "anime-v3"
+        # clearing the pin restores fleet-model sync
+        w2.config_path = None
+        w2.configure_worker("a", model_override="")
+        a2.load_options("fleet-model")
+        assert a2.backend.options["model"] == "fleet-model"
+
+    def test_configure_worker_disable_enable(self):
+        w = World(ConfigModel())
+        a = node("a", 10.0)
+        w.add_worker(a)
+        w.configure_worker("a", disabled=True)
+        assert a.state == State.DISABLED
+        assert w.get_workers() == []
+        w.configure_worker("a", disabled=False)
+        assert a.state == State.IDLE
+
+    def test_apply_settings(self):
+        w = World(ConfigModel())
+        applied = w.apply_settings({
+            "job_timeout": 7, "step_scaling": True,
+            "complement_production": False, "ignored_key": 1})
+        assert applied == {"job_timeout": 7.0, "step_scaling": True,
+                           "complement_production": False}
+        assert w.job_timeout == 7.0 and w.step_scaling \
+            and not w.complement_production
 
 
 class TestConcurrency:

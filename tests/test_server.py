@@ -99,6 +99,56 @@ class TestRoutes:
             call(server, "/sdapi/v1/nope")
         assert e.value.code == 404
 
+    def test_workers_control_surface(self, server):
+        world = server.source
+        extra = WorkerNode("r1", StubBackend(), avg_ipm=5.0)
+        world.add_worker(extra)
+        try:
+            # read surface (reference Worker Config tab, ui.py:90-214)
+            rows = call(server, "/internal/workers")
+            by_label = {r["label"]: r for r in rows}
+            assert by_label["r1"]["model_override"] is None
+            # write surface: pin + cap round-trip
+            out = call(server, "/internal/workers",
+                       {"label": "r1", "model_override": "pinned-v1",
+                        "pixel_cap": 123456})
+            assert out["updated"] == "r1"
+            assert extra.model_override == "pinned-v1"
+            assert extra.pixel_cap == 123456
+            rows = call(server, "/internal/workers")
+            by_label = {r["label"]: r for r in rows}
+            assert by_label["r1"]["model_override"] == "pinned-v1"
+            # unknown label -> 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                call(server, "/internal/workers", {"label": "ghost",
+                                                   "pixel_cap": 1})
+            assert e.value.code == 404
+        finally:
+            world.workers.remove(extra)
+
+    def test_restart_all_route(self, server):
+        world = server.source
+        extra = WorkerNode("r2", StubBackend(), avg_ipm=5.0)
+        world.add_worker(extra)
+        try:
+            out = call(server, "/internal/restart-all", {})
+            assert out["restarted"] == {"r2": True}
+            assert extra.backend.restarted
+        finally:
+            world.workers.remove(extra)
+
+    def test_options_apply_scheduler_settings(self, server):
+        world = server.source
+        old = world.job_timeout
+        try:
+            call(server, "/sdapi/v1/options",
+                 {"distributed_job_timeout": 11, "step_scaling": True})
+            assert world.job_timeout == 11.0
+            assert world.step_scaling is True
+        finally:
+            world.job_timeout = old
+            world.step_scaling = False
+
     def test_status_panel_html(self, server):
         url = f"http://127.0.0.1:{server.port}/"
         with urllib.request.urlopen(url, timeout=10) as r:
