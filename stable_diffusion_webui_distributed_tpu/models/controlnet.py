@@ -241,6 +241,24 @@ def preprocess_canny(img: np.ndarray, low: float = 100.0,
     return np.repeat(out[:, :, None], 3, axis=2)
 
 
+def preprocess_inpaint(img: np.ndarray,
+                       mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """ControlNet v1.1 inpaint convention: the hint is the image with
+    masked pixels set to -1.0 (the unit payload's ``image.mask`` channel
+    the reference forwards; white mask = repaint)."""
+    out = preprocess_none(img).copy()
+    if mask is not None:
+        m = np.asarray(mask)
+        if m.dtype == np.uint8 or m.max() > 1.0:
+            m = m.astype(np.float32) / 255.0
+        else:
+            m = m.astype(np.float32)
+        if m.ndim == 3:
+            m = m[..., 0]
+        out[m > 0.5] = -1.0
+    return out
+
+
 PREPROCESSORS = {
     "none": preprocess_none,
     "canny": preprocess_canny,
@@ -248,10 +266,15 @@ PREPROCESSORS = {
 }
 
 
-def run_preprocessor(module: str, img: np.ndarray) -> np.ndarray:
+def run_preprocessor(module: str, img: np.ndarray,
+                     mask: Optional[np.ndarray] = None) -> np.ndarray:
     """Resolve a webui module name; unknown modules fall back to pass-through
-    (same spirit as the reference's sampler fallback, worker.py:457-467)."""
-    fn = PREPROCESSORS.get((module or "none").lower())
+    (same spirit as the reference's sampler fallback, worker.py:457-467).
+    ``mask`` feeds mask-aware modules (inpaint family)."""
+    name = (module or "none").lower()
+    if name.startswith("inpaint"):  # inpaint / inpaint_only / +lama alias
+        return preprocess_inpaint(img, mask)
+    fn = PREPROCESSORS.get(name)
     if fn is None:
         from stable_diffusion_webui_distributed_tpu.runtime.logging import (
             get_logger,

@@ -1,0 +1,176 @@
+"""ESRGAN-family (RRDBNet) image upscalers, natively in JAX.
+
+The reference fleet gets ESRGAN/RealESRGAN hires upscaling for free from
+every sdwui worker's bundled model zoo (the webui hires-fix upscaler
+dropdown the reference's ETA model accounts for at
+/root/reference/scripts/spartan/worker.py:205-228). Here the architecture
+is implemented natively: standard RRDBNet x4 — conv_first, nb x RRDB
+(3 residual-dense blocks of 5 growth convs each), trunk conv, two nearest
+x2 upsample convs, HR conv, final conv; LeakyReLU(0.2) activations.
+
+Both public checkpoint layouts load:
+- new arch (BasicSR / RealESRGAN): ``conv_first.*, body.N.rdb1.conv1.*,
+  conv_body.*, conv_up1/2.*, conv_hr.*, conv_last.*``
+- old arch (original ESRGAN): ``model.0.*, model.1.sub.N.RDB1.conv1.0.*,
+  model.1.sub.{nb}.*, model.3/6/8/10.*`` — translated on load.
+
+Weight files are ``.pth`` (torch pickles, loaded CPU-side) or
+``.safetensors``. Inference is a jitted NHWC graph; the RRDB trunk runs as
+one ``lax.scan`` over stacked block weights so 23-block models compile
+fast and the MXU sees uniform convs.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_OLD_HEAD = {"0": "conv_first", "3": "conv_up1", "6": "conv_up2",
+             "8": "conv_hr", "10": "conv_last"}
+
+
+def _normalize_keys(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Old-arch ESRGAN keys -> new-arch names; new-arch passes through."""
+    out = {}
+    for key, v in sd.items():
+        m = re.match(
+            r"model\.1\.sub\.(\d+)\.RDB(\d)\.conv(\d)\.0\.(weight|bias)",
+            key)
+        if m:
+            out[f"body.{m.group(1)}.rdb{m.group(2)}.conv{m.group(3)}."
+                f"{m.group(4)}"] = v
+            continue
+        m = re.match(r"model\.1\.sub\.(\d+)\.(weight|bias)", key)
+        if m:  # the trailing conv inside the trunk = conv_body
+            out[f"conv_body.{m.group(2)}"] = v
+            continue
+        m = re.match(r"model\.(\d+)\.(weight|bias)", key)
+        if m and m.group(1) in _OLD_HEAD:
+            out[f"{_OLD_HEAD[m.group(1)]}.{m.group(2)}"] = v
+            continue
+        if key.startswith("model."):
+            continue  # old-arch activation/upsample placeholders
+        out[key.replace(".RDB", ".rdb")] = v
+    return out
+
+
+def convert_esrgan(sd: Dict) -> Dict:
+    """torch state dict -> {conv_first, body(stacked), conv_body, conv_up1,
+    conv_up2, conv_hr, conv_last} with NHWC-ready HWIO kernels."""
+    sd = _normalize_keys({k: np.asarray(v) for k, v in sd.items()})
+
+    def conv(name: str) -> Dict[str, jnp.ndarray]:
+        w = sd[f"{name}.weight"]  # torch (O, I, kh, kw)
+        return {"kernel": jnp.asarray(w.transpose(2, 3, 1, 0)),
+                "bias": jnp.asarray(sd[f"{name}.bias"])}
+
+    in_ch = sd["conv_first.weight"].shape[1]
+    if in_ch != 3:
+        raise ValueError(
+            f"unsupported RRDBNet input of {in_ch} channels (pixel-unshuffle"
+            " x2 variants not supported; use an x4 model)")
+
+    nb = 1 + max(int(re.match(r"body\.(\d+)\.", k).group(1))
+                 for k in sd if k.startswith("body."))
+    blocks: List[Dict] = []
+    for i in range(nb):
+        blocks.append({
+            f"rdb{j}": {f"conv{k}": conv(f"body.{i}.rdb{j}.conv{k}")
+                        for k in range(1, 6)}
+            for j in range(1, 4)
+        })
+    body = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "conv_first": conv("conv_first"),
+        "body": body,
+        "conv_body": conv("conv_body"),
+        "conv_up1": conv("conv_up1"),
+        "conv_up2": conv("conv_up2"),
+        "conv_hr": conv("conv_hr"),
+        "conv_last": conv("conv_last"),
+    }
+
+
+def _conv2d(p, x):
+    return jax.lax.conv_general_dilated(
+        x, p["kernel"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + p["bias"]
+
+
+def _lrelu(x):
+    return jnp.where(x >= 0, x, 0.2 * x)
+
+
+def _rdb(p, x):
+    cur = x
+    for k in range(1, 5):
+        cur = jnp.concatenate([cur, _lrelu(_conv2d(p[f"conv{k}"], cur))],
+                              axis=-1)
+    return x + 0.2 * _conv2d(p["conv5"], cur)
+
+
+def _rrdb(p, x):
+    y = _rdb(p["rdb1"], x)
+    y = _rdb(p["rdb2"], y)
+    y = _rdb(p["rdb3"], y)
+    return x + 0.2 * y
+
+
+def _nearest2x(x):
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+
+
+def rrdbnet_apply(params: Dict, img: jax.Array) -> jax.Array:
+    """(B, H, W, 3) in [0,1] -> (B, 4H, 4W, 3)."""
+    fea = _conv2d(params["conv_first"], img.astype(jnp.float32))
+    trunk, _ = jax.lax.scan(
+        lambda x, bp: (_rrdb(bp, x), None), fea, params["body"])
+    fea = fea + _conv2d(params["conv_body"], trunk)
+    fea = _lrelu(_conv2d(params["conv_up1"], _nearest2x(fea)))
+    fea = _lrelu(_conv2d(params["conv_up2"], _nearest2x(fea)))
+    return _conv2d(params["conv_last"],
+                   _lrelu(_conv2d(params["conv_hr"], fea)))
+
+
+def load_esrgan(path: str) -> Dict:
+    """Load + convert a .pth / .safetensors RRDBNet checkpoint."""
+    if path.lower().endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        sd = dict(load_file(path))
+    else:
+        import torch
+
+        sd = torch.load(path, map_location="cpu")
+        if isinstance(sd, dict):
+            for nest in ("params_ema", "params", "state_dict"):
+                if nest in sd and isinstance(sd[nest], dict):
+                    sd = sd[nest]
+                    break
+        sd = {k: v.detach().cpu().numpy() for k, v in sd.items()
+              if hasattr(v, "detach")}
+    return convert_esrgan(sd)
+
+
+def make_upscaler(params: Dict):
+    """-> upscale(imgs (B,H,W,3) [0,1], target_w, target_h): apply the
+    model (repeatedly if needed) then lanczos-resize to the exact target —
+    webui's upscale-then-shrink convention for fractional factors."""
+    apply = jax.jit(functools.partial(rrdbnet_apply, params))
+
+    def upscale(imgs, target_w: int, target_h: int):
+        x = jnp.asarray(imgs, jnp.float32)
+        while x.shape[1] < target_h or x.shape[2] < target_w:
+            x = jnp.clip(apply(x), 0.0, 1.0)
+        if (x.shape[1], x.shape[2]) != (target_h, target_w):
+            x = jax.image.resize(
+                x, (x.shape[0], target_h, target_w, x.shape[3]), "lanczos3")
+            x = jnp.clip(x, 0.0, 1.0)
+        return x
+
+    return upscale

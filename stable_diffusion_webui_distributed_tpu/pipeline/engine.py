@@ -37,6 +37,7 @@ from stable_diffusion_webui_distributed_tpu.models.tokenizer import load_tokeniz
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
     GenerationResult,
+    apply_scripts,
     array_to_b64png,
     b64png_to_array,
     build_infotext,
@@ -72,6 +73,7 @@ class Engine:
         lora_provider: Optional[Callable[[str], Optional[Dict]]] = None,
         controlnet_provider: Optional[Callable[[str], Optional[Dict]]] = None,
         engine_provider: Optional[Callable[[str], Optional["Engine"]]] = None,
+        upscaler_provider: Optional[Callable[[str], Optional[Callable]]] = None,
     ):
         self.family = family
         self.policy = policy
@@ -118,6 +120,9 @@ class Engine:
         # resolves another loaded engine by checkpoint name — the SDXL
         # base+refiner handoff (BASELINE config #2)
         self.engine_provider = engine_provider
+        # ESRGAN-family image-space hires upscalers (models/esrgan.py);
+        # None -> latent-space upscaling only
+        self.upscaler_provider = upscaler_provider
 
         cd = policy.compute_dtype
         self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
@@ -419,11 +424,15 @@ class Engine:
                     if not isinstance(u, dict) or not u.get("enabled", True):
                         continue
                     image = u.get("image") or u.get("input_image")
+                    mask = u.get("mask")
                     if isinstance(image, dict):
+                        # Mikubill dict form carries the mask channel the
+                        # inpaint module consumes
+                        mask = image.get("mask") or mask
                         image = image.get("image")
                     if not image:
                         continue
-                    units.append({**u, "image": image})
+                    units.append({**u, "image": image, "mask": mask})
                 return units
         return []
 
@@ -450,7 +459,9 @@ class Engine:
                     "controlnet model '%s' not found; unit skipped", name)
                 continue
             img = b64png_to_array(u["image"])
-            processed = run_preprocessor(u.get("module", "none"), img)
+            mask = b64png_to_array(u["mask"]) if u.get("mask") else None
+            processed = run_preprocessor(u.get("module", "none"), img,
+                                         mask=mask)
             # the hint embedder downsamples x8 into latent space; size the
             # hint so hint/8 == latent dims (equals width x height for real
             # SD families whose VAE factor is 8)
@@ -471,7 +482,14 @@ class Engine:
 
     # -- prompt conditioning -----------------------------------------------
 
-    def encode_prompts(self, payload: GenerationPayload):
+    def encode_prompts(self, payload: GenerationPayload, prompts=None):
+        """Conditioning for the request.
+
+        Default: one prompt -> ctx (1, L, D), broadcast over the batch in
+        the denoiser. With ``prompts`` (per-image variation: prompt matrix
+        etc.) each image gets its own row — ctx (B, L, D) — distinct
+        prompts encoded once, all chunk-padded to one context length.
+        """
         from stable_diffusion_webui_distributed_tpu.models.lora import (
             extract_lora_tags,
         )
@@ -481,13 +499,13 @@ class Engine:
         )
 
         tok = self.tokenizer
-        clean_prompt, _ = extract_lora_tags(payload.prompt)
-        ids_c, w_c = tokenize_weighted(tok, clean_prompt)
+        prompt_list = [payload.prompt] if prompts is None else list(prompts)
+        cleaned = [extract_lora_tags(p)[0] for p in prompt_list]
+        toks = [tokenize_weighted(tok, c) for c in cleaned]
         ids_u, w_u = tokenize_weighted(tok, payload.negative_prompt)
         # cond and uncond must agree on context length (webui pads both)
-        n = max(ids_c.shape[0], ids_u.shape[0])
+        n = max([t[0].shape[0] for t in toks] + [ids_u.shape[0]])
         bos, eos = tok.bos, tok.eos
-        ids_c, w_c = pad_chunks(ids_c, w_c, n, eos, bos)
         ids_u, w_u = pad_chunks(ids_u, w_u, n, eos, bos)
 
         skip = int(payload.clip_skip or 0)
@@ -495,8 +513,18 @@ class Engine:
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
         with trace.STATS.timer("text_encode"):
-            ctx_c, pooled_c = enc(te, te2, jnp.asarray(ids_c),
-                                  jnp.asarray(w_c), skip)
+            cache: Dict[str, Tuple] = {}
+            ctxs, pooleds = [], []
+            for (ids_c, w_c), raw in zip(toks, cleaned):
+                if raw not in cache:
+                    pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
+                    cache[raw] = enc(te, te2, jnp.asarray(pi),
+                                     jnp.asarray(wi), skip)
+                ctxs.append(cache[raw][0])
+                pooleds.append(cache[raw][1])
+            ctx_c = ctxs[0] if len(ctxs) == 1 else jnp.concatenate(ctxs, 0)
+            pooled_c = pooleds[0] if len(pooleds) == 1 \
+                else jnp.concatenate(pooleds, 0)
             ctx_u, pooled_u = enc(te, te2, jnp.asarray(ids_u),
                                   jnp.asarray(w_u), skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
@@ -519,10 +547,14 @@ class Engine:
         else:
             ids_c = [height, width, 0, 0, height, width][:n_ids]
             ids_u = ids_c
-        au = make_added_cond(pooled_u, jnp.asarray([ids_u], jnp.float32),
-                             ucfg.addition_time_embed_dim)
-        ac = make_added_cond(pooled_c, jnp.asarray([ids_c], jnp.float32),
-                             ucfg.addition_time_embed_dim)
+        # time-id rows track the pooled batch (per-image prompts make
+        # pooled_c (B, D) rather than (1, D))
+        tid_u = jnp.broadcast_to(jnp.asarray([ids_u], jnp.float32),
+                                 (pooled_u.shape[0], n_ids))
+        tid_c = jnp.broadcast_to(jnp.asarray([ids_c], jnp.float32),
+                                 (pooled_c.shape[0], n_ids))
+        au = make_added_cond(pooled_u, tid_u, ucfg.addition_time_embed_dim)
+        ac = make_added_cond(pooled_c, tid_c, ucfg.addition_time_embed_dim)
         return au, ac
 
     # -- generation ---------------------------------------------------------
@@ -550,16 +582,20 @@ class Engine:
         return self._run_txt2img(payload, start_index, count, job)
 
     def txt2img(self, payload: GenerationPayload) -> GenerationResult:
-        # top-level request: reset the interrupt latch. generate_range must
-        # NOT — it is the per-worker unit of a fleet fan-out, and clearing
-        # there would race the remote watchdogs out of a live interrupt
-        # (World.execute owns the latch at fleet scope).
+        # top-level request: reset the interrupt latch and expand native
+        # scripts (prompt matrix). generate_range must do NEITHER — it is
+        # the per-worker unit of a fleet fan-out: clearing the latch there
+        # would race the remote watchdogs out of a live interrupt, and
+        # re-expansion would change image counts mid-plan (World.execute
+        # owns both at fleet scope).
         self.state.begin_request()
-        return self.generate_range(payload, 0, None, "txt2img")
+        return self.generate_range(apply_scripts(payload), 0, None,
+                                   "txt2img")
 
     def img2img(self, payload: GenerationPayload) -> GenerationResult:
         self.state.begin_request()
-        return self.generate_range(payload, 0, None, "img2img")
+        return self.generate_range(apply_scripts(payload), 0, None,
+                                   "img2img")
 
     # -- internals -----------------------------------------------------------
 
@@ -583,14 +619,44 @@ class Engine:
         return place_batch(x, self.mesh)
 
     def _image_keys(self, payload, start, batch):
+        # ENSD (eta_noise_seed_delta) offsets the SAMPLER noise seed only —
+        # init noise is untouched — matching webui, where ancestral noise
+        # is seeded with seed+ENSD. Carried in override_settings like the
+        # sdapi payloads the reference forwards.
+        ensd = int((payload.override_settings or {})
+                   .get("eta_noise_seed_delta", 0) or 0)
+        seed = payload.seed + ensd
         idx = jnp.arange(batch, dtype=jnp.uint32) + jnp.uint32(start)
-        if payload.subseed_strength > 0:
-            # Variation batches: the base key is fixed (see runtime/rng.py).
+        if payload.subseed_strength > 0 or payload.same_seed:
+            # Variation batches and same-seed (prompt-matrix) batches:
+            # the base key is fixed (see runtime/rng.py).
             return jax.vmap(
-                lambda i: rng.key_for_image(payload.seed, jnp.uint32(0))
+                lambda i: rng.key_for_image(seed, jnp.uint32(0))
             )(idx)
         return jax.vmap(
-            lambda i: rng.key_for_image(payload.seed, i))(idx)
+            lambda i: rng.key_for_image(seed, i))(idx)
+
+    def _group_conds(self, payload, pos, gen_n, refiner):
+        """Per-image conditioning for images [pos, pos+gen_n) of a request
+        carrying ``all_prompts``; pad-and-drop tail rows repeat the last
+        prompt (those images are discarded)."""
+        prompts = list(payload.all_prompts[pos:pos + gen_n])
+        if not prompts:
+            prompts = [payload.prompt]
+        while len(prompts) < gen_n:
+            prompts.append(prompts[-1])
+        conds, pooleds = self.encode_prompts(payload, prompts=prompts)
+        ref_cond = (refiner.encode_prompts(payload, prompts=prompts)
+                    if refiner else None)
+        return conds, pooleds, ref_cond
+
+    def _seed_resize_latent(self, payload):
+        """(from_h, from_w) in latent units, or None when disabled."""
+        if payload.seed_resize_from_w > 0 and payload.seed_resize_from_h > 0:
+            f = self.family.vae_scale_factor
+            return (payload.seed_resize_from_h // f,
+                    payload.seed_resize_from_w // f)
+        return None
 
     def _apply_inpaint_fill(self, payload, init_lat, mask_lat, image_keys):
         """webui ``inpainting_fill`` masked-content modes (the enum the
@@ -686,17 +752,19 @@ class Engine:
         spec = kd.resolve_sampler(payload.sampler_name)
         sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
 
-        conds, pooleds = self.encode_prompts(payload)
         controls = self._prepare_controls(payload, width, height)
-        # refiner engine + its conditioning resolved ONCE per request, not
-        # per batch group
         refiner = self._refiner_engine(payload)
-        ref_cond = refiner.encode_prompts(payload) if refiner else None
+        conds = pooleds = ref_cond = None
+        if not payload.all_prompts:
+            # conditioning resolved ONCE per request, not per batch group;
+            # per-image prompts resolve per group in the loop instead
+            conds, pooleds = self.encode_prompts(payload)
+            ref_cond = refiner.encode_prompts(payload) if refiner else None
         out = GenerationResult(parameters=payload.model_dump())
 
         # Generate in groups of batch_size so the compiled batch dim is
         # stable across n_iter (reference batches the same way).
-        group = max(1, payload.batch_size)
+        group = max(1, payload.group_size or payload.batch_size)
         pos = start
         remaining = count
         pending = []
@@ -714,9 +782,14 @@ class Engine:
                 gen_n = group
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
-                pos, gen_n, (h, w, C))
+                pos, gen_n, (h, w, C),
+                seed_resize=self._seed_resize_latent(payload),
+                pin_index=payload.same_seed)
             x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
             keys = self._image_keys(payload, pos, gen_n)
+            if payload.all_prompts:
+                conds, pooleds, ref_cond = self._group_conds(
+                    payload, pos, gen_n, refiner)
             latents = self._split_denoise(
                 payload, x, keys, conds, pooleds, width, height, job,
                 controls, refiner, ref_cond, payload.steps, 0)
@@ -792,8 +865,23 @@ class Engine:
         start2 = steps2 - t_enc
 
         n, _, _, C = latents.shape
-        up = jax.image.resize(latents, (n, th // f, tw // f, C),
-                              _latent_resize_method(payload.hr_upscaler))
+        up = None
+        name = payload.hr_upscaler or "Latent"
+        if "latent" not in name.lower() and self.upscaler_provider:
+            upscale = self.upscaler_provider(name)
+            if upscale is not None:
+                # image-space (ESRGAN-family) hires: decode -> model
+                # upscale to target -> re-encode (webui's non-latent path)
+                with trace.STATS.timer("hires_upscale"):
+                    imgs = self._decode_fn(
+                        payload.width, payload.height, n)(
+                            self.params["vae"], latents)
+                    big = upscale(imgs, tw, th)
+                    up = self._encode_image_fn(tw, th, n)(
+                        self.params["vae"], big)
+        if up is None:
+            up = jax.image.resize(latents, (n, th // f, tw // f, C),
+                                  _latent_resize_method(payload.hr_upscaler))
         # Fresh per-image noise for the second pass, disjoint from both the
         # init-noise stream and the sampler's ancestral stream.
         def hr_noise(k):
@@ -825,13 +913,15 @@ class Engine:
 
         init = b64png_to_array(payload.init_images[0]).astype(np.float32) / 255.0
         init = _resize_image(init, width, height)
-        conds, pooleds = self.encode_prompts(payload)
         controls = self._prepare_controls(payload, width, height)
         # inpainting never uses the refiner (mask pinning is tied to the
         # base chunk loop) — don't load a refiner checkpoint for it
         refiner = None if payload.mask is not None \
             else self._refiner_engine(payload)
-        ref_cond = refiner.encode_prompts(payload) if refiner else None
+        conds = pooleds = ref_cond = None
+        if not payload.all_prompts:
+            conds, pooleds = self.encode_prompts(payload)
+            ref_cond = refiner.encode_prompts(payload) if refiner else None
 
         mask_lat = None
         if payload.mask is not None:
@@ -848,7 +938,7 @@ class Engine:
             mask_lat = jnp.clip(mask_lat * 1.02, 0.0, 1.0)  # keep core at 1
 
         out = GenerationResult(parameters=payload.model_dump())
-        group = max(1, payload.batch_size)
+        group = max(1, payload.group_size or payload.batch_size)
         pos, remaining = start, count
         pending = []
         while remaining > 0 and not self.state.flag.interrupted:
@@ -859,9 +949,14 @@ class Engine:
             keys = self._image_keys(payload, pos, n)
             init_lat = self._apply_inpaint_fill(
                 payload, init_lat, mask_lat, keys)
+            if payload.all_prompts:
+                conds, pooleds, ref_cond = self._group_conds(
+                    payload, pos, n, refiner)
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
-                pos, n, init_lat.shape[1:])
+                pos, n, init_lat.shape[1:],
+                seed_resize=self._seed_resize_latent(payload),
+                pin_index=payload.same_seed)
             x = self._place_batch(
                 init_lat + noise.astype(jnp.float32) * sigmas[start_step])
             if mask_lat is None:
@@ -912,18 +1007,22 @@ class Engine:
             self._append_images(out, payload, imgs, pos, n, width, height)
 
     def _append_images(self, out, payload, imgs, pos, n, width, height):
+        pinned = payload.subseed_strength > 0 or payload.same_seed
         for j in range(n):
             i = pos + j
-            seed_i = payload.seed + (0 if payload.subseed_strength > 0 else i)
-            sub_i = payload.subseed + i
+            seed_i = payload.seed + (0 if pinned else i)
+            sub_i = payload.subseed + (0 if payload.same_seed else i)
+            prompt_i = payload.prompt
+            if payload.all_prompts and i < len(payload.all_prompts):
+                prompt_i = payload.all_prompts[i]
             out.images.append(array_to_b64png(imgs[j]))
             out.seeds.append(int(seed_i))
             out.subseeds.append(int(sub_i))
-            out.prompts.append(payload.prompt)
+            out.prompts.append(prompt_i)
             out.negative_prompts.append(payload.negative_prompt)
             out.infotexts.append(build_infotext(
                 payload, int(seed_i), int(sub_i), self.model_name,
-                width, height))
+                width, height, prompt_override=prompt_i))
             out.worker_labels.append("")
 
 
@@ -953,9 +1052,11 @@ def _box_blur(img: np.ndarray, radius: int) -> np.ndarray:
 
 def _latent_resize_method(hr_upscaler: str) -> str:
     """webui latent-upscaler names -> jax.image.resize methods. Non-latent
-    upscalers (ESRGAN-family model files) aren't shipped; those names fall
-    back to bilinear latent upscaling with a log line — the
-    degraded-capability pattern (reference worker.py:457-467)."""
+    (ESRGAN-family) names are handled upstream via the engine's
+    upscaler_provider when a matching model file exists (models/esrgan.py);
+    reaching here means no file matched — fall back to bilinear latent
+    upscaling with a log line (degraded-capability pattern, reference
+    worker.py:457-467)."""
     name = (hr_upscaler or "Latent").lower()
     if "latent" in name:
         if "nearest" in name:

@@ -34,6 +34,11 @@ class GenerationPayload(BaseModel):
     cfg_scale: float = 7.0
     sampler_name: str = "Euler a"
     clip_skip: int = 0  # 0 = model default; webui's setting is clip_skip-1
+    # Seed-resize (webui): initial noise is drawn at THIS resolution and
+    # pasted centered into the target latent, so one seed keeps its
+    # composition across aspect ratios. <=0 disables.
+    seed_resize_from_w: int = 0
+    seed_resize_from_h: int = 0
 
     # img2img
     init_images: List[str] = Field(default_factory=list)  # base64 PNG
@@ -53,6 +58,24 @@ class GenerationPayload(BaseModel):
     # SDXL base+refiner two-model pass (webui sdapi field names)
     refiner_checkpoint: str = ""
     refiner_switch_at: float = 1.0   # fraction of steps where refiner takes over
+
+    # per-image prompt variation: when set, image i (GLOBAL index for the
+    # local backend; backends receiving a sub-range over HTTP get the
+    # pre-sliced list) is conditioned on all_prompts[i]. Populated by the
+    # prompt-matrix script expansion (apply_scripts) or directly by callers.
+    all_prompts: Optional[List[str]] = None
+    # webui script selector ("prompt matrix" is implemented natively;
+    # self-looping scripts bypass distribution, scheduler/world.py)
+    script_name: str = ""
+    script_args: List[Any] = Field(default_factory=list)
+    # every image reuses the request seed verbatim (prompt-matrix grids
+    # compare prompts at a FIXED seed; webui pins all_seeds the same way)
+    same_seed: bool = False
+    # compiled-batch cap: engines generate in groups of this many images
+    # (0 = batch_size). Script expansions set it to the user's original
+    # batch_size so a 32-combination matrix doesn't become one 32-wide
+    # (64 after CFG) UNet dispatch.
+    group_size: int = 0
 
     # model / misc
     override_settings: Dict[str, Any] = Field(default_factory=dict)
@@ -150,6 +173,21 @@ def parse_infotext(text: str) -> "GenerationPayload":
             except ValueError:
                 pass
             continue
+        if key == "seed resize from" and "x" in value:
+            w, _, h = value.partition("x")
+            try:
+                payload.seed_resize_from_w = int(w)
+                payload.seed_resize_from_h = int(h)
+            except ValueError:
+                pass
+            continue
+        if key == "ensd":
+            try:
+                payload.override_settings["eta_noise_seed_delta"] = \
+                    int(value)
+            except ValueError:
+                pass
+            continue
         target = _INFOTEXT_KEYS.get(key)
         if target is None:
             continue
@@ -158,6 +196,50 @@ def parse_infotext(text: str) -> "GenerationPayload":
             setattr(payload, field, conv(value))
         except ValueError:
             pass
+    return payload
+
+
+def expand_prompt_matrix(prompt: str) -> List[str]:
+    """webui prompt-matrix grammar: ``base|opt1|opt2`` -> one prompt per
+    subset of the options, in binary-counter order (webui
+    scripts/prompt_matrix.py semantics): index i includes option j iff bit
+    j of i is set. 2^(n_options) prompts total."""
+    parts = [p.strip() for p in prompt.split("|")]
+    base, options = parts[0], parts[1:]
+    if len(options) > 10:
+        # 2^n combinations: unbounded '|' counts would OOM the node while
+        # it holds the generation lock (10 options = 1024 images already)
+        raise ValueError(
+            f"prompt matrix with {len(options)} options would generate "
+            f"2^{len(options)} images; the limit is 10 options (1024)")
+    out = []
+    for i in range(1 << len(options)):
+        chosen = [options[j] for j in range(len(options)) if i & (1 << j)]
+        out.append(", ".join([base] + chosen) if chosen else base)
+    return out
+
+
+def apply_scripts(payload: "GenerationPayload") -> "GenerationPayload":
+    """Expand native script semantics into the payload. Idempotent — safe
+    to call at every entry point (World.execute, ApiServer, CLI).
+
+    ``prompt matrix``: the prompt's ``|`` alternatives expand into
+    ``all_prompts`` (one image per combination, fixed seed), replacing
+    batch_size/n_iter — the webui script this reproduces runs server-side
+    on every node of the reference's fleet.
+    """
+    if payload.all_prompts:
+        return payload  # already expanded
+    if payload.script_name.strip().lower() == "prompt matrix" \
+            and "|" in payload.prompt:
+        payload = payload.model_copy()
+        payload.all_prompts = expand_prompt_matrix(payload.prompt)
+        # the user's batch_size becomes the per-dispatch group cap; the
+        # matrix size becomes the request total
+        payload.group_size = max(1, payload.batch_size)
+        payload.batch_size = len(payload.all_prompts)
+        payload.n_iter = 1
+        payload.same_seed = True
     return payload
 
 
@@ -206,10 +288,12 @@ def b64png_to_array(data: str) -> np.ndarray:
 
 def build_infotext(payload: GenerationPayload, seed: int, subseed: int,
                    model_name: str = "", width: int = 0, height: int = 0,
-                   extra: str = "") -> str:
+                   extra: str = "", prompt_override: Optional[str] = None
+                   ) -> str:
     """webui-format generation parameters text (the string the reference
-    rewrites per gallery image at distributed.py:343-349)."""
-    lines = [payload.prompt]
+    rewrites per gallery image at distributed.py:343-349).
+    ``prompt_override``: this image's own prompt (per-image variation)."""
+    lines = [payload.prompt if prompt_override is None else prompt_override]
     if payload.negative_prompt:
         lines.append(f"Negative prompt: {payload.negative_prompt}")
     fields = [
@@ -224,6 +308,13 @@ def build_infotext(payload: GenerationPayload, seed: int, subseed: int,
     if payload.subseed_strength > 0:
         fields.append(f"Variation seed: {subseed}")
         fields.append(f"Variation seed strength: {payload.subseed_strength}")
+    if payload.seed_resize_from_w > 0 and payload.seed_resize_from_h > 0:
+        fields.append(f"Seed resize from: "
+                      f"{payload.seed_resize_from_w}x"
+                      f"{payload.seed_resize_from_h}")
+    ensd = (payload.override_settings or {}).get("eta_noise_seed_delta", 0)
+    if ensd:
+        fields.append(f"ENSD: {ensd}")
     if payload.denoising_strength != 0.75 and (
         payload.init_images or payload.enable_hr
     ):

@@ -48,6 +48,8 @@ class ModelRegistry:
         self._lora_cache: Dict[str, Dict] = {}
         self._vae_paths: Dict[str, str] = {}
         self._vae_cache: Dict[tuple, Dict] = {}
+        self._upscaler_paths: Dict[str, str] = {}
+        self._upscaler_cache: Dict[str, object] = {}
         self._active_vae = None
         self._engine = None
         self._secondary: Dict[str, object] = {}
@@ -90,6 +92,16 @@ class ModelRegistry:
                     if name.lower().endswith(".safetensors"):
                         self._vae_paths[os.path.splitext(name)[0]] = \
                             os.path.join(vae_dir, name)
+        self._upscaler_paths = {}
+        for up_dir in (os.path.join(self.model_dir, "ESRGAN"),
+                       os.path.join(self.model_dir, "RealESRGAN"),
+                       os.path.join(self.model_dir, "upscalers")):
+            if os.path.isdir(up_dir):
+                for name in sorted(os.listdir(up_dir)):
+                    if name.lower().endswith((".safetensors", ".pth")):
+                        self._upscaler_paths[os.path.splitext(name)[0]] = \
+                            os.path.join(up_dir, name)
+        self._upscaler_cache.clear()
         # adapters may have been replaced on disk — drop converted caches
         self._controlnet_cache.clear()
         self._lora_cache.clear()
@@ -104,6 +116,49 @@ class ModelRegistry:
 
     def available_vaes(self) -> Dict[str, str]:
         return dict(self._vae_paths)
+
+    def available_upscalers(self) -> Dict[str, str]:
+        return dict(self._upscaler_paths)
+
+    def upscaler_provider(self, name: str):
+        """hr_upscaler name -> upscale callable, or None (the engine then
+        falls back to latent bilinear with a warning). Matching ignores
+        case and punctuation so webui display names ("R-ESRGAN 4x+") find
+        their files ("RealESRGAN_x4plus.pth")."""
+        if not name:
+            return None
+        if name in self._upscaler_cache:
+            return self._upscaler_cache[name]
+
+        def canon(s: str) -> str:
+            s = s.lower().replace("+", "plus")
+            s = "".join(c for c in s if c.isalnum())
+            # webui display-name vs filename spellings: "R-ESRGAN 4x+"
+            # must find "RealESRGAN_x4plus"
+            if s.startswith("resrgan"):
+                s = "realesrgan" + s[len("resrgan"):]
+            return s.replace("4x", "x4").replace("2x", "x2")
+
+        path = self._upscaler_paths.get(name)
+        if path is None:
+            want = canon(name)
+            for stem, p in self._upscaler_paths.items():
+                cs = canon(stem)
+                if cs == want or want in cs or cs in want:
+                    path = p
+                    break
+        if path is None:
+            return None
+        from stable_diffusion_webui_distributed_tpu.models import esrgan
+
+        try:
+            fn = esrgan.make_upscaler(esrgan.load_esrgan(path))
+        except Exception as e:  # noqa: BLE001 — a bad file must not 500
+            get_logger().error("upscaler '%s' failed to load from %s: %s",
+                               name, path, e)
+            fn = None
+        self._upscaler_cache[name] = fn
+        return fn
 
     def set_vae(self, name: str) -> bool:
         """Apply a standalone VAE to the active engine ('Automatic'/'None'/
@@ -338,6 +393,7 @@ class ModelRegistry:
             lora_provider=self.lora_provider,
             controlnet_provider=self.controlnet_provider,
             engine_provider=self.secondary_engine,
+            upscaler_provider=self.upscaler_provider,
         )
 
     def activate(self, name: str):

@@ -19,7 +19,7 @@ batch explores the neighbourhood of ONE base noise. The init noise is
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -78,17 +78,54 @@ def batch_noise(
     batch_size: int,
     shape: Sequence[int],
     dtype=jnp.float32,
+    seed_resize: Optional[Tuple[int, int]] = None,
+    pin_index: bool = False,
 ) -> jax.Array:
     """Noise for a contiguous sub-batch starting at global image ``start_index``.
+
+    ``pin_index=True`` gives EVERY image index-0 noise (same-seed batches:
+    webui's prompt matrix pins all_seeds so prompts compare at one seed).
 
     This is the sharding-safe primitive: a job assigned images
     [start, start+batch) calls this and gets latents identical to a
     single-host run — seed-exact gallery merging for free.
+
+    ``seed_resize=(from_h, from_w)`` (latent units) reproduces webui's
+    seed-resize: noise (including any variation blend) is drawn at the
+    "from" resolution and pasted centered into the target latent — the
+    uncovered border stays zero, exactly webui's quirk — so one seed keeps
+    its composition across aspect-ratio changes.
     """
     idx = jnp.arange(batch_size, dtype=jnp.uint32) + jnp.asarray(start_index, jnp.uint32)
-    return jax.vmap(
-        lambda i: noise_for_image(seed, subseed, subseed_strength, i, shape, dtype)
+    if pin_index:
+        idx = jnp.zeros_like(idx)
+    if seed_resize is None:
+        return jax.vmap(
+            lambda i: noise_for_image(seed, subseed, subseed_strength, i, shape, dtype)
+        )(idx)
+
+    fh, fw = seed_resize
+    from_shape = (fh, fw) + tuple(shape[2:])
+    noise = jax.vmap(
+        lambda i: noise_for_image(seed, subseed, subseed_strength, i,
+                                  from_shape, dtype)
     )(idx)
+    return _paste_centered(noise, (batch_size,) + tuple(shape), dtype)
+
+
+def _paste_centered(noise: jax.Array, target_shape: Sequence[int],
+                    dtype) -> jax.Array:
+    """Center-paste (B, fh, fw, C) noise into zeros of (B, H, W, C) —
+    cropping when the source is larger (webui create_random_tensors)."""
+    _, fh, fw, _ = noise.shape
+    _, H, W, _ = target_shape
+    dy, dx = (H - fh) // 2, (W - fw) // 2
+    ty, sy = max(0, dy), max(0, -dy)
+    tx, sx = max(0, dx), max(0, -dx)
+    h, w = min(fh, H), min(fw, W)
+    out = jnp.zeros(target_shape, dtype)
+    return out.at[:, ty:ty + h, tx:tx + w].set(
+        noise[:, sy:sy + h, sx:sx + w])
 
 
 def slerp(t: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:

@@ -62,6 +62,23 @@ SAMPLERS = {
                                     ancestral=True, evals_per_step=2),
     "Euler a Karras": SamplerSpec("euler_a", schedule="karras", ancestral=True),
     "Euler Karras": SamplerSpec("euler", schedule="karras"),
+    # PLMS (ldm's pseudo linear multistep): Adams-Bashforth on the eps
+    # estimate over the DDIM leading-timestep grid, pseudo-improved-Euler
+    # warmup (2 evals on the first step only).
+    "PLMS": SamplerSpec("plms", schedule="ddim"),
+    # DPM fast: 2nd-order DPM-Solver on the uniform log-sigma grid
+    # k-diffusion's sample_dpm_fast walks. Multistep (history-based) so the
+    # model-eval budget stays ~= the requested step count — DPM fast's
+    # defining property (its NFE ~ n; a probe-based solver would double it).
+    "DPM fast": SamplerSpec("dpm_fast", schedule="exponential"),
+    # DPM adaptive: 3rd-order single-step DPM-Solver. The reference fleet's
+    # k-diffusion version re-sizes steps adaptively (PID-controlled NFE,
+    # ignoring the step slider); data-dependent step counts can't live in a
+    # compiled fixed-shape scan, so this walks the requested ladder at
+    # order 3 — the same solver family at the user's chosen budget. Its
+    # speed-table row (-61.4%, eta.py) reflects the 3 evals per step.
+    "DPM adaptive": SamplerSpec("dpm_solver_3", schedule="exponential",
+                                evals_per_step=3),
 }
 
 
@@ -78,11 +95,19 @@ def resolve_sampler(name: str) -> SamplerSpec:
 
 
 class Carry(NamedTuple):
-    """Scan carry: latent + one denoised history slot (multistep methods)."""
+    """Scan carry: latent + a 3-deep history of per-step estimates.
+
+    ``old_denoised`` is the newest history entry (``denoised`` for
+    DPM++ 2M-family, the eps estimate ``d`` for LMS/PLMS); ``hist2``/
+    ``hist3`` are one/two steps older — only PLMS's order-4 multistep reads
+    that deep. ``n_hist`` counts valid entries (0 at the first step)."""
 
     x: jax.Array
     old_denoised: jax.Array  # zeros until step 1
     have_old: jax.Array      # bool scalar
+    hist2: jax.Array         # zeros until step 2
+    hist3: jax.Array         # zeros until step 3
+    n_hist: jax.Array        # int32 scalar
 
 
 def _ancestral_split(sigma, sigma_next, eta: float = 1.0):
@@ -252,17 +277,105 @@ def make_sampler_step(
                               d + 0.5 * r * (d - d_prev), d)
             x_new = x + d_eff * h
 
+        elif algo == "plms":
+            # ldm's pseudo linear multistep (the webui PLMS sampler):
+            # Adams-Bashforth on the eps estimate, ramping order 2->4 as
+            # history fills; the first step probes sigma_next for a pseudo
+            # improved-Euler estimate. Terminal step uses plain d (exact).
+            h = sigma_next - sigma
+
+            def warmup(_):
+                sn = jnp.maximum(sigma_next, 1e-10)
+                x_eul = x + d * h
+                denoised2 = denoise_fn(x_eul, sn, i)
+                return (d + to_d(x_eul, sn, denoised2)) / 2
+
+            def multistep(_):
+                d1, d2_, d3 = carry.old_denoised, carry.hist2, carry.hist3
+                o2 = (3 * d - d1) / 2
+                o3 = (23 * d - 16 * d1 + 5 * d2_) / 12
+                o4 = (55 * d - 59 * d1 + 37 * d2_ - 9 * d3) / 24
+                n = carry.n_hist
+                return jnp.where(n >= 3, o4, jnp.where(n == 2, o3, o2))
+
+            d_prime = jax.lax.cond(carry.n_hist > 0, multistep, warmup,
+                                   operand=None)
+            d_prime = jnp.where(sigma_next > 0, d_prime, d)
+            x_new = x + d_prime * h
+
+        elif algo == "dpm_fast":
+            # Multistep 2nd-order DPM-Solver in the VE eps parameterization:
+            # slope of eps estimated from the PREVIOUS step's d (1 model
+            # eval per step). First step is solver-1 (== Euler); terminal
+            # step collapses to the denoised prediction (exact).
+            t = -jnp.log(jnp.maximum(sigma, 1e-10))
+            sn = jnp.maximum(sigma_next, 1e-10)
+            h = -jnp.log(sn) - t
+            sigma_prev = sigmas[jnp.maximum(i - 1, 0)]
+            h_last = t + jnp.log(jnp.maximum(sigma_prev, 1e-10))
+            i0 = sigma - sigma_next
+            i1 = sigma - sigma_next - h * sigma_next
+            d_prev = carry.old_denoised
+            c1 = (d - d_prev) / jnp.maximum(h_last, 1e-10)
+            c1 = jnp.where(carry.have_old, c1, jnp.zeros_like(c1))
+            x_new = x - i0 * d - i1 * c1
+            x_new = jnp.where(sigma_next > 0, x_new, denoised)
+
+        elif algo in ("dpm_solver_2", "dpm_solver_3"):
+            # Single-step DPM-Solver, order 2 (midpoint) or 3 (thirds), in
+            # the VE eps parameterization (Lu et al. 2022; k-diffusion's
+            # dpm_solver_2_step/3_step walk the same exponential-integrator
+            # updates). Exact integrals of the Taylor terms over the step:
+            #   I0 = ∫σ ds = σ−σ', I1 = ∫(s−t)σ ds = σ−σ'−hσ',
+            #   I2 = ∫(s−t)²σ ds = 2·I1 − h²σ'   (with t = −log σ).
+            def solver(_):
+                sn = jnp.maximum(sigma_next, 1e-10)
+                t = -jnp.log(jnp.maximum(sigma, 1e-10))
+                h = -jnp.log(sn) - t
+                i0 = sigma - sigma_next
+                i1 = sigma - sigma_next - h * sigma_next
+                if algo == "dpm_solver_2":
+                    a = 0.5 * h
+                    sig1 = jnp.exp(-(t + a))
+                    u1 = x + d * (sig1 - sigma)  # Euler probe to midpoint
+                    d1 = to_d(u1, sig1, denoise_fn(u1, sig1, i))
+                    c1 = (d1 - d) / a            # eps' estimate
+                    return x - i0 * d - i1 * c1
+                # order 3: probes at r1=1/3, r2=2/3; quadratic fit in s
+                a = h / 3.0
+                b = 2.0 * h / 3.0
+                sig1 = jnp.exp(-(t + a))
+                sig2 = jnp.exp(-(t + b))
+                u1 = x + d * (sig1 - sigma)
+                d1 = to_d(u1, sig1, denoise_fn(u1, sig1, i))
+                # 2nd-order probe to s2 using the midstep slope
+                i0b = sigma - sig2
+                i1b = sigma - sig2 - b * sig2
+                u2 = x - i0b * d - i1b * (d1 - d) / a
+                d2_ = to_d(u2, sig2, denoise_fn(u2, sig2, i))
+                denom = a * b * (b - a)
+                c1 = (b * b * (d1 - d) - a * a * (d2_ - d)) / denom
+                c2 = (a * (d2_ - d) - b * (d1 - d)) / denom
+                i2 = 2.0 * i1 - h * h * sigma_next
+                return x - i0 * d - i1 * c1 - i2 * c2
+
+            x_new = jax.lax.cond(sigma_next > 0, solver,
+                                 lambda _: denoised, operand=None)
+
         else:  # pragma: no cover
             raise ValueError(f"unknown sampler algorithm {algo}")
 
-        history = d if algo == "lms" else denoised
-        return Carry(x_new, history, jnp.bool_(True)), ()
+        history = d if algo in ("lms", "plms", "dpm_fast") else denoised
+        return Carry(x_new, history, jnp.bool_(True),
+                     carry.old_denoised, carry.hist2,
+                     carry.n_hist + 1), ()
 
     return step
 
 
 def init_carry(x: jax.Array) -> Carry:
-    return Carry(x, jnp.zeros_like(x), jnp.bool_(False))
+    z = jnp.zeros_like(x)
+    return Carry(x, z, jnp.bool_(False), z, z, jnp.int32(0))
 
 
 def run_steps(

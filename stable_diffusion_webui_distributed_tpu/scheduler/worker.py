@@ -464,6 +464,7 @@ class StubBackend:
         ):
             raise ConnectionError("stub backend injected failure")
         result = GenerationResult()
+        pinned = payload.same_seed or payload.subseed_strength > 0
         for i in range(start_index, start_index + count):
             if b.seconds_per_image:
                 # sleep in slices so an interrupt lands mid-flight, like a
@@ -473,12 +474,18 @@ class StubBackend:
                     time.sleep(0.01)
             if self.interrupted:
                 break
-            result.images.append(f"stub-image-{payload.seed + i}")
-            result.seeds.append(payload.seed + i)
-            result.subseeds.append(payload.subseed + i)
-            result.prompts.append(payload.prompt)
+            # per-image seed/prompt arithmetic mirrors Engine._append_images
+            seed_i = payload.seed + (0 if pinned else i)
+            sub_i = payload.subseed + (0 if payload.same_seed else i)
+            prompt_i = payload.prompt
+            if payload.all_prompts and i < len(payload.all_prompts):
+                prompt_i = payload.all_prompts[i]
+            result.images.append(f"stub-image-{seed_i}")
+            result.seeds.append(seed_i)
+            result.subseeds.append(sub_i)
+            result.prompts.append(prompt_i)
             result.negative_prompts.append(payload.negative_prompt)
-            result.infotexts.append(f"{payload.prompt}, Seed: {payload.seed + i}")
+            result.infotexts.append(f"{prompt_i}, Seed: {seed_i}")
             result.worker_labels.append("")
         return result
 
@@ -539,10 +546,16 @@ class HTTPBackend:
                  count: int) -> GenerationResult:
         body = payload.model_dump()
         # seed fan-out arithmetic, identical to the reference master
-        # (distributed.py:297-305): offset by prior images
-        if payload.subseed_strength == 0:
+        # (distributed.py:297-305): offset by prior images. Same-seed
+        # batches (prompt matrix) pin every image to the request seed.
+        if payload.subseed_strength == 0 and not payload.same_seed:
             body["seed"] = payload.seed + start_index
-        body["subseed"] = payload.subseed + start_index
+        if not payload.same_seed:
+            body["subseed"] = payload.subseed + start_index
+        # per-image prompts: the remote gets ITS slice, indexed from 0
+        if payload.all_prompts:
+            body["all_prompts"] = \
+                payload.all_prompts[start_index:start_index + count]
         body["batch_size"] = count
         body["n_iter"] = 1
         route = "img2img" if payload.init_images else "txt2img"
@@ -591,16 +604,18 @@ class HTTPBackend:
     def restart(self) -> None:
         """POST /server-restart (the reference's fleet-restart leg,
         worker.py:690-717). A server that re-execs before answering drops
-        the connection — that still counts as delivered."""
+        the connection or never flushes a response — both count as
+        delivered; only failing to CONNECT is a real failure."""
+        import requests
+
         try:
             self.session.post(self.url("server-restart"),
                               timeout=self.timeout)
-        except Exception as e:  # noqa: BLE001
-            import requests
-
-            if isinstance(e, requests.exceptions.ConnectionError):
-                return  # process went down to restart: expected
-            raise
+        except requests.exceptions.ConnectTimeout:
+            raise  # never reached the worker
+        except (requests.exceptions.ConnectionError,
+                requests.exceptions.ReadTimeout):
+            return  # process went down (or stopped answering) to restart
 
     def load_options(self, model: str, vae: str = "") -> None:
         body = {"sd_model_checkpoint": model}

@@ -65,6 +65,15 @@ class Job:
         return False
 
 
+#: alwayson scripts that re-run generation themselves (post-process loops
+#: like ADetailer's per-face img2img): distributing the outer request would
+#: multiply their inner passes per worker and skew gallery accounting, so
+#: such requests bypass distribution and run whole on the master — the
+#: reference bails out of its hook the same way
+#: (/root/reference/scripts/distributed.py:207-212).
+SELF_LOOPING_SCRIPTS = frozenset({"adetailer", "ddetailer", "ddsd"})
+
+
 class World:
     """Backend registry + job planner + request executor."""
 
@@ -313,6 +322,7 @@ class World:
         improvement over the reference, which drops those images —
         SURVEY.md §5 failure handling)."""
         from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts,
             fix_seed,
         )
 
@@ -329,9 +339,42 @@ class World:
         # resolve random seeds ONCE before fan-out so every backend derives
         # the same contiguous per-image seed range (the reference fixes the
         # seed before building per-worker payloads, distributed.py:252-254)
+        # native script expansion BEFORE planning: prompt matrix replaces
+        # batch_size with the combination count, so jobs split the right
+        # total (idempotent — a sub-range arriving over HTTP is pre-sliced)
+        payload = apply_scripts(payload)
         payload = payload.model_copy()
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
+
+        looping = [k for k in (payload.alwayson_scripts or {})
+                   if k.lower() in SELF_LOOPING_SCRIPTS]
+        if looping:
+            # script will re-run generation itself: bail out of
+            # distribution (reference distributed.py:207-212). The solo
+            # backend must be SCHEDULABLE (not disabled / thin-client
+            # master) and synced to the fleet checkpoint like any job.
+            schedulable = self.get_workers()
+            solo = next((w for w in schedulable if w.master),
+                        next(iter(schedulable), None))
+            if solo is None:
+                raise RuntimeError("no backend available")
+            log.info("script %s re-runs generation; bypassing distribution "
+                     "and running on '%s'", looping, solo.label)
+            if self.current_model and not solo.master:
+                if not solo.load_options(self.current_model,
+                                         self.current_vae):
+                    raise RuntimeError(
+                        f"model sync to '{solo.label}' failed")
+            result = solo.request(payload, 0, payload.total_images)
+            if result is None:
+                raise RuntimeError(
+                    f"'{solo.label}' failed the undistributed request")
+            result.parameters = payload.model_dump()
+            result.worker_labels = [solo.label] * len(result.images)
+            self.save_config()
+            return result
+
         jobs = self.plan(payload)
         summary = ", ".join(
             f"{j.worker.label}:{j.batch_size}"

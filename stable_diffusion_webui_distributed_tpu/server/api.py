@@ -67,6 +67,7 @@ class ApiServer:
         }
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._busy = threading.Lock()
+        self._benchmarking = threading.Lock()
         self.restart_requested = False
         self._styles_cache: Tuple = ((None, None), {})
 
@@ -75,9 +76,14 @@ class ApiServer:
     def _execute(self, payload: GenerationPayload) -> GenerationResult:
         if hasattr(self.source, "execute"):
             return self.source.execute(payload)  # World resets the latch
-        # bare Engine: this request is the top level — reset the latch here
+        # bare Engine: this request is the top level — reset the latch and
+        # expand native scripts here
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts,
+        )
+
         self.state.begin_request()
-        return self.source.generate_range(payload)
+        return self.source.generate_range(apply_scripts(payload))
 
     def _generation_response(self, result: GenerationResult) -> Dict[str, Any]:
         images = list(result.images)
@@ -311,9 +317,20 @@ class ApiServer:
                     "master": w.master,
                 })
         p = self.state.progress
+        settings = None
+        if hasattr(self.source, "job_timeout"):
+            settings = {
+                "job_timeout": self.source.job_timeout,
+                "complement_production": getattr(
+                    self.source, "complement_production", True),
+                "step_scaling": getattr(self.source, "step_scaling", False),
+                "thin_client_mode": getattr(
+                    self.source, "thin_client_mode", False),
+            }
         return {
             "model": self.options.get("sd_model_checkpoint", ""),
             "workers": workers,
+            "settings": settings,
             "progress": {
                 "job": p.job,
                 "sampling_step": p.sampling_step,
@@ -403,6 +420,30 @@ class ApiServer:
             raise ApiError(404, f"no worker '{label}'")
         return {"updated": label, **kwargs}
 
+    def handle_benchmark(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Kick a fleet benchmark sweep in the background (the reference's
+        "Redo benchmark" debug button, ui.py:282-287 area). Returns
+        immediately; progress is visible as worker speeds update."""
+        if not hasattr(self.source, "benchmark_all"):
+            raise ApiError(400, "no fleet attached to this node")
+        # non-blocking acquire, released by the worker thread: a locked()
+        # pre-check would race a double-click into two full sweeps
+        if not self._benchmarking.acquire(blocking=False):
+            return {"started": False, "reason": "benchmark already running"}
+
+        def run():
+            try:
+                self.source.benchmark_all(
+                    rebenchmark=bool(body.get("rebenchmark", True)))
+            except Exception as e:  # noqa: BLE001
+                get_logger().error("benchmark sweep failed: %s", e)
+            finally:
+                self._benchmarking.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name="benchmark-sweep").start()
+        return {"started": True}
+
     def handle_panel(self) -> str:
         from stable_diffusion_webui_distributed_tpu.server.panel import (
             PANEL_HTML,
@@ -418,6 +459,7 @@ class ApiServer:
             ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
             ("POST", "/internal/restart-all"): self.handle_restart_all,
+            ("POST", "/internal/benchmark"): self.handle_benchmark,
             ("GET", "/internal/workers"): self.handle_workers_get,
             ("POST", "/internal/workers"): self.handle_workers_post,
             ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,

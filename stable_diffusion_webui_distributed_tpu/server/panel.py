@@ -1,18 +1,23 @@
-"""Built-in status panel: the reference's Gradio Status tab, reborn as a
-dependency-free HTML page.
+"""Built-in control panel: the reference's Gradio Status + Worker Config +
+Settings tabs, reborn as one dependency-free HTML page.
 
-Parity targets (reference ui.py:217-404 + javascript/distributed.js): live
-worker table with states and speeds, the 16-line log ring buffer, generation
-progress, and a periodic auto-refresh (the reference's JS polls a hidden
-refresh button every 1.5 s — distributed.js:7-23; this page fetches
-``/internal/status`` on the same cadence).
+Parity targets (reference ui.py:26-404 + javascript/distributed.js):
+- live worker table with states/speeds + per-worker controls — checkpoint
+  pin (model_override), pixel cap, enable/disable (ui.py:90-214);
+- fleet buttons: interrupt all (ui.py:271-272), restart all workers with
+  the confirm dialog the reference keeps client-side (ui.py:274-280,
+  distributed.js:2-4), re-benchmark, reset MPE (ui.py:282-287);
+- runtime settings: job timeout, complement production, step scaling,
+  thin-client (ui.py:26-55) via POST /sdapi/v1/options;
+- the 16-line log ring, generation progress, stage timings, and the
+  1.5 s auto-refresh cadence (distributed.js:7-23).
 """
 
 PANEL_HTML = """<!doctype html>
 <html>
 <head>
 <meta charset="utf-8">
-<title>sdtpu — distributed status</title>
+<title>sdtpu — distributed control</title>
 <style>
   body { font-family: ui-monospace, monospace; background: #101418;
          color: #d5dbe1; margin: 2rem; }
@@ -29,6 +34,14 @@ PANEL_HTML = """<!doctype html>
           overflow-x: auto; }
   #bar { height: 6px; background: #2a3138; width: 36rem; }
   #fill { height: 6px; background: #7bd88f; width: 0; }
+  button { background: #1a2026; color: #d5dbe1; border: 1px solid #2a3138;
+           padding: .25rem .7rem; cursor: pointer; font: inherit; }
+  button:hover { background: #2a3138; }
+  input[type=number] { width: 6rem; }
+  input, label { font: inherit; background: #0b0e11; color: #d5dbe1;
+                 border: 1px solid #2a3138; }
+  .danger { border-color: #ff6188; }
+  #settings label { border: 0; background: none; margin-right: 1.2rem; }
 </style>
 </head>
 <body>
@@ -36,15 +49,80 @@ PANEL_HTML = """<!doctype html>
 <div>model: <span id="model">?</span> &middot; job: <span id="job"></span>
   <span id="step"></span></div>
 <div id="bar"><div id="fill"></div></div>
+<p>
+  <button onclick="post('/sdapi/v1/interrupt', {})">interrupt all</button>
+  <button onclick="benchmark()">re-benchmark</button>
+  <button onclick="post('/internal/reset-mpe', {})">reset MPE</button>
+  <button class="danger" onclick="restartAll()">restart all workers</button>
+</p>
 <h2>workers</h2>
 <table><thead><tr><th>label</th><th>state</th><th>speed</th><th>master</th>
-</tr></thead><tbody id="workers"></tbody></table>
+<th>pixel cap</th><th>model pin</th><th></th></tr></thead>
+<tbody id="workers"></tbody></table>
+<h2>settings</h2>
+<form id="settings" onsubmit="return saveSettings()">
+  <label>job timeout (s)
+    <input type="number" id="job_timeout" min="0" step="1"></label>
+  <label><input type="checkbox" id="complement_production">
+    complementary production</label>
+  <label><input type="checkbox" id="step_scaling"> step scaling</label>
+  <label><input type="checkbox" id="thin_client_mode"> thin client</label>
+  <button type="submit">apply</button>
+</form>
 <h2>stage timings (p50)</h2>
 <table><thead><tr><th>stage</th><th>p50</th><th>mean</th><th>count</th>
 </tr></thead><tbody id="timings"></tbody></table>
 <h2>log</h2>
 <div id="logs"></div>
 <script>
+async function post(url, body) {
+  try {
+    await fetch(url, {method: 'POST',
+      headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify(body)});
+  } catch (e) { /* server restarting */ }
+  tick();
+}
+function restartAll() {
+  // the reference keeps this confirm client-side (distributed.js:2-4)
+  if (confirm('Restart ALL workers?')) post('/internal/restart-all', {});
+}
+function benchmark() { post('/internal/benchmark', {rebenchmark: true}); }
+// workers cached by index: handlers never interpolate server-provided
+// strings into JS or HTML (a label/pin containing quotes must not become
+// markup — stored-XSS guard)
+let workerRows = [];
+const esc = s => String(s).replace(/[&<>"']/g, c => ({
+  '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;'}[c]));
+function setPin(i) {
+  const w = workerRows[i];
+  const v = prompt(`checkpoint pin for '${w.label}' (empty = follow fleet)`,
+                   w.model_override || '');
+  if (v !== null) post('/internal/workers',
+                       {label: w.label, model_override: v});
+}
+function setCap(i) {
+  const w = workerRows[i];
+  const v = prompt(`pixel cap for '${w.label}' (width*height*batch, 0 = ` +
+                   'uncapped)', w.pixel_cap || '0');
+  if (v !== null) post('/internal/workers',
+                       {label: w.label, pixel_cap: parseInt(v) || 0});
+}
+function toggle(i) {
+  const w = workerRows[i];
+  post('/internal/workers', {label: w.label, disabled: !w.disabled});
+}
+function saveSettings() {
+  post('/sdapi/v1/options', {
+    job_timeout: parseInt(document.getElementById('job_timeout').value),
+    complement_production:
+      document.getElementById('complement_production').checked,
+    step_scaling: document.getElementById('step_scaling').checked,
+    thin_client_mode: document.getElementById('thin_client_mode').checked,
+  });
+  return false;
+}
+let settingsLoaded = false;
 async function tick() {
   try {
     const r = await fetch('/internal/status');
@@ -56,16 +134,35 @@ async function tick() {
       ` ${s.progress.sampling_step}/${s.progress.sampling_steps}` : '';
     document.getElementById('fill').style.width =
       (100 * (s.progress.fraction || 0)) + '%';
-    document.getElementById('workers').innerHTML = s.workers.map(w =>
-      `<tr><td>${w.label}</td><td class="${w.state}">${w.state}</td>` +
-      `<td>${w.avg_ipm ? w.avg_ipm.toFixed(2) + ' ipm' : '—'}</td>` +
-      `<td>${w.master ? 'yes' : ''}</td></tr>`).join('');
     document.getElementById('timings').innerHTML =
       Object.entries(s.timings).map(([k, v]) =>
         `<tr><td>${k}</td><td>${(v.p50 * 1000).toFixed(1)} ms</td>` +
         `<td>${(v.mean * 1000).toFixed(1)} ms</td><td>${v.count}</td></tr>`
       ).join('');
     document.getElementById('logs').textContent = s.logs.join('\\n');
+    const wr = await fetch('/internal/workers');
+    workerRows = await wr.json();
+    document.getElementById('workers').innerHTML = workerRows.map((w, i) =>
+      `<tr><td>${esc(w.label)}</td>` +
+      `<td class="${esc(w.state)}">${esc(w.state)}</td>` +
+      `<td>${w.avg_ipm ? w.avg_ipm.toFixed(2) + ' ipm' : '—'}</td>` +
+      `<td>${w.master ? 'yes' : ''}</td>` +
+      `<td><a href="#" onclick="setCap(${i});return false">` +
+      `${w.pixel_cap || '—'}</a></td>` +
+      `<td><a href="#" onclick="setPin(${i});return false">` +
+      `${w.model_override ? esc(w.model_override) : '—'}</a></td>` +
+      `<td><button onclick="toggle(${i})">` +
+      `${w.disabled ? 'enable' : 'disable'}</button></td></tr>`).join('');
+    if (!settingsLoaded && s.settings) {
+      document.getElementById('job_timeout').value = s.settings.job_timeout;
+      document.getElementById('complement_production').checked =
+        s.settings.complement_production;
+      document.getElementById('step_scaling').checked =
+        s.settings.step_scaling;
+      document.getElementById('thin_client_mode').checked =
+        s.settings.thin_client_mode;
+      settingsLoaded = true;
+    }
   } catch (e) { /* server restarting */ }
 }
 setInterval(tick, 1500);  // reference cadence: distributed.js polls at 1.5 s

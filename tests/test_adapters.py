@@ -137,6 +137,36 @@ class TestLoraPromptSyntax:
         assert len(r.images) == 1
 
 
+class TestInpaintPreprocessor:
+    def test_masked_pixels_become_minus_one(self):
+        img = np.full((8, 8, 3), 128, np.uint8)
+        mask = np.zeros((8, 8), np.uint8)
+        mask[2:4, 2:4] = 255
+        out = run_preprocessor("inpaint", img, mask=mask)
+        np.testing.assert_allclose(out[2:4, 2:4], -1.0)
+        np.testing.assert_allclose(out[0, 0], 128 / 255.0, rtol=1e-6)
+        # mask-less call degrades to plain normalization
+        plain = run_preprocessor("inpaint_only", img)
+        np.testing.assert_allclose(plain, 128 / 255.0, rtol=1e-6)
+
+    def test_engine_parses_mikubill_mask_channel(self):
+        eng = Engine(TINY, init_params(TINY), chunk_size=4,
+                     state=GenerationState(),
+                     controlnet_provider=lambda name: None)
+        mask = np.zeros((16, 16), np.uint8)
+        mask[:8] = 255
+        payload = GenerationPayload(
+            prompt="x", steps=2, width=32, height=32, seed=1,
+            alwayson_scripts={"controlnet": {"args": [{
+                "enabled": True,
+                "image": {"image": array_to_b64png(
+                    np.full((16, 16, 3), 200, np.uint8)),
+                    "mask": array_to_b64png(mask)},
+                "module": "inpaint", "model": "inp"}]}})
+        units = eng._parse_controlnet_units(payload)
+        assert len(units) == 1 and units[0]["mask"] is not None
+
+
 def make_ldm_controlnet(cfg, prefix="control_model"):
     """Synthetic ldm ControlNet state dict for the TINY unet config."""
     sd = {}

@@ -245,6 +245,82 @@ class TestImg2Img:
         assert (back.seed, back.subseed) == (1234, 99)
         assert back.subseed_strength == 0.4
 
+    def test_infotext_round_trip_seed_resize_and_ensd(self):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            build_infotext, parse_infotext,
+        )
+
+        p = GenerationPayload(
+            prompt="cow", steps=10, seed=5,
+            seed_resize_from_w=1024, seed_resize_from_h=768,
+            override_settings={"eta_noise_seed_delta": 31337})
+        back = parse_infotext(build_infotext(p, 5, 0, "m"))
+        assert (back.seed_resize_from_w, back.seed_resize_from_h) == \
+            (1024, 768)
+        assert back.override_settings["eta_noise_seed_delta"] == 31337
+
+    def test_seed_resize_and_ensd_change_output_deterministically(
+            self, engine):
+        base = dict(prompt="s", steps=3, width=32, height=32, seed=11)
+        plain = engine.txt2img(GenerationPayload(**base))
+        resized = engine.txt2img(GenerationPayload(
+            **base, seed_resize_from_w=16, seed_resize_from_h=16))
+        assert resized.images[0] != plain.images[0]
+        again = engine.txt2img(GenerationPayload(
+            **base, seed_resize_from_w=16, seed_resize_from_h=16))
+        assert again.images[0] == resized.images[0]
+        # ENSD shifts the ancestral sampler noise (Euler a default)
+        shifted = engine.txt2img(GenerationPayload(
+            **base, override_settings={"eta_noise_seed_delta": 31337}))
+        assert shifted.images[0] != plain.images[0]
+
+    def test_prompt_matrix_expansion_order(self):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            expand_prompt_matrix,
+        )
+
+        got = expand_prompt_matrix("a cow|red|blue")
+        # binary-counter order: bit j of index i selects option j (webui
+        # scripts/prompt_matrix.py semantics)
+        assert got == ["a cow", "a cow, red", "a cow, blue",
+                       "a cow, red, blue"]
+
+    def test_prompt_matrix_end_to_end(self, engine):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts,
+        )
+
+        p = GenerationPayload(prompt="a cow|red", steps=3, width=32,
+                              height=32, seed=21,
+                              script_name="Prompt Matrix")
+        expanded = apply_scripts(p)
+        assert expanded.batch_size == 2 and expanded.same_seed
+        # the user's original batch_size caps the compiled dispatch group
+        assert expanded.group_size == 1
+        r = engine.txt2img(p)
+        assert len(r.images) == 2
+        assert r.prompts == ["a cow", "a cow, red"]
+        assert r.seeds == [21, 21]  # fixed seed across the matrix
+        assert r.images[0] != r.images[1]  # prompts actually condition
+        assert "a cow, red" in r.infotexts[1]
+        # matrix cell 0 == a plain single generation of the base prompt at
+        # the same seed (same index-0 noise, same conditioning)
+        plain = engine.txt2img(GenerationPayload(
+            prompt="a cow", steps=3, width=32, height=32, seed=21))
+        assert r.images[0] == plain.images[0]
+
+    def test_all_prompts_range_contract(self, engine):
+        # per-image prompts must survive the fan-out split: generating
+        # [1, 3) standalone reproduces those rows of the full batch
+        p = GenerationPayload(prompt="base", steps=3, width=32, height=32,
+                              seed=9,
+                              all_prompts=["base", "base b", "base c"],
+                              batch_size=3)
+        full = engine.txt2img(p)
+        part = engine.generate_range(p, 1, 2)
+        assert part.images == full.images[1:3]
+        assert part.prompts == ["base b", "base c"]
+
     def test_hires_upscaler_variants(self, engine):
         base = dict(prompt="h", steps=3, width=32, height=32, seed=4,
                     enable_hr=True, hr_scale=2.0, denoising_strength=0.7)

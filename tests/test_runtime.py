@@ -139,6 +139,27 @@ class TestRng:
         b = rng.batch_noise(103, 0, 0.0, 0, 1, shape)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_seed_resize_pastes_centered(self):
+        # webui seed-resize: noise drawn at the "from" latent size lands
+        # centered in the target; the uncovered border stays zero.
+        shape = (8, 8, 4)
+        src = rng.batch_noise(42, 0, 0.0, 0, 2, (4, 4, 4))
+        out = rng.batch_noise(42, 0, 0.0, 0, 2, shape, seed_resize=(4, 4))
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 2:6, 2:6]), np.asarray(src))
+        border = np.asarray(out).copy()
+        border[:, 2:6, 2:6] = 0
+        assert not border.any()
+        # larger-than-target from-size: the CENTER of the source is kept
+        big = rng.batch_noise(42, 0, 0.0, 0, 2, (8, 8, 4))
+        crop = rng.batch_noise(42, 0, 0.0, 0, 2, (4, 4, 4),
+                               seed_resize=(8, 8))
+        np.testing.assert_array_equal(
+            np.asarray(big[:, 2:6, 2:6]), np.asarray(crop))
+        # sub-batch contract survives seed-resize
+        part = rng.batch_noise(42, 0, 0.0, 1, 1, shape, seed_resize=(4, 4))
+        np.testing.assert_array_equal(np.asarray(out[1:2]), np.asarray(part))
+
     def test_different_seeds_differ(self):
         shape = (2, 4, 4)
         a = rng.noise_for_image(1, 0, 0.0, 0, shape)

@@ -358,6 +358,61 @@ class TestExecute:
         assert (req["start"], req["count"]) == (3, 2)
         assert recovered[0].step_override == 7
 
+    def test_http_backend_slices_all_prompts_and_pins_same_seed(self):
+        # the wire fan-out: a remote gets ITS slice of all_prompts indexed
+        # from 0, and same-seed (prompt matrix) batches keep the request
+        # seed un-offset
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        captured = {}
+
+        class FakeResp:
+            status_code = 200
+
+            def raise_for_status(self):
+                pass
+
+            def json(self):
+                n = captured["body"]["batch_size"]
+                return {"images": ["x"] * n, "info": {
+                    "all_seeds": [0] * n, "all_subseeds": [0] * n,
+                    "all_prompts": [""] * n, "infotexts": [""] * n}}
+
+        backend = HTTPBackend("h", 1)
+        backend.session.post = lambda url, json=None, timeout=0: (
+            captured.update(body=json) or FakeResp())
+
+        p = payload(batch_size=6, seed=100,
+                    all_prompts=[f"p{i}" for i in range(6)], same_seed=True)
+        backend.generate(p, 2, 3)
+        assert captured["body"]["all_prompts"] == ["p2", "p3", "p4"]
+        assert captured["body"]["seed"] == 100  # pinned, not offset
+        # without same_seed the classic offset applies
+        p2 = payload(batch_size=6, seed=100,
+                     all_prompts=[f"p{i}" for i in range(6)])
+        backend.generate(p2, 2, 3)
+        assert captured["body"]["seed"] == 102
+        assert captured["body"]["all_prompts"] == ["p2", "p3", "p4"]
+
+    def test_self_looping_script_bypasses_distribution(self):
+        # ADetailer-style scripts re-run img2img themselves; the request
+        # must run whole on the master (reference distributed.py:207-212)
+        w = World(ConfigModel())
+        m = node("m", 10.0, master=True)
+        a = node("a", 10.0)
+        w.add_worker(m)
+        w.add_worker(a)
+        r = w.execute(payload(
+            batch_size=4, seed=100,
+            alwayson_scripts={"ADetailer": {"args": [{"enabled": True}]}}))
+        assert len(r.images) == 4
+        assert len(a.backend.requests) == 0  # never distributed
+        assert len(m.backend.requests) == 1
+        assert m.backend.requests[0]["count"] == 4
+        assert r.worker_labels == ["m"] * 4
+
     def test_inflight_interrupt_aborts_remote_request(self):
         # While an HTTP-style request is in flight, the watchdog polls the
         # master's interrupt flag and fires backend.interrupt() — the
@@ -621,12 +676,14 @@ class TestBenchmark:
         for n_ in (caps, bare):
             n_.reachable()  # populates supported_scripts
         p = payload(batch_size=4, seed=1)
+        # "dynamic prompts" is a plain per-request script (NOT one of the
+        # self-looping set that bypasses distribution, SELF_LOOPING_SCRIPTS)
         p.alwayson_scripts = {"controlnet": {"args": [{"enabled": True}]},
-                              "adetailer": {"args": []}}
+                              "dynamic prompts": {"args": []}}
         w.execute(p)
         sent_caps = caps.backend.requests[-1]["payload"].alwayson_scripts
         sent_bare = bare.backend.requests[-1]["payload"].alwayson_scripts
-        assert set(sent_caps) == {"controlnet"}  # adetailer stripped
+        assert set(sent_caps) == {"controlnet"}  # unsupported stripped
         assert sent_bare == {}
 
     def test_thin_client_mode_excludes_master(self):

@@ -137,6 +137,28 @@ class TestRoutes:
         finally:
             world.workers.remove(extra)
 
+    def test_benchmark_route_sweeps_fleet(self, server):
+        import time
+
+        world = server.source
+        fresh = WorkerNode("r3", StubBackend())  # no calibration yet
+        world.add_worker(fresh)
+        try:
+            out = call(server, "/internal/benchmark", {"rebenchmark": False})
+            assert out["started"] is True
+            deadline = time.monotonic() + 20
+            while fresh.cal.avg_ipm is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fresh.cal.avg_ipm and fresh.cal.avg_ipm > 0
+        finally:
+            world.workers.remove(fresh)
+
+    def test_status_reports_settings(self, server):
+        out = call(server, "/internal/status")
+        s = out["settings"]
+        assert {"job_timeout", "complement_production", "step_scaling",
+                "thin_client_mode"} <= set(s)
+
     def test_options_apply_scheduler_settings(self, server):
         world = server.source
         old = world.job_timeout
