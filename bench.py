@@ -83,8 +83,10 @@ def _zeros(mod, *args):
     import jax.numpy as jnp
 
     shapes = jax.eval_shape(lambda: mod.init(jax.random.key(0), *args))
-    return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes)["params"]
+    # one jitted call: per-leaf jnp.zeros would be ~1000 separate device
+    # allocations (tens of seconds through the TPU relay)
+    return jax.jit(lambda: jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes))()["params"]
 
 
 def _family_params(family):
@@ -162,8 +164,9 @@ def _make_engine(family, refiner_family=None, lora_names=(),
     def engine_provider(name):
         return engines.get(name)
 
+    chunk = int(os.environ.get("SDTPU_CHUNK", "5"))  # sweepable knob
     engine = Engine(family, params, policy=policy,
-                    model_name=f"{family.name}-bench",
+                    model_name=f"{family.name}-bench", chunk_size=chunk,
                     lora_provider=lora_provider,
                     controlnet_provider=controlnet_provider,
                     engine_provider=engine_provider)
@@ -429,6 +432,14 @@ def main() -> None:
 
     jax.devices()
     init_done.set()
+
+    # persist XLA executables across bench invocations (a tuning sweep
+    # re-runs the same configs; first SDXL compile is minutes)
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     print(json.dumps(run_config(args.config, tiny)))
 

@@ -503,8 +503,13 @@ class Engine:
         cleaned = [extract_lora_tags(p)[0] for p in prompt_list]
         toks = [tokenize_weighted(tok, c) for c in cleaned]
         ids_u, w_u = tokenize_weighted(tok, payload.negative_prompt)
-        # cond and uncond must agree on context length (webui pads both)
-        n = max([t[0].shape[0] for t in toks] + [ids_u.shape[0]])
+        # cond and uncond must agree on context length (webui pads both);
+        # payload.context_chunks floors it at the REQUEST-wide max so an
+        # image's conditioning doesn't depend on its dispatch group /
+        # worker slice (seed-exactness across the fan-out, payload.py)
+        n = max([t[0].shape[0] for t in toks] + [ids_u.shape[0]]
+                + ([payload.context_chunks] if payload.context_chunks
+                   else []))
         bos, eos = tok.bos, tok.eos
         ids_u, w_u = pad_chunks(ids_u, w_u, n, eos, bos)
 
@@ -528,6 +533,29 @@ class Engine:
             ctx_u, pooled_u = enc(te, te2, jnp.asarray(ids_u),
                                   jnp.asarray(w_u), skip)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
+
+    def request_context_chunks(self, payload: GenerationPayload) -> int:
+        """Max context length in 77-token chunks over the request's full
+        prompt set (every all_prompts row + the negative prompt). The
+        planning master pins this into ``payload.context_chunks`` before
+        any slicing so every dispatch group on every worker pads
+        conditioning to the same chunk count (see payload.py)."""
+        from stable_diffusion_webui_distributed_tpu.models.lora import (
+            extract_lora_tags,
+        )
+        from stable_diffusion_webui_distributed_tpu.models.prompt import (
+            tokenize_weighted,
+        )
+
+        prompts = list(payload.all_prompts or [payload.prompt])
+        lengths = [
+            tokenize_weighted(self.tokenizer,
+                              extract_lora_tags(p)[0])[0].shape[0]
+            for p in prompts
+        ]
+        lengths.append(tokenize_weighted(
+            self.tokenizer, payload.negative_prompt)[0].shape[0])
+        return int(max(lengths))
 
     def _added_cond(self, pooled_u, pooled_c, width, height,
                     aesthetic_score: float = 6.0):
@@ -575,6 +603,11 @@ class Engine:
         payload = payload.model_copy()
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
+        if payload.all_prompts and payload.context_chunks is None:
+            # full-request entry (a sub-range over HTTP arrives with the
+            # master's value): pin the request-wide context length so
+            # group membership can't change an image's conditioning
+            payload.context_chunks = self.request_context_chunks(payload)
         self._apply_prompt_loras(payload)
         count = payload.total_images if count is None else count
         if payload.init_images:
@@ -714,6 +747,12 @@ class Engine:
         self.state.begin(job, end - start_step)
         done = 0
         pos = start_step
+        # Depth-1 pipelining: dispatch chunk i while chunk i-1 still runs
+        # on-device, so the host->device roundtrip (expensive through a
+        # chip relay) overlaps compute. Interrupt latency stays <= 2
+        # chunks: the flag is checked before every dispatch and at most
+        # one extra chunk is in flight when it flips.
+        pending = None  # (carry, chunk_length) still running on-device
         while pos < end:
             if self.state.flag.interrupted:
                 break
@@ -732,9 +771,15 @@ class Engine:
                 carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
                            ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
                            active)
-                carry.x.block_until_ready()
+                if pending is not None:
+                    pending[0].x.block_until_ready()
+                    done += pending[1]
+                    self.state.step(done)
+            pending = (carry, length)
             pos += length
-            done += length
+        if pending is not None:
+            pending[0].x.block_until_ready()
+            done += pending[1]
             self.state.step(done)
         self.state.finish()
         return carry.x

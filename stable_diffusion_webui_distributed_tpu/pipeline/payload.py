@@ -76,6 +76,14 @@ class GenerationPayload(BaseModel):
     # batch_size so a 32-combination matrix doesn't become one 32-wide
     # (64 after CFG) UNet dispatch.
     group_size: int = 0
+    # request-wide context length floor (in 77-token chunks) for
+    # per-image prompts: conditioning must be padded to the SAME number
+    # of chunks for an image regardless of which dispatch group or
+    # worker slice it lands in, or the distributed gallery stops being
+    # bitwise-identical to the single-host run. The planning master
+    # computes it over the FULL all_prompts list and it travels with
+    # every HTTP sub-range (slices can't reconstruct it).
+    context_chunks: Optional[int] = None
 
     # model / misc
     override_settings: Dict[str, Any] = Field(default_factory=dict)

@@ -120,15 +120,12 @@ class ModelRegistry:
     def available_upscalers(self) -> Dict[str, str]:
         return dict(self._upscaler_paths)
 
-    def upscaler_provider(self, name: str):
-        """hr_upscaler name -> upscale callable, or None (the engine then
-        falls back to latent bilinear with a warning). Matching ignores
-        case and punctuation so webui display names ("R-ESRGAN 4x+") find
-        their files ("RealESRGAN_x4plus.pth")."""
-        if not name:
-            return None
-        if name in self._upscaler_cache:
-            return self._upscaler_cache[name]
+    def _resolve_upscaler_path(self, name: str):
+        """hr_upscaler display name -> file path, or None. Matching
+        ignores case and punctuation so webui display names
+        ("R-ESRGAN 4x+") find their files ("RealESRGAN_x4plus.pth");
+        an exact canonical match wins over substring containment so
+        "...x4plus" never shadows "...x4plus_anime_6B"."""
 
         def canon(s: str) -> str:
             s = s.lower().replace("+", "plus")
@@ -140,13 +137,27 @@ class ModelRegistry:
             return s.replace("4x", "x4").replace("2x", "x2")
 
         path = self._upscaler_paths.get(name)
-        if path is None:
-            want = canon(name)
-            for stem, p in self._upscaler_paths.items():
-                cs = canon(stem)
-                if cs == want or want in cs or cs in want:
-                    path = p
-                    break
+        if path is not None:
+            return path
+        want = canon(name)
+        best = None  # (stem length, path) — most specific wins
+        for stem, p in self._upscaler_paths.items():
+            cs = canon(stem)
+            if cs == want:
+                return p
+            if want in cs or cs in want:
+                if best is None or len(cs) > best[0]:
+                    best = (len(cs), p)
+        return best[1] if best else None
+
+    def upscaler_provider(self, name: str):
+        """hr_upscaler name -> upscale callable, or None (the engine then
+        falls back to latent bilinear with a warning)."""
+        if not name:
+            return None
+        if name in self._upscaler_cache:
+            return self._upscaler_cache[name]
+        path = self._resolve_upscaler_path(name)
         if path is None:
             return None
         from stable_diffusion_webui_distributed_tpu.models import esrgan

@@ -8,6 +8,7 @@ sigmas, group-norm statistics, final VAE output) to f32.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax.numpy as jnp
 
@@ -46,20 +47,81 @@ def _env_flag(name: str) -> bool:
         "", "0", "false", "off", "no")
 
 
+def _default_param_dtype() -> jnp.dtype:
+    """Weight storage dtype on TPU (SDTPU_PARAM_DTYPE=bf16|f32).
+
+    bf16 storage halves HBM weight traffic per UNet call — the dominant
+    byte stream at inference batch sizes — and halves resident model
+    memory (SDXL base+refiner fit comfortably on one 16 GB v5e). Numerics
+    stay f32 where it matters: sigma/sampler math is pinned f32 by
+    ``sampler_dtype`` and flax group norms compute statistics in f32.
+
+    Default stays f32 until the bf16 cell of the tuning sweep
+    (tools/sweep.py c1-bf16) is measured good on silicon — the one
+    config with a recorded TPU number is the one the driver's bench
+    must reproduce (PERF.md).
+    """
+    import os
+
+    value = os.environ.get("SDTPU_PARAM_DTYPE", "f32").strip().lower()
+    if value in ("bf16", "bfloat16"):
+        return jnp.dtype(jnp.bfloat16)
+    if value not in ("f32", "float32", "fp32"):
+        import warnings
+
+        warnings.warn(
+            f"SDTPU_PARAM_DTYPE={value!r} is not one of ('bf16', 'f32'); "
+            "using 'f32'", stacklevel=2)
+    return jnp.dtype(jnp.float32)
+
+
 #: Default policy for real TPU runs.
-TPU = Policy(attention_impl=_default_attention(),
+TPU = Policy(param_dtype=_default_param_dtype(),
+             attention_impl=_default_attention(),
              use_remat=_env_flag("SDTPU_REMAT"))
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 
 
-def cast_floating(tree, dtype):
-    """Cast floating leaves of a pytree to ``dtype`` (params → bf16 etc.)."""
+def _needs_cast(x, dtype):
+    return (hasattr(x, "dtype")
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and x.dtype != dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_cast(dtype):
     import jax
 
-    def cast(x):
-        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
-            return x.astype(dtype)
-        return x
+    return jax.jit(lambda t: jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _needs_cast(x, dtype) else x, t))
 
-    return jax.tree_util.tree_map(cast, tree)
+
+def cast_floating(tree, dtype):
+    """Cast floating leaves of a pytree to ``dtype`` (params → bf16 etc.).
+
+    Host (numpy) trees — freshly converted checkpoints — are cast leaf by
+    leaf ON HOST: no XLA compile, and the device never holds the f32
+    source alongside the downcast copy (for SDXL that transient would be
+    ~15 GB, an OOM at load on a 16 GB v5e chip).
+
+    Device trees are cast inside a single ``jit`` call: per-leaf
+    ``astype`` would compile one tiny convert executable per unique leaf
+    shape (hundreds for a UNet), which is minutes of compile time on a
+    TPU backend; one jitted tree-cast is one compile, cached per target
+    dtype so repeated casts of same-structure trees (e.g. VAE toggles)
+    reuse the executable. Leaves already in ``dtype`` pass through
+    untouched, so a no-op cast stays free.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not any(_needs_cast(x, dtype) for x in leaves):
+        return tree
+    if not any(isinstance(x, jax.Array) for x in leaves):
+        import numpy as np
+
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x).astype(dtype)
+            if _needs_cast(x, dtype) else x, tree)
+    return _tree_cast(jnp.dtype(dtype))(tree)

@@ -346,6 +346,18 @@ class World:
         payload = payload.model_copy()
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
+        if payload.all_prompts and payload.context_chunks is None:
+            # pin the request-wide context length BEFORE slicing so an
+            # image's conditioning is independent of its worker slice /
+            # dispatch group (engine.request_context_chunks). Thin-client
+            # masters have no tokenizer; their fleets fall back to
+            # per-slice padding (documented in payload.py).
+            engine = next(
+                (w.backend.engine for w in self.workers
+                 if hasattr(w.backend, "engine")), None)
+            if engine is not None:
+                payload.context_chunks = \
+                    engine.request_context_chunks(payload)
 
         looping = [k for k in (payload.alwayson_scripts or {})
                    if k.lower() in SELF_LOOPING_SCRIPTS]

@@ -132,9 +132,24 @@ class ApiServer:
             self._styles_cache = ((path, mtime), load_styles(path))
         apply_styles(payload, self._styles_cache[1])
 
+    def _expand_scripts(self, payload: GenerationPayload) -> GenerationPayload:
+        """Script expansion up front so invalid user input (e.g. a prompt
+        matrix past the combination cap) surfaces as 422, not a 500 from
+        deep inside the engine. apply_scripts is idempotent, so the later
+        call in World.execute/Engine is a no-op."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts,
+        )
+
+        try:
+            return apply_scripts(payload)
+        except ValueError as e:
+            raise ApiError(422, str(e))
+
     def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
         payload = GenerationPayload(**body)
         self._apply_styles(payload)
+        payload = self._expand_scripts(payload)
         with self._busy:
             result = self._execute(payload)
         return self._generation_response(result)
@@ -144,6 +159,7 @@ class ApiServer:
         if not payload.init_images:
             raise ApiError(422, "img2img requires init_images")
         self._apply_styles(payload)
+        payload = self._expand_scripts(payload)
         with self._busy:
             result = self._execute(payload)
         return self._generation_response(result)
@@ -315,6 +331,11 @@ class ApiServer:
                     "state": w.state.name,
                     "avg_ipm": w.cal.avg_ipm,
                     "master": w.master,
+                    # control-surface fields: the panel renders its worker
+                    # table (and edit affordances) from this one response
+                    "pixel_cap": w.pixel_cap,
+                    "model_override": w.model_override,
+                    "disabled": w.state.name == "DISABLED",
                 })
         p = self.state.progress
         settings = None

@@ -140,8 +140,7 @@ async function tick() {
         `<td>${(v.mean * 1000).toFixed(1)} ms</td><td>${v.count}</td></tr>`
       ).join('');
     document.getElementById('logs').textContent = s.logs.join('\\n');
-    const wr = await fetch('/internal/workers');
-    workerRows = await wr.json();
+    workerRows = s.workers;  // one status fetch carries the worker table
     document.getElementById('workers').innerHTML = workerRows.map((w, i) =>
       `<tr><td>${esc(w.label)}</td>` +
       `<td class="${esc(w.state)}">${esc(w.state)}</td>` +

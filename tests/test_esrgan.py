@@ -108,6 +108,19 @@ class TestEngineHiresPath:
         assert reg.upscaler_provider("tiny x4plus") is not None
         assert reg.upscaler_provider("No Such Upscaler") is None
 
+        # exact canonical match beats substring shadowing: with both
+        # ..._x4plus and ..._x4plus_anime_6B present, the anime display
+        # name must pick the anime file (registry.py exact-first tiers)
+        save_file(make_rrdb_sd(),
+                  os.path.join(model_dir, "ESRGAN",
+                               "Tiny_x4plus_anime_6B.safetensors"))
+        reg2 = ModelRegistry(model_dir, policy=dtypes.F32,
+                             state=GenerationState())
+        want = reg2.available_upscalers()["Tiny_x4plus_anime_6B"]
+        assert reg2._resolve_upscaler_path("Tiny 4x+ Anime6B") == want
+        assert reg2._resolve_upscaler_path("tiny x4plus") == \
+            reg2.available_upscalers()["Tiny_x4plus"]
+
         engine = reg.activate("tinymodel")
         base = dict(prompt="u", steps=3, width=32, height=32, seed=6,
                     enable_hr=True, hr_scale=2.0, denoising_strength=0.7)

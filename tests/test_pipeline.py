@@ -321,6 +321,35 @@ class TestImg2Img:
         assert part.images == full.images[1:3]
         assert part.prompts == ["base b", "base c"]
 
+    def test_context_padding_independent_of_slice(self, engine):
+        # a short prompt grouped with a >1-chunk prompt gets a 2-chunk
+        # context; the same image produced alone on another worker must
+        # match bitwise, so the request-wide context length travels as
+        # payload.context_chunks (engine.request_context_chunks)
+        long_prompt = "a " + " ".join(f"word{i}" for i in range(90))
+        p = GenerationPayload(prompt="base", steps=3, width=32, height=32,
+                              seed=9, all_prompts=["short one", long_prompt],
+                              batch_size=2, group_size=2)
+        n = engine.request_context_chunks(p)
+        assert n > 1  # the long prompt really spans multiple 77-token chunks
+        full = engine.txt2img(p)
+
+        # simulate the HTTP fan-out: the remote gets only ITS slice plus
+        # the master's context_chunks (scheduler/worker.py slice logic)
+        p_slice = p.model_copy()
+        p_slice.all_prompts = ["short one"]
+        p_slice.batch_size = 1
+        p_slice.context_chunks = n
+        part = engine.generate_range(p_slice, 0, 1)
+        assert part.images[0] == full.images[0]
+
+        # without the pin the slice pads to its own (shorter) context —
+        # the bug this guards against would silently diverge
+        p_bare = p_slice.model_copy()
+        p_bare.context_chunks = None
+        bare = engine.generate_range(p_bare, 0, 1)
+        assert bare.images[0] != full.images[0]
+
     def test_hires_upscaler_variants(self, engine):
         base = dict(prompt="h", steps=3, width=32, height=32, seed=4,
                     enable_hr=True, hr_scale=2.0, denoising_strength=0.7)

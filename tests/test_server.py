@@ -63,6 +63,16 @@ class TestRoutes:
             call(server, "/sdapi/v1/img2img", {"prompt": "x"})
         assert e.value.code == 422
 
+    def test_prompt_matrix_over_cap_is_422(self, server):
+        # 11 options -> over the 2^10 combination cap: client error, not
+        # a 500 from deep inside the engine
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(server, "/sdapi/v1/txt2img",
+                 {"prompt": "base|" + "|".join(f"o{i}" for i in range(11)),
+                  "script_name": "prompt matrix", "steps": 1,
+                  "width": 64, "height": 64})
+        assert e.value.code == 422
+
     def test_progress(self, server):
         out = call(server, "/sdapi/v1/progress")
         assert {"progress", "eta_relative", "state"} <= set(out)

@@ -1,0 +1,122 @@
+"""One-process TPU tuning sweep over the bench configs and policy knobs.
+
+Claims the chip ONCE and runs every (config, knob) cell in sequence —
+separate bench.py invocations would pay ~1 min of backend init each and
+multiply the chance of wedging the pool-side chip claim (see
+PERF.md "relay lessons"). Results stream to ``PERF_SWEEP.jsonl`` (one
+JSON object per completed cell) so a mid-sweep abort still leaves data.
+
+Usage: python tools/sweep.py [cell ...]   (default: all cells)
+Cells are named, e.g. ``c1-bf16``, ``c1-chunk10``, ``c1-flash``,
+``c2-bf16``; ``--list`` prints them. A global deadline
+(SDTPU_SWEEP_DEADLINE seconds, default 3300) exits gracefully between
+cells rather than being killed mid-compile by an external timeout.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo root on path)
+
+
+def _policy(param="bf16", attention="xla", remat=False):
+    import jax.numpy as jnp
+
+    from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+
+    return dtypes.Policy(
+        param_dtype=jnp.dtype(jnp.bfloat16 if param == "bf16"
+                              else jnp.float32),
+        attention_impl=attention,
+        use_remat=remat,
+    )
+
+
+#: cell name -> (config number, policy kwargs, chunk size)
+CELLS = {
+    "c1-f32":     (1, {"param": "f32"}, 5),
+    "c1-bf16":    (1, {}, 5),
+    "c1-chunk10": (1, {}, 10),
+    "c1-chunk20": (1, {}, 20),
+    "c1-flash":   (1, {"attention": "flash"}, 5),
+    "c2-bf16":    (2, {}, 5),
+    "c2-remat":   (2, {"remat": True}, 5),
+    "c3-bf16":    (3, {}, 5),
+    "c4-bf16":    (4, {}, 5),
+    "c5-bf16":    (5, {}, 5),
+}
+
+DEFAULT_ORDER = [
+    "c1-bf16", "c1-chunk10", "c1-chunk20", "c1-flash",
+    "c3-bf16", "c5-bf16", "c4-bf16", "c2-bf16",
+]
+
+
+def run_cell(name):
+    from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+
+    cfg_n, pol_kwargs, chunk = CELLS[name]
+    dtypes.TPU = _policy(**pol_kwargs)  # bench._make_engine reads dtypes.TPU
+    os.environ["SDTPU_CHUNK"] = str(chunk)
+
+    t0 = time.time()
+    print(f"sweep: === {name} (config {cfg_n}) ===", file=sys.stderr,
+          flush=True)
+    out = bench.run_config(cfg_n, tiny=False)
+    out["cell"] = name
+    out["wall_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if "--list" in sys.argv:
+        print("\n".join(CELLS))
+        return
+    cells = args or DEFAULT_ORDER
+    unknown = [c for c in cells if c not in CELLS]
+    if unknown:
+        raise SystemExit(f"unknown cells {unknown}; --list to see all")
+
+    deadline = time.time() + float(
+        os.environ.get("SDTPU_SWEEP_DEADLINE", "3300"))
+
+    init_done = bench._start_init_watchdog()
+    import jax
+
+    jax.devices()
+    init_done.set()
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PERF_SWEEP.jsonl")
+    for name in cells:
+        if time.time() > deadline - 120:
+            print(f"sweep: deadline reached, stopping before {name}",
+                  file=sys.stderr, flush=True)
+            break
+        try:
+            row = run_cell(name)
+        except Exception as e:  # noqa: BLE001 — record and move on
+            row = {"cell": name, "error": f"{type(e).__name__}: {e}"}
+            print(f"sweep: {name} FAILED: {row['error']}", file=sys.stderr,
+                  flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(f"sweep: {json.dumps(row)}", file=sys.stderr, flush=True)
+        gc.collect()  # drop the cell's engine so HBM frees before the next
+
+
+if __name__ == "__main__":
+    main()
