@@ -178,6 +178,13 @@ def cmd_interrupt(args) -> int:
     return 0
 
 
+def cmd_user_script(args) -> int:
+    """Run the operator's sync* script (reference user_script_btn,
+    ui.py:26-55)."""
+    world, _ = _build_world(args, require_local=False)
+    return 0 if world.run_user_script() else 1
+
+
 def cmd_status(args) -> int:
     world, registry = _build_world(args, require_local=False)
     print(f"config: {world.config_path or config_mod.default_config_path()}")
@@ -310,6 +317,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.set_defaults(fn=cmd_benchmark)
 
     sub.add_parser("ping", help="health sweep").set_defaults(fn=cmd_ping)
+    sub.add_parser(
+        "user-script",
+        help="run the sync* script under <config dir>/user/",
+    ).set_defaults(fn=cmd_user_script)
     sub.add_parser("status", help="worker/model status").set_defaults(
         fn=cmd_status)
     sub.add_parser("interrupt", help="interrupt a serving node").set_defaults(

@@ -90,6 +90,8 @@ class CLIPTextModel(nn.Module):
         input_ids: jax.Array,            # (B, T) int32
         skip: Optional[int] = None,
         eos_index: Optional[jax.Array] = None,  # (B,) position of EOS token
+        inject_values: Optional[jax.Array] = None,  # (B, T, H) learned vecs
+        inject_mask: Optional[jax.Array] = None,    # (B, T, 1) 1 = replace
     ):
         c = self.cfg
         skip = c.default_skip if skip is None else skip
@@ -97,6 +99,12 @@ class CLIPTextModel(nn.Module):
 
         tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,
                        name="token_embedding")(input_ids)
+        if inject_values is not None:
+            # textual inversion: placeholder rows take their learned
+            # vectors (models/embeddings.py); vectors are call arguments,
+            # so switching embeddings never recompiles
+            m = inject_mask.astype(self.dtype)
+            tok = tok * (1.0 - m) + inject_values.astype(self.dtype) * m
         pos = self.param(
             "position_embedding",
             nn.initializers.normal(0.01),

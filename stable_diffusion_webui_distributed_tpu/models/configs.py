@@ -92,6 +92,13 @@ class ModelFamily:
         return 2 ** (len(self.vae.block_out_channels) - 1)
 
     @property
+    def inpaint(self) -> bool:
+        """Inpainting-specialized checkpoint (ldm "hybrid" conditioning):
+        the UNet eats [latent, mask, masked-image latent] — latent + 1 +
+        latent channels (sd-v1-5-inpainting and friends)."""
+        return self.unet.in_channels == 2 * self.vae.latent_channels + 1
+
+    @property
     def context_dim(self) -> int:
         return self.unet.cross_attention_dim
 
@@ -231,6 +238,24 @@ TINY_REFINER = ModelFamily(
 TINY_V = dataclasses.replace(TINY, name="tiny-v",
                              prediction_type="v_prediction")
 
+# Inpainting-specialized variants (ldm "hybrid" conditioning, 9-channel
+# conv_in: latent + mask + masked-image latent — sd-v1-5-inpainting,
+# stable-diffusion-2-inpainting, sd_xl_base inpainting ports; webui
+# detects these via the .yaml, here via conv_in shape at load).
+SD15_INPAINT = dataclasses.replace(
+    SD15, name="sd15-inpaint",
+    unet=dataclasses.replace(SD15.unet, in_channels=9))
+SD2_INPAINT = dataclasses.replace(
+    SD21_BASE, name="sd2-inpaint",
+    unet=dataclasses.replace(SD21_BASE.unet, in_channels=9))
+SDXL_INPAINT = dataclasses.replace(
+    SDXL_BASE, name="sdxl-inpaint",
+    unet=dataclasses.replace(SDXL_BASE.unet, in_channels=9))
+TINY_INPAINT = dataclasses.replace(
+    TINY, name="tiny-inpaint",
+    unet=dataclasses.replace(TINY.unet, in_channels=9))
+
 FAMILIES = {f.name: f for f in (SD15, SD21, SD21_BASE, SDXL_BASE,
-                                SDXL_REFINER, TINY, TINY_XL, TINY_REFINER,
-                                TINY_V)}
+                                SDXL_REFINER, SD15_INPAINT, SD2_INPAINT,
+                                SDXL_INPAINT, TINY, TINY_XL, TINY_REFINER,
+                                TINY_V, TINY_INPAINT)}

@@ -436,16 +436,22 @@ def load_checkpoint(path: str, family: ModelFamily) -> Dict[str, Optional[Dict]]
 
 def detect_family(sd: StateDict) -> str:
     """Guess the model family from checkpoint keys (webui does the same when
-    a user drops in an arbitrary checkpoint)."""
+    a user drops in an arbitrary checkpoint). Inpainting-specialized
+    checkpoints are detected by their 9-channel conv_in (webui reads this
+    from the .yaml; the weights say it just as clearly)."""
+    conv_in = sd.get("model.diffusion_model.input_blocks.0.0.weight")
+    inpaint = conv_in is not None and conv_in.ndim == 4 \
+        and conv_in.shape[1] == 9
     if "conditioner.embedders.1.model.text_projection" in sd or any(
         k.startswith("conditioner.embedders.1.") for k in sd
     ):
-        return "sdxl-base"
+        return "sdxl-inpaint" if inpaint else "sdxl-base"
     if any(k.startswith("conditioner.embedders.0.model.") for k in sd):
         return "sdxl-refiner"
     if any(k.startswith("cond_stage_model.model.") for k in sd):
         # SD2.x; v-pred (768-v) vs epsilon (512-base) is not inferable from
         # keys — default to the v-prediction 768 model, overridable via the
-        # <ckpt>.json family sidecar (webui reads the .yaml the same way)
-        return "sd21"
-    return "sd15"
+        # <ckpt>.json family sidecar (webui reads the .yaml the same way).
+        # 9-channel conv_in marks stable-diffusion-2-inpainting (epsilon).
+        return "sd2-inpaint" if inpaint else "sd21"
+    return "sd15-inpaint" if inpaint else "sd15"

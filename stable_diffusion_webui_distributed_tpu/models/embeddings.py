@@ -1,0 +1,200 @@
+"""Textual-inversion embeddings (webui "extra networks" style).
+
+Every sdwui worker in the reference deployment resolves embedding names
+mentioned in the prompt text against its ``embeddings/`` directory and
+splices the learned vectors into CLIP's token-embedding stream (the
+reference ships prompts verbatim over HTTP, distributed.py:239-265, and
+relies on webui to do this per node). This module owns it natively.
+
+Supported file formats (webui's loader accepts all of these):
+
+- ``.safetensors`` with ``emb_params`` (SD1/SD2 single-encoder), or
+  ``clip_l``/``clip_g`` keys (SDXL dual-encoder).
+- torch ``.pt`` with ``string_to_param`` (the classic A1111 training
+  output), loaded via torch (CPU) when available.
+- diffusers ``.bin``/``.pt`` minimal form: one tensor keyed by any name.
+
+Injection model: the tokenizer emits ``n_vectors`` placeholder tokens per
+mention; the text encoder replaces those rows of the token-embedding
+lookup with the learned vectors (models/clip.py ``inject_*`` args) — the
+vectors are jit *arguments*, so switching embeddings never recompiles.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
+
+#: embedding-file suffixes scanned by discover()
+_SUFFIXES = (".safetensors", ".pt", ".bin")
+
+
+class Embedding:
+    """One loaded embedding: per-encoder vector stacks."""
+
+    def __init__(self, name: str, clip_l: np.ndarray,
+                 clip_g: Optional[np.ndarray] = None):
+        # (n_vectors, hidden) float32
+        self.name = name
+        self.clip_l = np.asarray(clip_l, np.float32)
+        self.clip_g = None if clip_g is None else np.asarray(clip_g,
+                                                             np.float32)
+        if self.clip_l.ndim == 1:
+            self.clip_l = self.clip_l[None]
+        if self.clip_g is not None and self.clip_g.ndim == 1:
+            self.clip_g = self.clip_g[None]
+        if self.clip_g is not None and \
+                len(self.clip_g) != len(self.clip_l):
+            raise ValueError(
+                f"embedding '{name}': clip_l has {len(self.clip_l)} "
+                f"vectors but clip_g has {len(self.clip_g)}")
+
+    @property
+    def n_vectors(self) -> int:
+        return self.clip_l.shape[0]
+
+
+def _from_state_dict(name: str, sd: Dict[str, np.ndarray]) -> Embedding:
+    lowered = {k.lower(): v for k, v in sd.items()}
+    if "clip_l" in lowered or "clip_g" in lowered:
+        return Embedding(name, lowered["clip_l"], lowered.get("clip_g"))
+    if "emb_params" in lowered:
+        return Embedding(name, lowered["emb_params"])
+    if "string_to_param" in sd:  # nested .pt layout
+        inner = sd["string_to_param"]
+        key = "*" if "*" in inner else next(iter(inner))
+        return Embedding(name, np.asarray(inner[key], np.float32))
+    if len(sd) == 1:  # diffusers minimal: {token: tensor}
+        return Embedding(name, next(iter(sd.values())))
+    raise ValueError(
+        f"embedding '{name}': unrecognized keys {sorted(sd)[:4]}")
+
+
+def load_embedding(path: str) -> Embedding:
+    """Load one embedding file (see module docstring for formats)."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return _from_state_dict(name, load_file(path))
+    # torch .pt / .bin
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    if "string_to_param" in sd:
+        inner = {k: v.detach().float().numpy()
+                 for k, v in sd["string_to_param"].items()}
+        return _from_state_dict(name, {"string_to_param": inner})
+    return _from_state_dict(
+        name,
+        {k: (v.detach().float().numpy() if hasattr(v, "detach") else
+             np.asarray(v, np.float32))
+         for k, v in sd.items()
+         if hasattr(v, "shape")})
+
+
+class EmbeddingStore:
+    """Directory-backed registry: prompt-name -> lazily loaded Embedding.
+
+    Matching is case-insensitive on the file stem, like webui's embedding
+    database. Files that fail to load are logged and skipped (a bad file
+    must not take down the node)."""
+
+    def __init__(self, directory: Optional[str]):
+        self._paths: Dict[str, str] = {}   # lowercase name -> path
+        self._cache: Dict[str, Optional[Embedding]] = {}
+        self.rescan(directory)
+
+    def rescan(self, directory: Optional[str]) -> None:
+        """Re-discover the directory in place. Engines hold a reference to
+        this store, so a registry refresh must mutate it rather than build
+        a new one (or generation would keep seeing the old file set)."""
+        self.directory = directory
+        self._paths = {}
+        self._cache = {}
+        if directory and os.path.isdir(directory):
+            for fn in sorted(os.listdir(directory)):
+                if fn.endswith(_SUFFIXES):
+                    stem = os.path.splitext(fn)[0]
+                    self._paths[stem.lower()] = os.path.join(directory, fn)
+
+    def names(self) -> List[str]:
+        return sorted(self._paths)
+
+    def lookup(self, name: str) -> Optional[Embedding]:
+        key = name.lower()
+        if key not in self._paths:
+            return None
+        if key not in self._cache:
+            try:
+                self._cache[key] = load_embedding(self._paths[key])
+            except Exception as e:  # noqa: BLE001 — skip bad files
+                get_logger().error("embedding '%s' failed to load: %s",
+                                   name, e)
+                self._cache[key] = None
+        return self._cache[key]
+
+    def vector_counts(self) -> Dict[str, int]:
+        """name -> n_vectors for every loadable embedding (loads lazily);
+        the tokenizer uses this to emit placeholder runs."""
+        out = {}
+        for name in self._paths:
+            emb = self.lookup(name)
+            if emb is not None:
+                out[name] = emb.n_vectors
+        return out
+
+
+#: (chunk_row, column, embedding_name, vector_index) — where tokenizer
+#: placeholders landed; the engine turns these into injection arrays.
+Injection = Tuple[int, int, str, int]
+
+
+def build_injection_arrays(
+    injections: List[Injection],
+    n_chunks: int,
+    width: int,
+    store,
+    hidden_l: int,
+    hidden_g: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Injection list -> (mask (n,w,1), values_l (n,w,Hl), values_g (n,w,Hg)).
+
+    Rows whose vectors don't match the encoder width are skipped with a
+    log line (an SD1.5 embedding mentioned under SDXL, say) — degraded
+    capability beats a crashed request, like the reference's sampler-404
+    fallback (worker.py:457-467).
+    """
+    mask = np.zeros((n_chunks, width, 1), np.float32)
+    val_l = np.zeros((n_chunks, width, hidden_l), np.float32)
+    val_g = np.zeros((n_chunks, width, max(hidden_g, 1)), np.float32)
+    for row, col, name, vec in injections:
+        if row >= n_chunks:
+            continue  # truncated by the max_chunks cap
+        emb = store.lookup(name) if store is not None else None
+        if emb is None:
+            continue
+        if emb.clip_l.shape[1] != hidden_l:
+            get_logger().warning(
+                "embedding '%s' width %d != encoder width %d; skipped",
+                name, emb.clip_l.shape[1], hidden_l)
+            continue
+        if hidden_g and emb.clip_g is None:
+            get_logger().warning(
+                "embedding '%s' has no clip_g vectors for this SDXL "
+                "encoder; skipped", name)
+            continue
+        if hidden_g and emb.clip_g.shape[1] != hidden_g:
+            get_logger().warning(
+                "embedding '%s' clip_g width %d != encoder width %d; "
+                "skipped", name, emb.clip_g.shape[1], hidden_g)
+            continue
+        mask[row, col, 0] = 1.0
+        val_l[row, col] = emb.clip_l[vec]
+        if hidden_g and emb.clip_g is not None:
+            val_g[row, col] = emb.clip_g[vec]
+    return mask, val_l, val_g

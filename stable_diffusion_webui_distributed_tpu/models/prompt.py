@@ -19,7 +19,7 @@ This module owns it natively:
 from __future__ import annotations
 
 import re
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -100,39 +100,90 @@ def tokenize_weighted(
     windows as needed (capped at ``max_chunks``), each wrapped in BOS/EOS;
     BOS/EOS/padding carry weight 1.0. ``BREAK`` starts a new chunk.
     """
+    ids, weights, _ = tokenize_with_embeddings(tokenizer, text, None,
+                                               max_chunks)
+    return ids, weights
+
+
+def tokenize_with_embeddings(
+    tokenizer,
+    text: str,
+    embeddings: Optional[Dict[str, int]],
+    max_chunks: int = 8,
+) -> Tuple[np.ndarray, np.ndarray, List[Tuple[int, int, str, int]]]:
+    """``tokenize_weighted`` plus textual-inversion placeholders.
+
+    ``embeddings`` maps lowercase embedding names to their vector counts
+    (models/embeddings.py ``EmbeddingStore.vector_counts``). A mention of
+    an embedding name (word-boundary, case-insensitive — webui's matching
+    rule) emits that many placeholder tokens (id 0; the real vectors are
+    injected at the token-embedding layer, models/clip.py) and returns
+    their positions as ``(chunk_row, column, name, vector_index)``.
+    """
     segments = parse_prompt_attention(text)
+    emb_re = None
+    if embeddings:
+        # longest name first so "style-v2" isn't eaten by "style"
+        names = sorted(embeddings, key=len, reverse=True)
+        emb_re = re.compile(
+            r"(?<![\w-])(" + "|".join(re.escape(n) for n in names)
+            + r")(?![\w-])", re.IGNORECASE)
+
     flat_ids: List[int] = []
     flat_w: List[float] = []
-    chunks: List[Tuple[List[int], List[float]]] = []
+    flat_inj: List[Optional[Tuple[str, int]]] = []
+    chunks: List[Tuple[List[int], List[float], List]] = []
 
     def flush():
-        nonlocal flat_ids, flat_w
-        chunks.append((flat_ids, flat_w))
-        flat_ids, flat_w = [], []
+        nonlocal flat_ids, flat_w, flat_inj
+        chunks.append((flat_ids, flat_w, flat_inj))
+        flat_ids, flat_w, flat_inj = [], [], []
+
+    def emit(tid: int, w: float, inj=None):
+        if len(flat_ids) >= CHUNK_CONTENT:
+            flush()
+        flat_ids.append(tid)
+        flat_w.append(w)
+        flat_inj.append(inj)
 
     for seg, w in segments:
         if seg == "BREAK" and w == -1.0:
             flush()
             continue
-        for tid in tokenizer.encode(seg):
-            if len(flat_ids) >= CHUNK_CONTENT:
-                flush()
-            flat_ids.append(tid)
-            flat_w.append(w)
+        parts = emb_re.split(seg) if emb_re else [seg]
+        for i, part in enumerate(parts):
+            if emb_re and i % 2 == 1:  # a matched embedding name
+                name = part.lower()
+                n_vec = embeddings[name]
+                # keep the vector run atomic within one chunk (webui's
+                # chunking opens a new window when an embedding doesn't
+                # fit); runs longer than a whole chunk split unavoidably
+                if flat_ids and n_vec <= CHUNK_CONTENT \
+                        and len(flat_ids) + n_vec > CHUNK_CONTENT:
+                    flush()
+                for vec in range(n_vec):
+                    emit(0, w, (name, vec))
+            elif part:
+                for tid in tokenizer.encode(part):
+                    emit(tid, w)
     flush()
-    chunks = chunks[:max_chunks] or [([], [])]
+    chunks = chunks[:max_chunks] or [([], [], [])]
 
     n = len(chunks)
     bos = getattr(tokenizer, "bos", 49406)
     eos = getattr(tokenizer, "eos", 49407)
     ids = np.full((n, CHUNK_CONTENT + 2), eos, np.int32)
     weights = np.ones((n, CHUNK_CONTENT + 2), np.float32)
-    for row, (cid, cw) in enumerate(chunks):
+    injections: List[Tuple[int, int, str, int]] = []
+    for row, (cid, cw, cinj) in enumerate(chunks):
         ids[row, 0] = bos
         ids[row, 1:1 + len(cid)] = cid
         ids[row, 1 + len(cid)] = eos
         weights[row, 1:1 + len(cw)] = cw
-    return ids, weights
+        for col, inj in enumerate(cinj):
+            if inj is not None:
+                injections.append((row, col + 1, inj[0], inj[1]))
+    return ids, weights, injections
 
 
 def pad_chunks(a: np.ndarray, wa: np.ndarray, n: int, eos: int,

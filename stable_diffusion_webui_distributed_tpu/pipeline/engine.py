@@ -74,6 +74,7 @@ class Engine:
         controlnet_provider: Optional[Callable[[str], Optional[Dict]]] = None,
         engine_provider: Optional[Callable[[str], Optional["Engine"]]] = None,
         upscaler_provider: Optional[Callable[[str], Optional[Callable]]] = None,
+        embedding_store=None,
     ):
         self.family = family
         self.policy = policy
@@ -123,6 +124,9 @@ class Engine:
         # ESRGAN-family image-space hires upscalers (models/esrgan.py);
         # None -> latent-space upscaling only
         self.upscaler_provider = upscaler_provider
+        # textual-inversion embeddings (models/embeddings.py); None ->
+        # prompt names are ordinary tokens
+        self.embedding_store = embedding_store
 
         cd = policy.compute_dtype
         self.text_encoder = CLIPTextModel(family.text_encoder, dtype=cd)
@@ -146,6 +150,9 @@ class Engine:
 
         self._cache: Dict[Tuple, Callable] = {}
         self._cache_lock = threading.Lock()
+        # blank hybrid-conditioning latents per (batch, size); VAE-derived,
+        # so set_vae clears it
+        self._blank_cond_cache: Dict[Tuple, Any] = {}
 
     # -- compiled stage factories ------------------------------------------
 
@@ -179,15 +186,18 @@ class Engine:
         embeddings with chunk-mean restoration (webui semantics)."""
 
         def build():
-            def encode(te_params, te2_params, ids, weights, skip):
+            def encode(te_params, te2_params, ids, weights, skip,
+                       inj_mask, inj_l, inj_g):
                 # skip=0 -> model default (None); webui clip_skip N maps to N-1.
                 skip_arg = skip if skip else None
                 ctx, pooled = self.text_encoder.apply(
                     {"params": te_params}, ids, skip=skip_arg,
+                    inject_values=inj_l, inject_mask=inj_mask,
                 )
                 if self.text_encoder_2 is not None:
                     ctx2, pooled2 = self.text_encoder_2.apply(
                         {"params": te2_params}, ids, skip=skip_arg,
+                        inject_values=inj_g, inject_mask=inj_mask,
                     )
                     ctx = jnp.concatenate(
                         [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)],
@@ -212,7 +222,8 @@ class Engine:
         return self._cached(("encode",), build)
 
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
-                         added_u, added_c, controls=(), total_steps=1):
+                         added_u, added_c, controls=(), total_steps=1,
+                         inpaint_cond=None):
         """Closure: x0-prediction denoiser with classifier-free guidance and
         optional ControlNet residual injection.
 
@@ -255,7 +266,15 @@ class Engine:
                 residuals = rs if residuals is None else tuple(
                     a + b for a, b in zip(residuals, rs))
 
-            out = self.unet.apply(unet_params, both, tb, ctx, added,
+            unet_in = both
+            if inpaint_cond is not None:
+                # inpainting-specialized model (ldm hybrid conditioning):
+                # [latent, mask, masked-image latent] per CFG branch.
+                # ControlNet above still sees the bare 4-channel input.
+                cond2 = jnp.concatenate(
+                    [inpaint_cond, inpaint_cond], axis=0).astype(both.dtype)
+                unet_in = jnp.concatenate([both, cond2], axis=-1)
+            out = self.unet.apply(unet_params, unet_in, tb, ctx, added,
                                   control_residuals=residuals)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
             guided = out_u + cfg_scale * (out_c - out_u)
@@ -269,22 +288,24 @@ class Engine:
 
     def _chunk_fn(self, sampler_name: str, steps: int, width: int,
                   height: int, batch: int, length: int,
-                  masked: bool, n_controls: int = 0) -> Callable:
+                  masked: bool, n_controls: int = 0,
+                  inpaint: bool = False) -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
         index. Cache key excludes prompt/seed/cfg — those are data."""
         spec = kd.resolve_sampler(sampler_name)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
-               masked, n_controls, self.family.name)
+               masked, n_controls, inpaint, self.family.name)
 
         def build():
             sigmas = kd.build_sigmas(spec, self.schedule, steps)
 
             def run_chunk(unet_params, carry, start, ctx_u, ctx_c, cfg,
                           image_keys, added_u, added_c, mask_lat, init_lat,
-                          controls):
+                          controls, inpaint_cond):
                 denoise = self._make_denoise_fn(
                     unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
-                    controls=controls, total_steps=steps)
+                    controls=controls, total_steps=steps,
+                    inpaint_cond=inpaint_cond if inpaint else None)
                 base_step = kd.make_sampler_step(
                     spec, denoise, sigmas, image_keys)
 
@@ -409,6 +430,7 @@ class Engine:
             target = shard_params(target, self.mesh)
         self._base_params = {**self._base_params, "vae": target}
         self.params = {**self.params, "vae": target}
+        self._blank_cond_cache.clear()  # conditioning latents are VAE-derived
 
     # -- ControlNet ---------------------------------------------------------
 
@@ -489,20 +511,27 @@ class Engine:
         the denoiser. With ``prompts`` (per-image variation: prompt matrix
         etc.) each image gets its own row — ctx (B, L, D) — distinct
         prompts encoded once, all chunk-padded to one context length.
+        Textual-inversion mentions resolve against the embedding store
+        (models/embeddings.py) and ride as injection arrays.
         """
+        from stable_diffusion_webui_distributed_tpu.models.embeddings import (
+            build_injection_arrays,
+        )
         from stable_diffusion_webui_distributed_tpu.models.lora import (
             extract_lora_tags,
         )
         from stable_diffusion_webui_distributed_tpu.models.prompt import (
             pad_chunks,
-            tokenize_weighted,
+            tokenize_with_embeddings,
         )
 
         tok = self.tokenizer
+        counts = self._embedding_counts()
         prompt_list = [payload.prompt] if prompts is None else list(prompts)
         cleaned = [extract_lora_tags(p)[0] for p in prompt_list]
-        toks = [tokenize_weighted(tok, c) for c in cleaned]
-        ids_u, w_u = tokenize_weighted(tok, payload.negative_prompt)
+        toks = [tokenize_with_embeddings(tok, c, counts) for c in cleaned]
+        ids_u, w_u, inj_u = tokenize_with_embeddings(
+            tok, payload.negative_prompt, counts)
         # cond and uncond must agree on context length (webui pads both);
         # payload.context_chunks floors it at the REQUEST-wide max so an
         # image's conditioning doesn't depend on its dispatch group /
@@ -513,6 +542,17 @@ class Engine:
         bos, eos = tok.bos, tok.eos
         ids_u, w_u = pad_chunks(ids_u, w_u, n, eos, bos)
 
+        h_l = self.family.text_encoder.hidden_size
+        h_g = (self.family.text_encoder_2.hidden_size
+               if self.family.text_encoder_2 else 0)
+        width = ids_u.shape[1]
+
+        def inj_arrays(injections):
+            mask, val_l, val_g = build_injection_arrays(
+                injections, n, width, self.embedding_store, h_l, h_g)
+            return (jnp.asarray(mask), jnp.asarray(val_l),
+                    jnp.asarray(val_g))
+
         skip = int(payload.clip_skip or 0)
         enc = self._encode_fn()
         te = self.params["text_encoder"]
@@ -520,19 +560,29 @@ class Engine:
         with trace.STATS.timer("text_encode"):
             cache: Dict[str, Tuple] = {}
             ctxs, pooleds = [], []
-            for (ids_c, w_c), raw in zip(toks, cleaned):
+            for (ids_c, w_c, inj_c), raw in zip(toks, cleaned):
                 if raw not in cache:
                     pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
                     cache[raw] = enc(te, te2, jnp.asarray(pi),
-                                     jnp.asarray(wi), skip)
+                                     jnp.asarray(wi), skip,
+                                     *inj_arrays(inj_c))
                 ctxs.append(cache[raw][0])
                 pooleds.append(cache[raw][1])
             ctx_c = ctxs[0] if len(ctxs) == 1 else jnp.concatenate(ctxs, 0)
             pooled_c = pooleds[0] if len(pooleds) == 1 \
                 else jnp.concatenate(pooleds, 0)
             ctx_u, pooled_u = enc(te, te2, jnp.asarray(ids_u),
-                                  jnp.asarray(w_u), skip)
+                                  jnp.asarray(w_u), skip,
+                                  *inj_arrays(inj_u))
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
+
+    def _embedding_counts(self):
+        """name -> n_vectors map for the tokenizer, or None when no
+        embedding store is attached / the directory is empty."""
+        if self.embedding_store is None:
+            return None
+        counts = self.embedding_store.vector_counts()
+        return counts or None
 
     def request_context_chunks(self, payload: GenerationPayload) -> int:
         """Max context length in 77-token chunks over the request's full
@@ -544,17 +594,19 @@ class Engine:
             extract_lora_tags,
         )
         from stable_diffusion_webui_distributed_tpu.models.prompt import (
-            tokenize_weighted,
+            tokenize_with_embeddings,
         )
 
+        counts = self._embedding_counts()
         prompts = list(payload.all_prompts or [payload.prompt])
         lengths = [
-            tokenize_weighted(self.tokenizer,
-                              extract_lora_tags(p)[0])[0].shape[0]
+            tokenize_with_embeddings(
+                self.tokenizer, extract_lora_tags(p)[0],
+                counts)[0].shape[0]
             for p in prompts
         ]
-        lengths.append(tokenize_weighted(
-            self.tokenizer, payload.negative_prompt)[0].shape[0])
+        lengths.append(tokenize_with_embeddings(
+            self.tokenizer, payload.negative_prompt, counts)[0].shape[0])
         return int(max(lengths))
 
     def _added_cond(self, pooled_u, pooled_c, width, height,
@@ -729,7 +781,8 @@ class Engine:
 
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
-                       mask_lat, init_lat, controls=(), end_step=None):
+                       mask_lat, init_lat, controls=(), end_step=None,
+                       inpaint_cond=None):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
@@ -742,6 +795,8 @@ class Engine:
         masked = mask_lat is not None
         mask_arg = mask_lat if masked else jnp.float32(0)
         init_arg = init_lat if masked else jnp.float32(0)
+        inpainting = self.family.inpaint and inpaint_cond is not None
+        inp_arg = inpaint_cond if inpainting else jnp.float32(0)
         carry = kd.init_carry(x)
         end = steps if end_step is None else min(end_step, steps)
         self.state.begin(job, end - start_step)
@@ -765,12 +820,12 @@ class Engine:
                            if c[3] <= hi and c[4] >= lo)
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
                                 batch, length, masked=masked,
-                                n_controls=len(active))
+                                n_controls=len(active), inpaint=inpainting)
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate(f"denoise[{pos}:{pos + length}]"):
                 carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
                            ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
-                           active)
+                           active, inp_arg)
                 if pending is not None:
                     pending[0].x.block_until_ready()
                     done += pending[1]
@@ -788,12 +843,50 @@ class Engine:
         sigmas = kd.build_sigmas(spec, self.schedule, steps)
         return sigmas
 
+    # -- inpainting-model (hybrid) conditioning -----------------------------
+
+    def _blank_inpaint_cond(self, batch, width, height):
+        """txt2img / maskless-img2img conditioning for an inpainting
+        checkpoint: repaint-everything mask + VAE-encoded blank (mid-gray)
+        image — webui's txt2img_image_conditioning for hybrid models.
+        Depends only on (batch, size) and the VAE, so it's cached per
+        bucket; ``set_vae`` invalidates (engine.py)."""
+        key = (batch, width, height)
+        cached = self._blank_cond_cache.get(key)
+        if cached is not None:
+            return cached
+        h, w = self._latent_hw(width, height)
+        gray = jnp.full((batch, height, width, 3), 0.5, jnp.float32)
+        lat = self._encode_image_fn(width, height, batch)(
+            self.params["vae"], gray)
+        mask = jnp.ones((batch, h, w, 1), jnp.float32)
+        cond = jnp.concatenate([mask, lat], axis=-1)
+        self._blank_cond_cache[key] = cond
+        return cond
+
+    def _masked_inpaint_cond(self, batch, width, height, init, mask_pixels):
+        """Real-mask conditioning: rounded mask + VAE encode of the masked
+        init image (masked region mid-gray, webui's
+        img2img_image_conditioning for hybrid models)."""
+        h, w = self._latent_hw(width, height)
+        m = np.round(np.clip(mask_pixels, 0.0, 1.0))
+        masked = init * (1.0 - m) + 0.5 * m
+        lat = self._encode_image_fn(width, height, batch)(
+            self.params["vae"],
+            jnp.asarray(masked)[None].repeat(batch, axis=0))
+        mask_lat = jnp.round(jnp.asarray(np.asarray(
+            jax.image.resize(m, (h, w, 1), "bilinear")),
+            jnp.float32))[None].repeat(batch, axis=0)
+        return jnp.concatenate([mask_lat, lat], axis=-1)
+
     def _run_txt2img(self, payload, start, count, job,
                      width=None, height=None) -> GenerationResult:
         width = width or payload.width
         height = height or payload.height
         h, w = self._latent_hw(width, height)
-        C = self.family.unet.in_channels
+        # sampled latent channels — NOT unet.in_channels, which counts the
+        # mask/masked-image conditioning of inpainting checkpoints too
+        C = self.family.vae.latent_channels
         spec = kd.resolve_sampler(payload.sampler_name)
         sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
 
@@ -835,9 +928,12 @@ class Engine:
             if payload.all_prompts:
                 conds, pooleds, ref_cond = self._group_conds(
                     payload, pos, gen_n, refiner)
+            inp = (self._blank_inpaint_cond(gen_n, width, height)
+                   if self.family.inpaint else None)
             latents = self._split_denoise(
                 payload, x, keys, conds, pooleds, width, height, job,
-                controls, refiner, ref_cond, payload.steps, 0)
+                controls, refiner, ref_cond, payload.steps, 0,
+                inpaint_cond=inp)
             out_w, out_h = width, height
             if payload.enable_hr and not self.state.flag.interrupted:
                 latents, out_w, out_h = self._hires_pass(
@@ -862,7 +958,8 @@ class Engine:
         return self.engine_provider(payload.refiner_checkpoint)
 
     def _split_denoise(self, payload, x, keys, conds, pooleds, width, height,
-                       job, controls, refiner, ref_cond, steps, start_step):
+                       job, controls, refiner, ref_cond, steps, start_step,
+                       inpaint_cond=None):
         """Denoise [start_step, steps) with an optional refiner handoff: the
         base model runs up to the switch point, then the refiner — its own
         text conditioning and aesthetic micro-conditioning — finishes on the
@@ -874,7 +971,8 @@ class Engine:
         if refiner is None or ref_cond is None:
             return self._denoise_range(payload, x, keys, conds, pooleds,
                                        width, height, start_step, steps, job,
-                                       None, None, controls)
+                                       None, None, controls,
+                                       inpaint_cond=inpaint_cond)
         switch = int(steps * payload.refiner_switch_at)
         switch = max(start_step, min(steps - 1, switch))
         latents = x
@@ -882,7 +980,7 @@ class Engine:
             latents = self._denoise_range(
                 payload, latents, keys, conds, pooleds, width, height,
                 start_step, steps, job, None, None, controls,
-                end_step=switch)
+                end_step=switch, inpaint_cond=inpaint_cond)
         if self.state.flag.interrupted:
             return latents
         ref_conds, ref_pooleds = ref_cond
@@ -942,9 +1040,11 @@ class Engine:
         # re-prepared at the target resolution; the refiner switch applies
         # within the hires pass as well
         controls2 = self._prepare_controls(payload, tw, th)
+        inp2 = (self._blank_inpaint_cond(n, tw, th)
+                if self.family.inpaint else None)
         latents2 = self._split_denoise(
             hires, x, image_keys, conds, pooleds, tw, th, job + "+hr",
-            controls2, refiner, ref_cond, steps2, start2)
+            controls2, refiner, ref_cond, steps2, start2, inpaint_cond=inp2)
         return latents2, tw, th
 
     def _run_img2img(self, payload, start, count, job) -> GenerationResult:
@@ -969,9 +1069,11 @@ class Engine:
             ref_cond = refiner.encode_prompts(payload) if refiner else None
 
         mask_lat = None
+        mask_pixels = None
         if payload.mask is not None:
             m = b64png_to_array(payload.mask).astype(np.float32) / 255.0
             m = _resize_image(m, width, height)[..., :1]
+            mask_pixels = m  # pre-blur: hybrid conditioning wants it sharp
             if payload.mask_blur > 0:
                 # soften the seam (webui gaussian-blurs the pixel mask by
                 # mask_blur); the soft values survive into the latent mask
@@ -997,6 +1099,12 @@ class Engine:
             if payload.all_prompts:
                 conds, pooleds, ref_cond = self._group_conds(
                     payload, pos, n, refiner)
+            inp = None
+            if self.family.inpaint:
+                inp = (self._masked_inpaint_cond(n, width, height, init,
+                                                 mask_pixels)
+                       if mask_pixels is not None
+                       else self._blank_inpaint_cond(n, width, height))
             noise = rng.batch_noise(
                 payload.seed, payload.subseed, payload.subseed_strength,
                 pos, n, init_lat.shape[1:],
@@ -1010,12 +1118,13 @@ class Engine:
                 # tied to the base chunk loop
                 latents = self._split_denoise(
                     payload, x, keys, conds, pooleds, width, height, job,
-                    controls, refiner, ref_cond, payload.steps, start_step)
+                    controls, refiner, ref_cond, payload.steps, start_step,
+                    inpaint_cond=inp)
             else:
                 latents = self._denoise_range(
                     payload, x, keys, conds, pooleds, width, height,
                     start_step, payload.steps, job, mask_lat, init_lat,
-                    controls)
+                    controls, inpaint_cond=inp)
             pending.append(self._queue_decoded(latents, pos, n, width,
                                                height))
             if len(pending) > 1:  # depth-1 decode pipeline (see txt2img)

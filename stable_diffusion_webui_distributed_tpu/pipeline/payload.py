@@ -235,11 +235,15 @@ def apply_scripts(payload: "GenerationPayload") -> "GenerationPayload":
     ``all_prompts`` (one image per combination, fixed seed), replacing
     batch_size/n_iter — the webui script this reproduces runs server-side
     on every node of the reference's fleet.
+
+    ``prompts from file or textbox``: one image per non-empty line of the
+    script's text argument (webui's built-in; lines starting with ``#``
+    are comments), normal per-image seed progression.
     """
     if payload.all_prompts:
         return payload  # already expanded
-    if payload.script_name.strip().lower() == "prompt matrix" \
-            and "|" in payload.prompt:
+    script = payload.script_name.strip().lower()
+    if script == "prompt matrix" and "|" in payload.prompt:
         payload = payload.model_copy()
         payload.all_prompts = expand_prompt_matrix(payload.prompt)
         # the user's batch_size becomes the per-dispatch group cap; the
@@ -248,6 +252,24 @@ def apply_scripts(payload: "GenerationPayload") -> "GenerationPayload":
         payload.batch_size = len(payload.all_prompts)
         payload.n_iter = 1
         payload.same_seed = True
+    elif script == "prompts from file or textbox":
+        # webui run() signature: (checkbox_iterate, checkbox_iterate_batches,
+        # prompt_txt) — the text rides last in script_args. With
+        # checkbox_iterate OFF (the default) every line runs at the SAME
+        # seed; ON advances the seed per line (webui semantics).
+        args = payload.script_args or []
+        text = next((a for a in reversed(args)
+                     if isinstance(a, str) and a.strip()), "")
+        iterate = bool(next((a for a in args if isinstance(a, bool)), False))
+        lines = [ln.strip() for ln in text.splitlines()]
+        lines = [ln for ln in lines if ln and not ln.startswith("#")]
+        if lines:
+            payload = payload.model_copy()
+            payload.all_prompts = lines
+            payload.group_size = max(1, payload.batch_size)
+            payload.batch_size = len(lines)
+            payload.n_iter = 1
+            payload.same_seed = not iterate
     return payload
 
 

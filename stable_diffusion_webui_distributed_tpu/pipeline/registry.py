@@ -102,6 +102,26 @@ class ModelRegistry:
                         self._upscaler_paths[os.path.splitext(name)[0]] = \
                             os.path.join(up_dir, name)
         self._upscaler_cache.clear()
+        # textual-inversion embeddings (webui keeps these NEXT TO the
+        # model dir, <webui>/embeddings; accept an in-dir folder too)
+        from stable_diffusion_webui_distributed_tpu.models.embeddings import (
+            EmbeddingStore,
+        )
+
+        emb_dir = None
+        for cand in (os.path.join(self.model_dir, "embeddings"),
+                     os.path.join(os.path.dirname(self.model_dir.rstrip(
+                         os.sep)) or ".", "embeddings")):
+            if os.path.isdir(cand):
+                emb_dir = cand
+                break
+        # one store for the registry's lifetime, rescanned in place:
+        # live engines hold a reference, so replacing it would leave
+        # generation blind to new files until a checkpoint switch
+        if getattr(self, "embedding_store", None) is None:
+            self.embedding_store = EmbeddingStore(emb_dir)
+        else:
+            self.embedding_store.rescan(emb_dir)
         # adapters may have been replaced on disk — drop converted caches
         self._controlnet_cache.clear()
         self._lora_cache.clear()
@@ -405,6 +425,7 @@ class ModelRegistry:
             controlnet_provider=self.controlnet_provider,
             engine_provider=self.secondary_engine,
             upscaler_provider=self.upscaler_provider,
+            embedding_store=self.embedding_store,
         )
 
     def activate(self, name: str):

@@ -622,6 +622,41 @@ class World:
         self.save_config()
         return out
 
+    def run_user_script(self) -> bool:
+        """Execute the operator's ``sync*`` script, if present — the
+        reference's user-script button (ui.py:26-55): a file named
+        ``sync*`` under ``<config dir>/user/`` (typically an
+        rsync-models-to-workers hook), launched via its shebang line
+        (``sh`` when it has none). Returns False with a logged hint when
+        no script exists."""
+        import os
+        import subprocess
+
+        log = get_logger()
+        base = os.path.dirname(os.path.abspath(
+            self.config_path or config_mod.default_config_path()))
+        user_dir = os.path.join(base, "user")
+        script = None
+        if os.path.isdir(user_dir):
+            for name in sorted(os.listdir(user_dir)):
+                path = os.path.join(user_dir, name)
+                if name.startswith("sync") and os.path.isfile(path):
+                    script = path
+        if script is None:
+            log.error(
+                "couldn't find user script: place a file named sync* "
+                "under %s", user_dir)
+            return False
+        with open(script, "r", encoding="utf-8", errors="replace") as f:
+            first = f.readline().strip()
+        cmd = (first[2:].split() + [script] if first.startswith("#!")
+               else ["sh", script])
+        log.info("running user script %s", script)
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            log.error("user script exited %d", rc)
+        return rc == 0
+
     def sync_models(self, model: str, vae: str = "") -> None:
         """Checkpoint-change fan-out (world.py:784-811): push the new model
         to every non-master backend without an override, in threads."""

@@ -253,11 +253,33 @@ class ApiServer:
     def handle_samplers(self) -> Any:
         return [{"name": n, "aliases": [], "options": {}} for n in SAMPLERS]
 
+    def handle_embeddings(self) -> Dict[str, Any]:
+        """webui's GET /sdapi/v1/embeddings shape: loaded textual-inversion
+        embeddings with their vector counts (models/embeddings.py)."""
+        loaded: Dict[str, Any] = {}
+        store = getattr(self.registry, "embedding_store", None)
+        if store is not None:
+            for name, n in store.vector_counts().items():
+                e = store.lookup(name)
+                loaded[name] = {
+                    "step": None, "sd_checkpoint": None,
+                    "sd_checkpoint_name": None,
+                    "shape": int(e.clip_l.shape[1]), "vectors": int(n),
+                }
+        return {"loaded": loaded, "skipped": {}}
+
     def handle_script_info(self) -> Any:
         # advertised to masters that filter per-worker script args
         # (world.py:744-763): this node applies ControlNet units in-graph
-        return [{"name": "controlnet", "is_alwayson": True, "is_img2img": True,
-                 "args": []}]
+        # and expands the selectable scripts natively (payload.apply_scripts)
+        return [
+            {"name": "controlnet", "is_alwayson": True, "is_img2img": True,
+             "args": []},
+            {"name": "prompt matrix", "is_alwayson": False,
+             "is_img2img": False, "args": []},
+            {"name": "prompts from file or textbox", "is_alwayson": False,
+             "is_img2img": False, "args": []},
+        ]
 
     def handle_refresh(self) -> Dict[str, Any]:
         if self.registry is not None:
@@ -400,6 +422,14 @@ class ApiServer:
                     self.source.save_config()
         return {"cleared": cleared}
 
+    def handle_user_script(self) -> Dict[str, Any]:
+        """Run the operator's ``sync*`` script (reference user_script_btn,
+        ui.py:26-55) — e.g. an rsync-models-to-workers hook placed under
+        ``<config dir>/user/``."""
+        if not hasattr(self.source, "run_user_script"):
+            raise ApiError(400, "no fleet attached to this node")
+        return {"ran": self.source.run_user_script()}
+
     def handle_restart_all(self) -> Dict[str, Any]:
         """Fleet restart fan-out (the reference's 'Restart All Workers'
         button, ui.py:274-280 + javascript/distributed.js:2-4 — its confirm
@@ -480,6 +510,7 @@ class ApiServer:
             ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
             ("POST", "/internal/restart-all"): self.handle_restart_all,
+            ("POST", "/internal/user-script"): self.handle_user_script,
             ("POST", "/internal/benchmark"): self.handle_benchmark,
             ("GET", "/internal/workers"): self.handle_workers_get,
             ("POST", "/internal/workers"): self.handle_workers_post,
@@ -491,6 +522,7 @@ class ApiServer:
             ("POST", "/sdapi/v1/interrupt"): self.handle_interrupt,
             ("GET", "/sdapi/v1/memory"): self._memory,
             ("GET", "/sdapi/v1/sd-models"): self.handle_sd_models,
+            ("GET", "/sdapi/v1/embeddings"): self.handle_embeddings,
             ("GET", "/sdapi/v1/samplers"): self.handle_samplers,
             ("GET", "/sdapi/v1/script-info"): self.handle_script_info,
             ("POST", "/sdapi/v1/refresh-checkpoints"): self.handle_refresh,

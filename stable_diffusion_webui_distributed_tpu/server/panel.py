@@ -53,6 +53,7 @@ PANEL_HTML = """<!doctype html>
   <button onclick="post('/sdapi/v1/interrupt', {})">interrupt all</button>
   <button onclick="benchmark()">re-benchmark</button>
   <button onclick="post('/internal/reset-mpe', {})">reset MPE</button>
+  <button onclick="post('/internal/user-script', {})">run sync script</button>
   <button class="danger" onclick="restartAll()">restart all workers</button>
 </p>
 <h2>workers</h2>

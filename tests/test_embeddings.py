@@ -1,0 +1,200 @@
+"""Textual-inversion embedding tests: file formats, tokenizer placeholder
+placement, and exact conditioning-injection semantics (webui splices
+learned vectors into CLIP's token-embedding stream on every worker; here
+models/embeddings.py + models/clip.py inject args own it natively)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models import embeddings as emb
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY, TINY_XL
+from stable_diffusion_webui_distributed_tpu.models.prompt import (
+    tokenize_with_embeddings,
+)
+from stable_diffusion_webui_distributed_tpu.models.tokenizer import (
+    load_tokenizer,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+from test_pipeline import init_params
+
+
+class TestLoading:
+    def test_safetensors_emb_params(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        vecs = np.random.default_rng(0).standard_normal((3, 16)) \
+            .astype(np.float32)
+        p = str(tmp_path / "style.safetensors")
+        save_file({"emb_params": vecs}, p)
+        e = emb.load_embedding(p)
+        assert e.n_vectors == 3 and e.clip_g is None
+        np.testing.assert_array_equal(e.clip_l, vecs)
+
+    def test_safetensors_sdxl_dual(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        rng = np.random.default_rng(1)
+        l = rng.standard_normal((2, 16)).astype(np.float32)
+        g = rng.standard_normal((2, 32)).astype(np.float32)
+        p = str(tmp_path / "xlstyle.safetensors")
+        save_file({"clip_l": l, "clip_g": g}, p)
+        e = emb.load_embedding(p)
+        assert e.n_vectors == 2
+        np.testing.assert_array_equal(e.clip_g, g)
+
+    def test_torch_pt_string_to_param(self, tmp_path):
+        import torch
+
+        vecs = torch.randn(2, 16)
+        p = str(tmp_path / "charname.pt")
+        torch.save({"string_to_param": {"*": vecs},
+                    "name": "charname"}, p)
+        e = emb.load_embedding(p)
+        assert e.n_vectors == 2
+        np.testing.assert_allclose(e.clip_l, vecs.numpy(), rtol=1e-6)
+
+    def test_store_discovery_case_insensitive(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        save_file({"emb_params": np.zeros((1, 16), np.float32)},
+                  str(tmp_path / "MyStyle.safetensors"))
+        store = emb.EmbeddingStore(str(tmp_path))
+        assert store.names() == ["mystyle"]
+        assert store.lookup("MYSTYLE") is not None
+        assert store.lookup("unknown") is None
+        assert store.vector_counts() == {"mystyle": 1}
+
+    def test_bad_file_skipped(self, tmp_path):
+        (tmp_path / "broken.safetensors").write_bytes(b"not a tensor file")
+        store = emb.EmbeddingStore(str(tmp_path))
+        assert store.lookup("broken") is None
+        assert store.vector_counts() == {}
+
+
+class TestTokenizer:
+    @pytest.fixture(scope="class")
+    def tok(self):
+        return load_tokenizer(None, TINY.text_encoder.vocab_size)
+
+    def test_placeholders_and_positions(self, tok):
+        ids, w, inj = tokenize_with_embeddings(
+            tok, "a MyStyle cat", {"mystyle": 2})
+        # positions are (row, col, name, vec); col 0 is BOS
+        assert [(r, n, v) for r, c, n, v in inj] == \
+            [(0, "mystyle", 0), (0, "mystyle", 1)]
+        cols = [c for _, c, _, _ in inj]
+        assert cols == [cols[0], cols[0] + 1]
+        assert all(ids[0, c] == 0 for c in cols)
+
+    def test_word_boundary_not_substring(self, tok):
+        _, _, inj = tokenize_with_embeddings(
+            tok, "restyled text", {"style": 1})
+        assert inj == []
+
+    def test_weight_applies_to_placeholders(self, tok):
+        ids, w, inj = tokenize_with_embeddings(
+            tok, "(MyStyle:1.5)", {"mystyle": 1})
+        (_, col, _, _), = inj
+        assert w[0, col] == pytest.approx(1.5)
+
+    def test_without_embeddings_matches_plain(self, tok):
+        a = tokenize_with_embeddings(tok, "plain words", None)
+        assert a[2] == []
+
+    def test_multi_vector_run_stays_atomic_at_chunk_boundary(self, tok):
+        # ~73 content tokens then an 8-vector embedding: webui opens a new
+        # chunk rather than splitting the run across EOS/BOS
+        filler = " ".join(f"w{i}" for i in range(36))  # ~72-73 tokens
+        ids, w, inj = tokenize_with_embeddings(
+            tok, filler + " myemb", {"myemb": 8})
+        rows = {r for r, _, _, _ in inj}
+        assert len(rows) == 1, f"run split across chunks {rows}"
+        cols = sorted(c for _, c, _, _ in inj)
+        assert cols == list(range(cols[0], cols[0] + 8))
+
+    def test_store_rescan_picks_up_new_files(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        store = emb.EmbeddingStore(str(tmp_path))
+        assert store.names() == []
+        save_file({"emb_params": np.zeros((1, 16), np.float32)},
+                  str(tmp_path / "late.safetensors"))
+        store.rescan(str(tmp_path))
+        assert store.names() == ["late"]
+
+
+class TestInjection:
+    @pytest.fixture(scope="class")
+    def store_and_engine(self, tmp_path_factory):
+        """An embedding whose vectors ARE the token-embedding rows of the
+        word 'cow' — prompts using it must reproduce 'cow' bit-for-bit."""
+        from safetensors.numpy import save_file
+
+        params = init_params(TINY)
+        tok = load_tokenizer(None, TINY.text_encoder.vocab_size)
+        cow_ids = tok.encode("cow")
+        table = np.asarray(
+            params["text_encoder"]["token_embedding"]["embedding"])
+        vecs = table[np.asarray(cow_ids)]
+
+        d = tmp_path_factory.mktemp("emb")
+        save_file({"emb_params": vecs.astype(np.float32)},
+                  str(d / "cowlike.safetensors"))
+        store = emb.EmbeddingStore(str(d))
+        engine = Engine(TINY, params, tokenizer=tok, chunk_size=4,
+                        state=GenerationState(), embedding_store=store)
+        return store, engine
+
+    def test_embedding_reproduces_token_rows_exactly(self, store_and_engine):
+        _, engine = store_and_engine
+        base = dict(steps=3, width=32, height=32, seed=11)
+        with_emb = engine.txt2img(GenerationPayload(
+            prompt="a cowlike grazing", **base))
+        plain = engine.txt2img(GenerationPayload(
+            prompt="a cow grazing", **base))
+        assert with_emb.images[0] == plain.images[0]
+
+    def test_embedding_changes_output_vs_unknown_word(self, store_and_engine):
+        _, engine = store_and_engine
+        base = dict(steps=3, width=32, height=32, seed=11)
+        with_emb = engine.txt2img(GenerationPayload(
+            prompt="a cowlike grazing", **base))
+        # without the store the same text tokenizes as ordinary words
+        no_store = Engine(TINY, engine.params, tokenizer=engine.tokenizer,
+                          chunk_size=4, state=GenerationState())
+        plain = no_store.txt2img(GenerationPayload(
+            prompt="a cowlike grazing", **base))
+        assert with_emb.images[0] != plain.images[0]
+
+    def test_negative_prompt_injection(self, store_and_engine):
+        _, engine = store_and_engine
+        base = dict(prompt="a barn", steps=3, width=32, height=32, seed=4)
+        neg_emb = engine.txt2img(GenerationPayload(
+            negative_prompt="cowlike", **base))
+        neg_plain = engine.txt2img(GenerationPayload(
+            negative_prompt="cow", **base))
+        assert neg_emb.images[0] == neg_plain.images[0]
+
+    def test_width_mismatch_skipped_not_crashed(self, store_and_engine,
+                                                tmp_path):
+        from safetensors.numpy import save_file
+
+        store, engine = store_and_engine
+        save_file({"emb_params": np.zeros((1, 9999), np.float32)},
+                  str(tmp_path / "wrongwidth.safetensors"))
+        wrong = emb.EmbeddingStore(str(tmp_path))
+        e2 = Engine(TINY, engine.params, tokenizer=engine.tokenizer,
+                    chunk_size=4, state=GenerationState(),
+                    embedding_store=wrong)
+        out = e2.txt2img(GenerationPayload(
+            prompt="wrongwidth here", steps=2, width=32, height=32, seed=1))
+        assert len(out.images) == 1  # degraded, not crashed

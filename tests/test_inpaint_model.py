@@ -1,0 +1,110 @@
+"""Inpainting-specialized checkpoint tests (9-channel UNet, ldm "hybrid"
+conditioning): every webui worker in a reference fleet serves
+sd-v1-5-inpainting-style models; here the engine concatenates
+[latent, mask, masked-image latent] natively (engine.py inpaint_cond)."""
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models import convert
+from stable_diffusion_webui_distributed_tpu.models.configs import (
+    FAMILIES,
+    SD15_INPAINT,
+    TINY,
+    TINY_INPAINT,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    array_to_b64png,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+from test_pipeline import init_params
+
+
+def b64_image(value, w=32, h=32):
+    return array_to_b64png(
+        np.full((h, w, 3), value, np.uint8))
+
+
+def b64_mask(w=32, h=32, half=True):
+    m = np.zeros((h, w, 3), np.uint8)
+    if half:
+        m[:, : w // 2] = 255
+    else:
+        m[:] = 255
+    return array_to_b64png(m)
+
+
+class TestFamilies:
+    def test_inpaint_property(self):
+        assert SD15_INPAINT.inpaint and TINY_INPAINT.inpaint
+        assert not TINY.inpaint
+        for name in ("sd15-inpaint", "sd2-inpaint", "sdxl-inpaint",
+                     "tiny-inpaint"):
+            assert name in FAMILIES
+
+    def test_detect_family_by_conv_in(self):
+        base = {"model.diffusion_model.input_blocks.0.0.weight":
+                np.zeros((320, 9, 3, 3), np.float32)}
+        assert convert.detect_family(base) == "sd15-inpaint"
+        sd2 = dict(base)
+        sd2["cond_stage_model.model.ln_final.weight"] = np.zeros(
+            (1024,), np.float32)
+        assert convert.detect_family(sd2) == "sd2-inpaint"
+        four = {"model.diffusion_model.input_blocks.0.0.weight":
+                np.zeros((320, 4, 3, 3), np.float32)}
+        assert convert.detect_family(four) == "sd15"
+
+
+class TestEngine:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return Engine(TINY_INPAINT, init_params(TINY_INPAINT), chunk_size=4,
+                      state=GenerationState())
+
+    def test_txt2img_runs_and_is_deterministic(self, engine):
+        p = GenerationPayload(prompt="a barn", steps=3, width=32, height=32,
+                              seed=5)
+        a = engine.txt2img(p)
+        b = engine.txt2img(p)
+        assert len(a.images) == 1 and a.images == b.images
+
+    def test_img2img_mask_conditioning_changes_output(self, engine):
+        base = dict(prompt="fix it", steps=4, width=32, height=32, seed=8,
+                    init_images=[b64_image(128)],
+                    denoising_strength=0.8, mask_blur=0)
+        left = engine.img2img(GenerationPayload(**base, mask=b64_mask()))
+        full = engine.img2img(GenerationPayload(
+            **base, mask=b64_mask(half=False)))
+        assert len(left.images) == 1
+        # different masks change the hybrid conditioning AND the pinning,
+        # so outputs must differ
+        assert left.images[0] != full.images[0]
+        again = engine.img2img(GenerationPayload(**base, mask=b64_mask()))
+        assert again.images[0] == left.images[0]
+
+    def test_plain_img2img_uses_blank_conditioning(self, engine):
+        p = GenerationPayload(prompt="gray", steps=3, width=32, height=32,
+                              seed=2, init_images=[b64_image(90)],
+                              denoising_strength=0.7)
+        a = engine.img2img(p)
+        b = engine.img2img(p)
+        assert a.images == b.images
+
+    def test_range_split_seed_exact_on_inpaint_family(self, engine):
+        p = GenerationPayload(prompt="cows", steps=3, width=32, height=32,
+                              seed=31, batch_size=3)
+        full = engine.txt2img(p)
+        part = engine.generate_range(p, 1, 2)
+        assert part.images == full.images[1:3]
+
+    def test_hires_pass_runs(self, engine):
+        p = GenerationPayload(prompt="up", steps=3, width=32, height=32,
+                              seed=3, enable_hr=True, hr_scale=2.0,
+                              denoising_strength=0.7)
+        out = engine.txt2img(p)
+        assert len(out.images) == 1

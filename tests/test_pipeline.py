@@ -34,7 +34,7 @@ def init_params(family):
     te2 = (CLIPTextModel(family.text_encoder_2).init(k, ids)["params"]
            if family.text_encoder_2 else None)
     ctx_dim = family.unet.cross_attention_dim
-    args = [jnp.zeros((2, 8, 8, 4)), jnp.ones((2,)),
+    args = [jnp.zeros((2, 8, 8, family.unet.in_channels)), jnp.ones((2,)),
             jnp.zeros((2, 77, ctx_dim))]
     if family.unet.addition_embed_dim:
         args.append(jnp.zeros((2, family.unet.projection_input_dim)))
@@ -273,6 +273,37 @@ class TestImg2Img:
         shifted = engine.txt2img(GenerationPayload(
             **base, override_settings={"eta_noise_seed_delta": 31337}))
         assert shifted.images[0] != plain.images[0]
+
+    def test_prompts_from_file_script(self, engine):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts,
+        )
+
+        p = GenerationPayload(
+            prompt="ignored", steps=3, width=32, height=32, seed=40,
+            script_name="Prompts from file or textbox",
+            script_args=[True, False, "# comment\na cow\n\na dog\n"])
+        expanded = apply_scripts(p)
+        assert expanded.all_prompts == ["a cow", "a dog"]
+        assert expanded.batch_size == 2 and expanded.group_size == 1
+        assert not expanded.same_seed  # checkbox_iterate ON advances seeds
+        r = engine.txt2img(p)
+        assert len(r.images) == 2
+        assert r.prompts == ["a cow", "a dog"]
+        assert r.seeds == [40, 41]
+        # line i reproduces a plain generation of that prompt at seed+i
+        plain = engine.txt2img(GenerationPayload(
+            prompt="a dog", steps=3, width=32, height=32, seed=41))
+        assert r.images[1] == plain.images[0]
+
+        # default (checkbox_iterate off): webui runs every line at the
+        # request seed
+        p2 = GenerationPayload(
+            prompt="x", steps=3, width=32, height=32, seed=40,
+            script_name="Prompts from file or textbox",
+            script_args=[False, False, "a cow\na dog"])
+        r2 = engine.txt2img(p2)
+        assert r2.seeds == [40, 40]
 
     def test_prompt_matrix_expansion_order(self):
         from stable_diffusion_webui_distributed_tpu.pipeline.payload import (

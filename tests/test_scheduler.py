@@ -481,6 +481,24 @@ class TestWorkerControl:
         w.add_worker(bad)
         assert w.restart_all() == {"bad": False}
 
+    def test_user_script_runs_sync_file(self, tmp_path):
+        # reference user_script_btn (ui.py:26-55): a sync* file under
+        # <config dir>/user/, launched via its shebang
+        w = World(ConfigModel(), config_path=str(tmp_path / "cfg.json"))
+        assert w.run_user_script() is False  # no user/ dir yet
+
+        user = tmp_path / "user"
+        user.mkdir()
+        marker = tmp_path / "ran.txt"
+        script = user / "sync-models.sh"
+        script.write_text(f"#!/bin/sh\necho ok > {marker}\n")
+        assert w.run_user_script() is True
+        assert marker.read_text().strip() == "ok"
+
+        # a failing script reports False
+        script.write_text("#!/bin/sh\nexit 3\n")
+        assert w.run_user_script() is False
+
     def test_configure_worker_roundtrips_and_load_options_honors(self,
                                                                  tmp_path):
         path = str(tmp_path / "cfg.json")
