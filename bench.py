@@ -78,18 +78,26 @@ def _start_init_watchdog():
     return done
 
 
-def _zeros(mod, *args):
+def _zeros(mod, *args, dtype=None):
     import jax
     import jax.numpy as jnp
 
     shapes = jax.eval_shape(lambda: mod.init(jax.random.key(0), *args))
+
+    def make(s):
+        use = dtype if (dtype is not None
+                        and jnp.issubdtype(s.dtype, jnp.floating)) else s.dtype
+        return jnp.zeros(s.shape, use)
+
     # one jitted call: per-leaf jnp.zeros would be ~1000 separate device
-    # allocations (tens of seconds through the TPU relay)
-    return jax.jit(lambda: jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes))()["params"]
+    # allocations (tens of seconds through the TPU relay). Floating leaves
+    # are created directly in the policy's storage dtype — materializing
+    # SDXL f32 (10.4 GB) and casting after would transiently need ~15.6 GB,
+    # an OOM on a 16 GB v5e (seen: round-3 sweep c2/c4/c5).
+    return jax.jit(lambda: jax.tree_util.tree_map(make, shapes))()["params"]
 
 
-def _family_params(family):
+def _family_params(family, dtype=None):
     """Zero-init the full component dict for one model family."""
     import jax
     import jax.numpy as jnp
@@ -115,12 +123,15 @@ def _family_params(family):
             jnp.zeros((2, ucfg.addition_embed_dim)),
             jnp.zeros((2, n_ids)), ucfg.addition_time_embed_dim))
     return {
-        "text_encoder": _zeros(CLIPTextModel(family.text_encoder), ids),
-        "text_encoder_2": (_zeros(CLIPTextModel(family.text_encoder_2), ids)
+        "text_encoder": _zeros(CLIPTextModel(family.text_encoder), ids,
+                               dtype=dtype),
+        "text_encoder_2": (_zeros(CLIPTextModel(family.text_encoder_2), ids,
+                                  dtype=dtype)
                            if family.text_encoder_2 else None),
-        "unet": _zeros(UNet(ucfg), *uargs),
+        "unet": _zeros(UNet(ucfg), *uargs, dtype=dtype),
         "vae": _zeros(VAE(family.vae),
-                      jnp.zeros((1, 64, 64, 3)), jax.random.key(1)),
+                      jnp.zeros((1, 64, 64, 3)), jax.random.key(1),
+                      dtype=dtype),
     }
 
 
@@ -135,7 +146,7 @@ def _make_engine(family, refiner_family=None, lora_names=(),
     policy = dtypes.TPU if jax.devices()[0].platform != "cpu" else dtypes.F32
 
     t0 = time.time()
-    params = _family_params(family)
+    params = _family_params(family, dtype=policy.param_dtype)
     print(f"bench: zero-init {family.name} params in {time.time()-t0:.1f}s",
           file=sys.stderr)
 
@@ -156,7 +167,8 @@ def _make_engine(family, refiner_family=None, lora_names=(),
         cargs = [jnp.zeros((1, 8, 8, ucfg.in_channels)), jnp.ones((1,)),
                  jnp.zeros((1, 77, ucfg.cross_attention_dim)),
                  jnp.zeros((1, 64, 64, 3))]
-        cn_params = _zeros(ControlNet(ucfg), *cargs)
+        cn_params = _zeros(ControlNet(ucfg), *cargs,
+                           dtype=policy.param_dtype)
         controlnet_provider = lambda name: cn_params
 
     engines = {}
@@ -164,7 +176,7 @@ def _make_engine(family, refiner_family=None, lora_names=(),
     def engine_provider(name):
         return engines.get(name)
 
-    chunk = int(os.environ.get("SDTPU_CHUNK", "5"))  # sweepable knob
+    chunk = int(os.environ.get("SDTPU_CHUNK", "10"))  # sweep-measured best
     engine = Engine(family, params, policy=policy,
                     model_name=f"{family.name}-bench", chunk_size=chunk,
                     lora_provider=lora_provider,
@@ -172,7 +184,9 @@ def _make_engine(family, refiner_family=None, lora_names=(),
                     engine_provider=engine_provider)
     if refiner_family is not None:
         engines["refiner"] = Engine(
-            refiner_family, _family_params(refiner_family), policy=policy,
+            refiner_family,
+            _family_params(refiner_family, dtype=policy.param_dtype),
+            policy=policy,
             model_name=f"{refiner_family.name}-bench")
     return engine
 

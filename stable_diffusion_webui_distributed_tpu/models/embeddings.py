@@ -23,6 +23,7 @@ vectors are jit *arguments*, so switching embeddings never recompiles.
 from __future__ import annotations
 
 import os
+from collections.abc import Mapping
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -84,7 +85,11 @@ def load_embedding(path: str) -> Embedding:
     # torch .pt / .bin
     import torch
 
-    sd = torch.load(path, map_location="cpu", weights_only=False)
+    # weights_only: .pt embeddings are routinely downloaded from sharing
+    # sites; a full unpickle would execute arbitrary code from a malicious
+    # file. The safe unpickler covers every layout we parse (tensors,
+    # Parameters, dict/str/int containers).
+    sd = torch.load(path, map_location="cpu", weights_only=True)
     if "string_to_param" in sd:
         inner = {k: v.detach().float().numpy()
                  for k, v in sd["string_to_param"].items()}
@@ -138,15 +143,34 @@ class EmbeddingStore:
                 self._cache[key] = None
         return self._cache[key]
 
-    def vector_counts(self) -> Dict[str, int]:
-        """name -> n_vectors for every loadable embedding (loads lazily);
-        the tokenizer uses this to emit placeholder runs."""
-        out = {}
-        for name in self._paths:
-            emb = self.lookup(name)
-            if emb is not None:
-                out[name] = emb.n_vectors
-        return out
+    def vector_counts(self) -> "LazyCounts":
+        """name -> n_vectors mapping for the tokenizer's placeholder runs.
+
+        Lazy: iterating / truth-testing touches only the discovered file
+        names; a file is loaded the first time its COUNT is read — i.e.
+        only for embeddings actually mentioned in a prompt. An eager
+        version unpickled every file in the directory on the node's first
+        request for any prompt at all."""
+        return LazyCounts(self)
+
+
+class LazyCounts(Mapping):
+    """Read-through name -> n_vectors view over an EmbeddingStore."""
+
+    def __init__(self, store: EmbeddingStore):
+        self._store = store
+
+    def __iter__(self):
+        return iter(self._store._paths)
+
+    def __len__(self) -> int:
+        return len(self._store._paths)
+
+    def __getitem__(self, name: str) -> int:
+        emb = self._store.lookup(name)
+        if emb is None:  # unloadable file: absent (Mapping.get -> default)
+            raise KeyError(name)
+        return emb.n_vectors
 
 
 #: (chunk_row, column, embedding_name, vector_index) — where tokenizer

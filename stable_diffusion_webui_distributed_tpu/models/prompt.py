@@ -154,7 +154,11 @@ def tokenize_with_embeddings(
         for i, part in enumerate(parts):
             if emb_re and i % 2 == 1:  # a matched embedding name
                 name = part.lower()
-                n_vec = embeddings[name]
+                n_vec = embeddings.get(name, 0)
+                if n_vec <= 0:  # unloadable file: keep the literal text
+                    for tid in tokenizer.encode(part):
+                        emit(tid, w)
+                    continue
                 # keep the vector run atomic within one chunk (webui's
                 # chunking opens a new window when an embedding doesn't
                 # fit); runs longer than a whole chunk split unavoidably

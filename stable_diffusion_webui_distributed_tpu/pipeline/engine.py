@@ -67,7 +67,7 @@ class Engine:
         policy: dtypes.Policy = dtypes.F32,
         model_name: str = "",
         state: Optional[interrupt_mod.GenerationState] = None,
-        chunk_size: int = 5,
+        chunk_size: int = 10,  # measured best on v5e (PERF.md round-3 sweep)
         schedule: Optional[sched.NoiseSchedule] = None,
         mesh=None,
         lora_provider: Optional[Callable[[str], Optional[Dict]]] = None,
@@ -346,6 +346,24 @@ class Engine:
                 return jnp.clip(imgs * 0.5 + 0.5, 0.0, 1.0)
 
             return jax.jit(decode)
+
+        return self._cached(key, build)
+
+    def _decode_u8_fn(self, width: int, height: int, batch: int) -> Callable:
+        """Decode straight to uint8 pixels on-device: the host fetch moves
+        4x fewer bytes than the f32 image, which matters when the chip sits
+        behind a relay/DCN hop (PERF.md "relay lessons")."""
+        key = ("decode-u8", width, height, batch, self.family.name)
+        # resolve the float decode OUTSIDE the cached build: _cached holds a
+        # non-reentrant lock, so a nested _decode_fn lookup would deadlock
+        decode = self._decode_fn(width, height, batch)
+
+        def build():
+            def decode_u8(vae_params, latents):
+                return (decode(vae_params, latents) * 255.0 + 0.5
+                        ).astype(jnp.uint8)
+
+            return jax.jit(decode_u8)
 
         return self._cached(key, build)
 
@@ -939,7 +957,7 @@ class Engine:
                 latents, out_w, out_h = self._hires_pass(
                     payload, latents, keys, conds, pooleds, job,
                     refiner, ref_cond)
-            pending.append(self._queue_decoded(latents, pos, n, out_w, out_h))
+            pending.extend(self._queue_decoded(latents, pos, n, out_w, out_h))
             # depth-1 pipeline: keep only the newest decode in flight so
             # large n_iter jobs don't accumulate decoded buffers in HBM
             if len(pending) > 1:
@@ -1125,7 +1143,7 @@ class Engine:
                     payload, x, keys, conds, pooleds, width, height,
                     start_step, payload.steps, job, mask_lat, init_lat,
                     controls, inpaint_cond=inp)
-            pending.append(self._queue_decoded(latents, pos, n, width,
+            pending.extend(self._queue_decoded(latents, pos, n, width,
                                                height))
             if len(pending) > 1:  # depth-1 decode pipeline (see txt2img)
                 self._flush_decoded(out, payload, pending[:-1])
@@ -1137,27 +1155,48 @@ class Engine:
 
     def _append_decoded(self, out, payload, latents, pos, n, width, height):
         """Dispatch decode + materialize immediately (single-group path)."""
-        self._flush_decoded(out, payload, [self._queue_decoded(
-            latents, pos, n, width, height)])
+        self._flush_decoded(out, payload, self._queue_decoded(
+            latents, pos, n, width, height))
+
+    #: default decode micro-batch budget: images decoded per dispatch =
+    #: max(1, budget // (width*height)). The (f32-pinned) VAE decoder's
+    #: temps are ~16 bytes/pixel/image at its widest layer — batch-8
+    #: 1024x1024 in one dispatch needs 16 GB of HBM scratch (measured OOM,
+    #: PERF.md round 3); per-dispatch slicing caps scratch while the slices
+    #: still pipeline back-to-back on device.
+    _DECODE_PIXEL_BUDGET = 1024 * 1024
 
     def _queue_decoded(self, latents, pos, n, width, height):
         """Dispatch the VAE decode WITHOUT waiting: the returned device
-        array materializes later, so the decode of group i pipelines with
+        arrays materialize later, so the decode of group i pipelines with
         the denoise of group i+1 (SURVEY.md §7 hard part #6 overlap).
+
+        Returns a LIST of pending entries — the batch is decoded in
+        micro-batches under a pixel budget (see _DECODE_PIXEL_BUDGET) so
+        decoder scratch stays bounded at SDXL sizes.
 
         ``n`` is how many images to KEEP; latents may carry extra
         pad-and-drop rows — the decode executable is keyed on the actual
         row count so padded remainders reuse the full-group compile."""
-        decode = self._decode_fn(width, height, latents.shape[0])
-        with trace.STATS.timer("vae_decode_dispatch"):
-            imgs = decode(self.params["vae"], latents)
-        return (imgs, pos, n, width, height)
+        import os as _os
+
+        budget = int(_os.environ.get("SDTPU_DECODE_PIXELS",
+                                     str(self._DECODE_PIXEL_BUDGET)))
+        per = max(1, budget // max(1, width * height))
+        entries = []
+        for s in range(0, min(n, latents.shape[0]), per):
+            rows = latents[s:s + per]
+            keep = min(n - s, rows.shape[0])
+            decode = self._decode_u8_fn(width, height, rows.shape[0])
+            with trace.STATS.timer("vae_decode_dispatch"):
+                imgs = decode(self.params["vae"], rows)
+            entries.append((imgs, pos + s, keep, width, height))
+        return entries
 
     def _flush_decoded(self, out, payload, pending) -> None:
         for imgs_dev, pos, n, width, height in pending:
             with trace.STATS.timer("vae_decode_fetch"):
                 imgs = np.asarray(imgs_dev)
-            imgs = (imgs * 255.0 + 0.5).astype(np.uint8)
             self._append_images(out, payload, imgs, pos, n, width, height)
 
     def _append_images(self, out, payload, imgs, pos, n, width, height):

@@ -33,7 +33,7 @@ class ModelRegistry:
 
     def __init__(self, model_dir: str = "models",
                  policy: dtypes.Policy = dtypes.TPU,
-                 chunk_size: int = 5,
+                 chunk_size: int = 10,
                  state=None,
                  mesh=None):
         self.model_dir = model_dir

@@ -92,6 +92,9 @@ class World:
         # request, distributed.py:260-318 + worker.py:342-343)
         self.current_model: str = self.cfg.default_model
         self.current_vae: str = ""
+        # TLS verification for remotes added at runtime (reference
+        # --distributed-skip-verify-remotes, distributed.py:38-46)
+        self.verify_tls: bool = True
 
     # -- registry -----------------------------------------------------------
 
@@ -578,6 +581,46 @@ class World:
         self.save_config()
         return True
 
+    def add_remote_worker(self, label: str, address: str, port: int, *,
+                          tls: bool = False, user: Optional[str] = None,
+                          password: Optional[str] = None,
+                          pixel_cap: int = 0) -> WorkerNode:
+        """Register a new HTTP remote live (the reference's Worker Config
+        "Add Worker" flow, ui.py:90-159): the node joins the registry
+        immediately and is persisted. Raises ValueError on a duplicate
+        label or missing address."""
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        if not label:
+            raise ValueError("label required")
+        if self.get_worker(label) is not None:
+            raise ValueError(f"worker '{label}' already exists")
+        if not address:
+            raise ValueError("address required")
+        backend = HTTPBackend(address, int(port), tls=tls, user=user,
+                              password=password, verify_tls=self.verify_tls)
+        node = WorkerNode(label, backend, pixel_cap=max(0, int(pixel_cap)),
+                          benchmark_payload=self.cfg.benchmark_payload)
+        self.add_worker(node)
+        self.save_config()
+        return node
+
+    def remove_worker(self, label: str) -> bool:
+        """Drop a non-master worker from the registry and the persisted
+        config (reference Worker Config "Remove" flow, ui.py:173-186).
+        Returns False for an unknown label; raises on the master — the
+        reference's UI simply never offers it for removal."""
+        w = self.get_worker(label)
+        if w is None:
+            return False
+        if w.master:
+            raise ValueError("cannot remove the master worker")
+        self.workers.remove(w)
+        self.save_config()
+        return True
+
     def apply_settings(self, settings: Dict) -> Dict:
         """Runtime scheduler settings (the reference's Settings tab fields,
         ui.py:26-55): job_timeout / complement_production / step_scaling,
@@ -642,6 +685,7 @@ class World:
                 path = os.path.join(user_dir, name)
                 if name.startswith("sync") and os.path.isfile(path):
                     script = path
+                    break  # first in sort order wins
         if script is None:
             log.error(
                 "couldn't find user script: place a file named sync* "
@@ -738,6 +782,7 @@ class World:
         )
 
         world = cls(cfg, config_path)
+        world.verify_tls = verify_tls
         for entry in cfg.workers:
             for label, wm in entry.items():
                 if backend_factory is not None:

@@ -257,16 +257,21 @@ class ApiServer:
         """webui's GET /sdapi/v1/embeddings shape: loaded textual-inversion
         embeddings with their vector counts (models/embeddings.py)."""
         loaded: Dict[str, Any] = {}
+        skipped: Dict[str, Any] = {}
         store = getattr(self.registry, "embedding_store", None)
         if store is not None:
-            for name, n in store.vector_counts().items():
+            for name in store.names():
                 e = store.lookup(name)
+                if e is None:  # unloadable file — webui lists it as skipped
+                    skipped[name] = {}
+                    continue
                 loaded[name] = {
                     "step": None, "sd_checkpoint": None,
                     "sd_checkpoint_name": None,
-                    "shape": int(e.clip_l.shape[1]), "vectors": int(n),
+                    "shape": int(e.clip_l.shape[1]),
+                    "vectors": int(e.n_vectors),
                 }
-        return {"loaded": loaded, "skipped": {}}
+        return {"loaded": loaded, "skipped": skipped}
 
     def handle_script_info(self) -> Any:
         # advertised to masters that filter per-worker script args
@@ -454,13 +459,41 @@ class ApiServer:
         } for w in self.source.workers]
 
     def handle_workers_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
-        """Set per-worker runtime fields: model_override / pixel_cap /
-        disabled (reference ui.py:161-214 'Update Worker' flow)."""
+        """Worker CRUD (reference Worker Config tab, ui.py:90-214):
+        ``action`` = "update" (default — model_override/pixel_cap/disabled),
+        "add" (label+address+port join the fleet live), or "remove"."""
         if not hasattr(self.source, "configure_worker"):
             raise ApiError(400, "no fleet attached to this node")
         label = body.get("label", "")
         if not label:
             raise ApiError(422, "label required")
+        action = body.get("action", "update")
+        if action == "add":
+            try:
+                with self._busy:
+                    self.source.add_remote_worker(
+                        label, body.get("address", ""),
+                        int(body.get("port", 7860)),
+                        tls=bool(body.get("tls", False)),
+                        user=body.get("user") or None,
+                        password=body.get("password") or None,
+                        pixel_cap=int(body.get("pixel_cap", 0)))
+            except (ValueError, TypeError) as e:
+                # TypeError: JSON null / non-scalar port etc. — same
+                # malformed-field class as ValueError, so same 422
+                raise ApiError(422, str(e))
+            return {"added": label}
+        if action == "remove":
+            try:
+                with self._busy:
+                    ok = self.source.remove_worker(label)
+            except ValueError as e:
+                raise ApiError(422, str(e))
+            if not ok:
+                raise ApiError(404, f"no worker '{label}'")
+            return {"removed": label}
+        if action != "update":
+            raise ApiError(422, f"unknown action '{action}'")
         kwargs = {}
         for key in ("model_override", "pixel_cap", "disabled"):
             if key in body:

@@ -58,8 +58,17 @@ PANEL_HTML = """<!doctype html>
 </p>
 <h2>workers</h2>
 <table><thead><tr><th>label</th><th>state</th><th>speed</th><th>master</th>
-<th>pixel cap</th><th>model pin</th><th></th></tr></thead>
+<th>pixel cap</th><th>model pin</th><th></th><th></th></tr></thead>
 <tbody id="workers"></tbody></table>
+<form id="addworker" onsubmit="return addWorker()">
+  <label>label <input id="aw_label" size="10"></label>
+  <label>address <input id="aw_address" size="14"></label>
+  <label>port <input type="number" id="aw_port" value="7860"></label>
+  <label><input type="checkbox" id="aw_tls"> tls</label>
+  <label>user <input id="aw_user" size="8"></label>
+  <label>password <input type="password" id="aw_password" size="8"></label>
+  <button type="submit">add worker</button>
+</form>
 <h2>settings</h2>
 <form id="settings" onsubmit="return saveSettings()">
   <label>job timeout (s)
@@ -113,6 +122,23 @@ function toggle(i) {
   const w = workerRows[i];
   post('/internal/workers', {label: w.label, disabled: !w.disabled});
 }
+function removeWorker(i) {
+  const w = workerRows[i];
+  if (confirm(`Remove worker '${w.label}' from the fleet?`))
+    post('/internal/workers', {action: 'remove', label: w.label});
+}
+function addWorker() {
+  post('/internal/workers', {
+    action: 'add',
+    label: document.getElementById('aw_label').value,
+    address: document.getElementById('aw_address').value,
+    port: parseInt(document.getElementById('aw_port').value) || 7860,
+    tls: document.getElementById('aw_tls').checked,
+    user: document.getElementById('aw_user').value,
+    password: document.getElementById('aw_password').value,
+  });
+  return false;
+}
 function saveSettings() {
   post('/sdapi/v1/options', {
     job_timeout: parseInt(document.getElementById('job_timeout').value),
@@ -152,7 +178,10 @@ async function tick() {
       `<td><a href="#" onclick="setPin(${i});return false">` +
       `${w.model_override ? esc(w.model_override) : '—'}</a></td>` +
       `<td><button onclick="toggle(${i})">` +
-      `${w.disabled ? 'enable' : 'disable'}</button></td></tr>`).join('');
+      `${w.disabled ? 'enable' : 'disable'}</button></td>` +
+      `<td>${w.master ? '' :
+        `<button class="danger" onclick="removeWorker(${i})">x</button>`}` +
+      `</td></tr>`).join('');
     if (!settingsLoaded && s.settings) {
       document.getElementById('job_timeout').value = s.settings.job_timeout;
       document.getElementById('complement_production').checked =

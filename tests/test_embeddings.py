@@ -77,7 +77,29 @@ class TestLoading:
         (tmp_path / "broken.safetensors").write_bytes(b"not a tensor file")
         store = emb.EmbeddingStore(str(tmp_path))
         assert store.lookup("broken") is None
-        assert store.vector_counts() == {}
+        # counts view is lazy: the name is discovered, but reading its
+        # count finds the file unloadable and reports it absent
+        counts = store.vector_counts()
+        assert list(counts) == ["broken"]
+        assert counts.get("broken") is None
+
+    def test_counts_view_is_lazy(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        save_file({"emb_params": np.ones((2, 8), np.float32)},
+                  str(tmp_path / "style.safetensors"))
+        store = emb.EmbeddingStore(str(tmp_path))
+        loads = []
+        orig = emb.load_embedding
+        monkeypatch.setattr(emb, "load_embedding",
+                            lambda p: loads.append(p) or orig(p))
+        counts = store.vector_counts()
+        # iteration / truthiness never touch the files ...
+        assert bool(counts) and list(counts) == ["style"]
+        assert not loads
+        # ... only reading a mentioned name's count does
+        assert counts["style"] == 2
+        assert len(loads) == 1
 
 
 class TestTokenizer:

@@ -90,6 +90,18 @@ class TestTxt2Img:
         assert part12.images == full.images[1:]
         assert part12.seeds == full.seeds[1:]
 
+    def test_decode_microbatch_slices_match(self, engine, monkeypatch):
+        """Forcing the decode pixel budget down to one image per dispatch
+        must yield the same images and ordering as a single-dispatch
+        decode (SDXL-scale scratch bounding, engine._queue_decoded)."""
+        p = GenerationPayload(prompt="mb", steps=3, width=32, height=32,
+                              batch_size=3, seed=77)
+        whole = engine.txt2img(p)
+        monkeypatch.setenv("SDTPU_DECODE_PIXELS", str(32 * 32))
+        sliced = engine.txt2img(p)
+        assert sliced.images == whole.images
+        assert sliced.seeds == whole.seeds
+
     def test_remainder_group_pad_and_drop(self, engine):
         """7 images at batch_size 2: the final odd group reuses the
         compiled 2-batch executable (pad-and-drop) and must produce the

@@ -528,6 +528,40 @@ class TestWorkerControl:
         a2.load_options("fleet-model")
         assert a2.backend.options["model"] == "fleet-model"
 
+    def test_add_remove_remote_worker_live(self, tmp_path):
+        # the reference's Worker Config tab adds/removes workers on a
+        # RUNNING fleet (ui.py:90-186); verify registry + persistence
+        path = str(tmp_path / "cfg.json")
+        w = World(ConfigModel(), config_path=path)
+        master = node("local", 10.0)
+        master.master = True
+        w.add_worker(master)
+        n = w.add_remote_worker("r1", "10.0.0.5", 7860, tls=True,
+                                user="u", password="p", pixel_cap=99)
+        assert w.get_worker("r1") is n
+        assert n.backend.address == "10.0.0.5" and n.backend.tls
+        with pytest.raises(ValueError):
+            w.add_remote_worker("r1", "10.0.0.5", 7860)  # duplicate
+        with pytest.raises(ValueError):
+            w.add_remote_worker("r2", "", 7860)          # no address
+        # persisted with credentials; survives a reload
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            load_config,
+        )
+
+        w2 = World.from_config(load_config(path))
+        r1 = w2.get_worker("r1")
+        assert r1 is not None and r1.pixel_cap == 99
+        assert r1.backend.user == "u" and r1.backend.password == "p"
+        # removal drops it from registry and config
+        assert w.remove_worker("r1")
+        assert w.get_worker("r1") is None
+        assert not w.remove_worker("ghost")
+        with pytest.raises(ValueError):
+            w.remove_worker("local")  # master is never removable
+        w3 = World.from_config(load_config(path))
+        assert w3.get_worker("r1") is None
+
     def test_configure_worker_disable_enable(self):
         w = World(ConfigModel())
         a = node("a", 10.0)

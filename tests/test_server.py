@@ -136,6 +136,54 @@ class TestRoutes:
         finally:
             world.workers.remove(extra)
 
+    def test_embeddings_route_tolerates_broken_file(self, tmp_path):
+        from safetensors.numpy import save_file
+        import numpy as np
+        import types
+
+        from stable_diffusion_webui_distributed_tpu.models.embeddings import (
+            EmbeddingStore,
+        )
+
+        save_file({"emb_params": np.ones((2, 8), np.float32)},
+                  str(tmp_path / "good.safetensors"))
+        (tmp_path / "broken.safetensors").write_bytes(b"junk")
+        registry = types.SimpleNamespace(
+            embedding_store=EmbeddingStore(str(tmp_path)))
+        srv = ApiServer(make_world(), registry=registry,
+                        host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            out = call(srv, "/sdapi/v1/embeddings")
+        finally:
+            srv.stop()
+        assert out["loaded"]["good"]["vectors"] == 2
+        assert "broken" in out["skipped"]  # unloadable must not 500
+
+    def test_workers_add_remove_routes(self, server):
+        world = server.source
+        out = call(server, "/internal/workers",
+                   {"action": "add", "label": "new-r", "address": "h1",
+                    "port": 7861})
+        assert out["added"] == "new-r"
+        assert world.get_worker("new-r") is not None
+        try:
+            # duplicate add -> 422
+            with pytest.raises(urllib.error.HTTPError) as e:
+                call(server, "/internal/workers",
+                     {"action": "add", "label": "new-r", "address": "h1",
+                      "port": 7861})
+            assert e.value.code == 422
+        finally:
+            out = call(server, "/internal/workers",
+                       {"action": "remove", "label": "new-r"})
+        assert out["removed"] == "new-r"
+        assert world.get_worker("new-r") is None
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(server, "/internal/workers",
+                 {"action": "remove", "label": "new-r"})
+        assert e.value.code == 404
+
     def test_restart_all_route(self, server):
         world = server.source
         extra = WorkerNode("r2", StubBackend(), avg_ipm=5.0)

@@ -1,29 +1,34 @@
-"""One-process TPU tuning sweep over the bench configs and policy knobs.
+"""TPU tuning sweep over the bench configs and policy knobs.
 
-Claims the chip ONCE and runs every (config, knob) cell in sequence —
-separate bench.py invocations would pay ~1 min of backend init each and
-multiply the chance of wedging the pool-side chip claim (see
-PERF.md "relay lessons"). Results stream to ``PERF_SWEEP.jsonl`` (one
-JSON object per completed cell) so a mid-sweep abort still leaves data.
+Each cell runs in its OWN subprocess: the parent never imports jax, so a
+cell that dies (OOM, relay hiccup) releases the chip claim and its HBM on
+exit and cannot poison later cells — a round-3 one-process run showed an
+SDXL OOM leaving HBM wedged for every subsequent cell, even with
+``jax.clear_caches()`` between them. The per-cell backend init (~30-60 s
+through the relay) is the price of isolation.
+
+Results stream to ``PERF_SWEEP.jsonl`` (one JSON object per completed
+cell) so a mid-sweep abort still leaves data.
 
 Usage: python tools/sweep.py [cell ...]   (default: all cells)
 Cells are named, e.g. ``c1-bf16``, ``c1-chunk10``, ``c1-flash``,
 ``c2-bf16``; ``--list`` prints them. A global deadline
-(SDTPU_SWEEP_DEADLINE seconds, default 3300) exits gracefully between
-cells rather than being killed mid-compile by an external timeout.
+(SDTPU_SWEEP_DEADLINE seconds, default 3300) stops launching new cells;
+a running cell is never killed externally (a SIGTERM mid-XLA-compile
+wedges the pool-side chip claim — PERF.md "relay lessons"); each child
+relies on bench's own init watchdog instead.
 """
 
 from __future__ import annotations
 
-import gc
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import bench  # noqa: E402  (repo root on path)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 
 def _policy(param="bf16", attention="xla", remat=False):
@@ -46,6 +51,8 @@ CELLS = {
     "c1-chunk10": (1, {}, 10),
     "c1-chunk20": (1, {}, 20),
     "c1-flash":   (1, {"attention": "flash"}, 5),
+    "c1-chunk8":  (1, {}, 8),
+    "c1-flash10": (1, {"attention": "flash"}, 10),
     "c2-bf16":    (2, {}, 5),
     "c2-remat":   (2, {"remat": True}, 5),
     "c3-bf16":    (3, {}, 5),
@@ -58,36 +65,19 @@ DEFAULT_ORDER = [
     "c3-bf16", "c5-bf16", "c4-bf16", "c2-bf16",
 ]
 
+#: sentinel line prefix the child prints its result row behind
+_ROW_MARK = "SWEEP_ROW:"
+
 
 def run_cell(name):
+    """Child-process body: claim the chip, run one cell, print the row."""
+    import bench  # noqa: E402  (repo root on path)
+
     from stable_diffusion_webui_distributed_tpu.runtime import dtypes
 
-    cfg_n, pol_kwargs, chunk = CELLS[name]
-    dtypes.TPU = _policy(**pol_kwargs)  # bench._make_engine reads dtypes.TPU
-    os.environ["SDTPU_CHUNK"] = str(chunk)
-
-    t0 = time.time()
-    print(f"sweep: === {name} (config {cfg_n}) ===", file=sys.stderr,
-          flush=True)
-    out = bench.run_config(cfg_n, tiny=False)
-    out["cell"] = name
-    out["wall_s"] = round(time.time() - t0, 1)
-    return out
-
-
-def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("-")]
-    if "--list" in sys.argv:
-        print("\n".join(CELLS))
-        return
-    cells = args or DEFAULT_ORDER
-    unknown = [c for c in cells if c not in CELLS]
-    if unknown:
-        raise SystemExit(f"unknown cells {unknown}; --list to see all")
-
-    deadline = time.time() + float(
-        os.environ.get("SDTPU_SWEEP_DEADLINE", "3300"))
-
+    # fail-fast on a wedged chip claim (rc=3 + message beats hanging the
+    # whole sweep) and share the on-disk executable cache across cells —
+    # both normally done by bench.main(), which this child path bypasses
     init_done = bench._start_init_watchdog()
     import jax
 
@@ -99,23 +89,66 @@ def main():
 
     enable_compilation_cache()
 
-    out_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "PERF_SWEEP.jsonl")
+    cfg_n, pol_kwargs, chunk = CELLS[name]
+    dtypes.TPU = _policy(**pol_kwargs)  # bench._make_engine reads dtypes.TPU
+    os.environ["SDTPU_CHUNK"] = str(chunk)
+
+    t0 = time.time()
+    out = bench.run_config(cfg_n, tiny=False)
+    out["cell"] = name
+    out["wall_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def _child_main(name):
+    try:
+        row = run_cell(name)
+    except Exception as e:  # noqa: BLE001 — report and exit nonzero
+        row = {"cell": name, "error": f"{type(e).__name__}: {e}"}
+        print(_ROW_MARK + json.dumps(row), flush=True)
+        sys.exit(1)
+    print(_ROW_MARK + json.dumps(row), flush=True)
+
+
+def main():
+    if "--run-cell" in sys.argv:
+        _child_main(sys.argv[sys.argv.index("--run-cell") + 1])
+        return
+    if "--list" in sys.argv:
+        print("\n".join(CELLS))
+        return
+    cells = [a for a in sys.argv[1:] if not a.startswith("-")]
+    cells = cells or DEFAULT_ORDER
+    unknown = [c for c in cells if c not in CELLS]
+    if unknown:
+        raise SystemExit(f"unknown cells {unknown}; --list to see all")
+
+    deadline = time.time() + float(
+        os.environ.get("SDTPU_SWEEP_DEADLINE", "3300"))
+    out_path = os.path.join(_REPO, "PERF_SWEEP.jsonl")
+
     for name in cells:
         if time.time() > deadline - 120:
             print(f"sweep: deadline reached, stopping before {name}",
                   file=sys.stderr, flush=True)
             break
-        try:
-            row = run_cell(name)
-        except Exception as e:  # noqa: BLE001 — record and move on
-            row = {"cell": name, "error": f"{type(e).__name__}: {e}"}
-            print(f"sweep: {name} FAILED: {row['error']}", file=sys.stderr,
-                  flush=True)
+        print(f"sweep: === {name} ===", file=sys.stderr, flush=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run-cell", name],
+            stdout=subprocess.PIPE, text=True)
+        row = None
+        for line in (proc.stdout or "").splitlines():
+            if line.startswith(_ROW_MARK):
+                row = json.loads(line[len(_ROW_MARK):])
+        if row is None:
+            row = {"cell": name,
+                   "error": f"child exited rc={proc.returncode} with no row"}
+        if "error" in row:
+            print(f"sweep: {name} FAILED: {row['error'][:300]}",
+                  file=sys.stderr, flush=True)
         with open(out_path, "a") as f:
             f.write(json.dumps(row) + "\n")
-        print(f"sweep: {json.dumps(row)}", file=sys.stderr, flush=True)
-        gc.collect()  # drop the cell's engine so HBM frees before the next
+        print(f"sweep: {json.dumps(row)[:500]}", file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":
