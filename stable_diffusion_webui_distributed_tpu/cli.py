@@ -125,9 +125,30 @@ def cmd_generate(args) -> int:
         interrupt_mod.STATE.flag.interrupt()
         world.interrupt_all()
 
+    xyz_opts = {}
+    for prefix, spec in (("x", args.xyz_x), ("y", args.xyz_y),
+                         ("z", args.xyz_z)):
+        if spec:
+            axis, _, vals = spec.partition(":")
+            xyz_opts[f"{prefix}_axis"] = axis.strip()
+            xyz_opts[f"{prefix}_values"] = vals.strip()
+
     previous = signal.signal(signal.SIGINT, on_sigint)
     try:
-        result = world.execute(payload)
+        if xyz_opts:
+            from stable_diffusion_webui_distributed_tpu.pipeline.xyz import (
+                run_xyz,
+            )
+            from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import (
+                SAMPLERS,
+            )
+
+            payload.script_name = "x/y/z plot"
+            payload.script_args = [xyz_opts]
+            result = run_xyz(payload, world.execute,
+                             known_samplers=list(SAMPLERS))
+        else:
+            result = world.execute(payload)
     finally:
         signal.signal(signal.SIGINT, previous)
 
@@ -310,6 +331,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--hires-scale", type=float, default=2.0)
     g.add_argument("--outdir", default="outputs")
     g.add_argument("--verbose-info", action="store_true")
+    g.add_argument("--xyz-x", default=None, metavar='"AXIS: VALUES"',
+                   help='x/y/z plot x axis, e.g. "Steps: 10,20,30"')
+    g.add_argument("--xyz-y", default=None, metavar='"AXIS: VALUES"')
+    g.add_argument("--xyz-z", default=None, metavar='"AXIS: VALUES"')
     g.set_defaults(fn=cmd_generate)
 
     b = sub.add_parser("benchmark", help="2+3 ipm benchmark of all workers")

@@ -112,6 +112,9 @@ class EmbeddingStore:
     def __init__(self, directory: Optional[str]):
         self._paths: Dict[str, str] = {}   # lowercase name -> path
         self._cache: Dict[str, Optional[Embedding]] = {}
+        #: bumped on every rescan — consumers (engine cond cache) use it to
+        #: invalidate anything derived from the file set
+        self.generation = 0
         self.rescan(directory)
 
     def rescan(self, directory: Optional[str]) -> None:
@@ -121,6 +124,7 @@ class EmbeddingStore:
         self.directory = directory
         self._paths = {}
         self._cache = {}
+        self.generation += 1
         if directory and os.path.isdir(directory):
             for fn in sorted(os.listdir(directory)):
                 if fn.endswith(_SUFFIXES):

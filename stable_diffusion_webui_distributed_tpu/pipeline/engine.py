@@ -153,6 +153,15 @@ class Engine:
         # blank hybrid-conditioning latents per (batch, size); VAE-derived,
         # so set_vae clears it
         self._blank_cond_cache: Dict[Tuple, Any] = {}
+        # cross-request conditioning cache (webui keeps cached_c/cached_uc
+        # across same-prompt requests, processing.py); keyed on prompt text
+        # + clip_skip + chunk count, epoch-invalidated on LoRA merges and
+        # embedding-store rescans. Entries are ~1 MB of device arrays.
+        from collections import OrderedDict
+
+        self._cond_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        self._cond_epoch = 0
+        self._COND_CACHE_MAX = 64
 
     # -- compiled stage factories ------------------------------------------
 
@@ -417,6 +426,9 @@ class Engine:
         # tags — always re-merges from _base_params, so a partial merge can
         # never leak into later images.
         self._active_loras = key if all_resolved else _UNRESOLVED
+        # TE weights changed: conds computed under the old merge are stale
+        self._cond_epoch += 1
+        self._cond_cache.clear()
 
     def _apply_prompt_loras(self, payload: GenerationPayload) -> None:
         """Activate adapters named in the prompt. The payload keeps its tags
@@ -558,7 +570,6 @@ class Engine:
                 + ([payload.context_chunks] if payload.context_chunks
                    else []))
         bos, eos = tok.bos, tok.eos
-        ids_u, w_u = pad_chunks(ids_u, w_u, n, eos, bos)
 
         h_l = self.family.text_encoder.hidden_size
         h_g = (self.family.text_encoder_2.hidden_size
@@ -575,23 +586,37 @@ class Engine:
         enc = self._encode_fn()
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
+        store_gen = (self.embedding_store.generation
+                     if self.embedding_store is not None else 0)
+
+        def cached_enc(raw, ids_c, w_c, inj_c):
+            # cross-request cache (webui's cached_c/uc): same text at the
+            # same clip_skip/chunk-count under the same TE weights and
+            # embedding files encodes to the same conditioning
+            key = (raw, skip, n, self._cond_epoch, store_gen)
+            hit = self._cond_cache.get(key)
+            if hit is not None:
+                self._cond_cache.move_to_end(key)
+                return hit
+            pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
+            out = enc(te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
+                      *inj_arrays(inj_c))
+            self._cond_cache[key] = out
+            if len(self._cond_cache) > self._COND_CACHE_MAX:
+                self._cond_cache.popitem(last=False)
+            return out
+
         with trace.STATS.timer("text_encode"):
-            cache: Dict[str, Tuple] = {}
             ctxs, pooleds = [], []
             for (ids_c, w_c, inj_c), raw in zip(toks, cleaned):
-                if raw not in cache:
-                    pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
-                    cache[raw] = enc(te, te2, jnp.asarray(pi),
-                                     jnp.asarray(wi), skip,
-                                     *inj_arrays(inj_c))
-                ctxs.append(cache[raw][0])
-                pooleds.append(cache[raw][1])
+                ctx, pooled = cached_enc(raw, ids_c, w_c, inj_c)
+                ctxs.append(ctx)
+                pooleds.append(pooled)
             ctx_c = ctxs[0] if len(ctxs) == 1 else jnp.concatenate(ctxs, 0)
             pooled_c = pooleds[0] if len(pooleds) == 1 \
                 else jnp.concatenate(pooleds, 0)
-            ctx_u, pooled_u = enc(te, te2, jnp.asarray(ids_u),
-                                  jnp.asarray(w_u), skip,
-                                  *inj_arrays(inj_u))
+            ctx_u, pooled_u = cached_enc(payload.negative_prompt,
+                                         ids_u, w_u, inj_u)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _embedding_counts(self):
@@ -874,11 +899,14 @@ class Engine:
         if cached is not None:
             return cached
         h, w = self._latent_hw(width, height)
-        gray = jnp.full((batch, height, width, 3), 0.5, jnp.float32)
-        lat = self._encode_image_fn(width, height, batch)(
+        # encode ONE gray frame and tile: rows are identical, and a
+        # batch-1 encode keeps VAE scratch flat at SDXL sizes
+        gray = jnp.full((1, height, width, 3), 0.5, jnp.float32)
+        lat = self._encode_image_fn(width, height, 1)(
             self.params["vae"], gray)
-        mask = jnp.ones((batch, h, w, 1), jnp.float32)
-        cond = jnp.concatenate([mask, lat], axis=-1)
+        mask = jnp.ones((1, h, w, 1), jnp.float32)
+        cond = jnp.tile(jnp.concatenate([mask, lat], axis=-1),
+                        (batch, 1, 1, 1))
         self._blank_cond_cache[key] = cond
         return cond
 
@@ -889,9 +917,9 @@ class Engine:
         h, w = self._latent_hw(width, height)
         m = np.round(np.clip(mask_pixels, 0.0, 1.0))
         masked = init * (1.0 - m) + 0.5 * m
-        lat = self._encode_image_fn(width, height, batch)(
-            self.params["vae"],
-            jnp.asarray(masked)[None].repeat(batch, axis=0))
+        # identical rows: batch-1 encode + repeat (bounded VAE scratch)
+        lat = jnp.repeat(self._encode_image_fn(width, height, 1)(
+            self.params["vae"], jnp.asarray(masked)[None]), batch, axis=0)
         mask_lat = jnp.round(jnp.asarray(np.asarray(
             jax.image.resize(m, (h, w, 1), "bilinear")),
             jnp.float32))[None].repeat(batch, axis=0)
@@ -1032,14 +1060,26 @@ class Engine:
             upscale = self.upscaler_provider(name)
             if upscale is not None:
                 # image-space (ESRGAN-family) hires: decode -> model
-                # upscale to target -> re-encode (webui's non-latent path)
+                # upscale to target -> re-encode (webui's non-latent path);
+                # rows are DISTINCT images, so bound VAE scratch by slicing
+                # each stage under the decode pixel budget
+                import os as _os
+
+                budget = int(_os.environ.get(
+                    "SDTPU_DECODE_PIXELS", str(self._DECODE_PIXEL_BUDGET)))
+                per_lo = max(1, budget // max(1, payload.width
+                                              * payload.height))
+                per_hi = max(1, budget // max(1, tw * th))
                 with trace.STATS.timer("hires_upscale"):
-                    imgs = self._decode_fn(
-                        payload.width, payload.height, n)(
-                            self.params["vae"], latents)
-                    big = upscale(imgs, tw, th)
-                    up = self._encode_image_fn(tw, th, n)(
-                        self.params["vae"], big)
+                    ups = []
+                    for s in range(0, n, min(per_lo, per_hi)):
+                        e = min(n, s + min(per_lo, per_hi))
+                        imgs = self._decode_fn(
+                            payload.width, payload.height, e - s)(
+                                self.params["vae"], latents[s:e])
+                        ups.append(self._encode_image_fn(tw, th, e - s)(
+                            self.params["vae"], upscale(imgs, tw, th)))
+                    up = ups[0] if len(ups) == 1 else jnp.concatenate(ups)
         if up is None:
             up = jax.image.resize(latents, (n, th // f, tw // f, C),
                                   _latent_resize_method(payload.hr_upscaler))
@@ -1106,11 +1146,13 @@ class Engine:
         group = max(1, payload.group_size or payload.batch_size)
         pos, remaining = start, count
         pending = []
+        # the init image is one frame shared by every row: encode it ONCE
+        # at batch 1 (flat VAE scratch at SDXL sizes) and repeat per group
+        init_lat1 = self._encode_image_fn(width, height, 1)(
+            self.params["vae"], jnp.asarray(init)[None])
         while remaining > 0 and not self.state.flag.interrupted:
             n = min(group, remaining)
-            enc = self._encode_image_fn(width, height, n)
-            init_lat = enc(self.params["vae"],
-                           jnp.asarray(init)[None].repeat(n, axis=0))
+            init_lat = jnp.repeat(init_lat1, n, axis=0)
             keys = self._image_keys(payload, pos, n)
             init_lat = self._apply_inpaint_fill(
                 payload, init_lat, mask_lat, keys)

@@ -151,7 +151,7 @@ class ApiServer:
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
         with self._busy:
-            result = self._execute(payload)
+            result = self._run_scripted(payload)
         return self._generation_response(result)
 
     def handle_img2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -161,8 +161,24 @@ class ApiServer:
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
         with self._busy:
-            result = self._execute(payload)
+            result = self._run_scripted(payload)
         return self._generation_response(result)
+
+    def _run_scripted(self, payload: GenerationPayload) -> GenerationResult:
+        """Dispatch through master-side multi-generation scripts (x/y/z
+        plot runs one full — fleet-distributed — generation per cell)."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.xyz import (
+            is_xyz,
+            run_xyz,
+        )
+
+        if is_xyz(payload):
+            try:
+                return run_xyz(payload, self._execute,
+                               known_samplers=list(SAMPLERS))
+            except ValueError as e:
+                raise ApiError(422, str(e))
+        return self._execute(payload)
 
     def handle_options_get(self) -> Dict[str, Any]:
         return dict(self.options)
@@ -284,6 +300,8 @@ class ApiServer:
              "is_img2img": False, "args": []},
             {"name": "prompts from file or textbox", "is_alwayson": False,
              "is_img2img": False, "args": []},
+            {"name": "x/y/z plot", "is_alwayson": False,
+             "is_img2img": True, "args": []},
         ]
 
     def handle_refresh(self) -> Dict[str, Any]:

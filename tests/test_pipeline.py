@@ -90,6 +90,27 @@ class TestTxt2Img:
         assert part12.images == full.images[1:]
         assert part12.seeds == full.seeds[1:]
 
+    def test_cond_cache_reused_across_requests(self, engine, monkeypatch):
+        """Second request with the same prompt skips text encoding entirely
+        (webui's cached_c/uc); a LoRA change invalidates the cache."""
+        p = GenerationPayload(prompt="cache me", steps=2, width=32,
+                              height=32, seed=3)
+        first = engine.txt2img(p)
+        enc = engine._encode_fn()
+        calls = []
+
+        def counting(*args, **kw):
+            calls.append(1)
+            return enc(*args, **kw)
+
+        monkeypatch.setattr(engine, "_encode_fn", lambda: counting)
+        again = engine.txt2img(p)
+        assert again.images == first.images
+        assert calls == []  # both cond and uncond came from the cache
+        engine._cond_epoch += 1  # what set_loras does on a merge
+        engine.txt2img(p)
+        assert calls  # stale epoch -> re-encoded
+
     def test_decode_microbatch_slices_match(self, engine, monkeypatch):
         """Forcing the decode pixel budget down to one image per dispatch
         must yield the same images and ordering as a single-dispatch

@@ -1,0 +1,136 @@
+"""X/Y/Z plot: axis parsing, cell fan-out, grid assembly (pipeline/xyz.py)."""
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+    GenerationResult,
+    array_to_b64png,
+    b64png_to_array,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline import xyz
+
+
+class TestParse:
+    def test_comma_list_int(self):
+        assert xyz.parse_axis_values("int", "10, 20,30") == [10, 20, 30]
+
+    def test_plain_int_range(self):
+        assert xyz.parse_axis_values("int", "1-5") == [1, 2, 3, 4, 5]
+
+    def test_counted_range(self):
+        assert xyz.parse_axis_values("int", "1-10 [5]") == [1, 3, 5, 7, 10]
+
+    def test_counted_range_float(self):
+        vals = xyz.parse_axis_values("float", "0-1 [3]")
+        assert vals == [0.0, 0.5, 1.0]
+
+    def test_stepped_range(self):
+        assert xyz.parse_axis_values("int", "1-10 (+2)") == [1, 3, 5, 7, 9]
+
+    def test_descending_int_range(self):
+        assert xyz.parse_axis_values("int", "3-1") == [3, 2, 1]
+
+    def test_text_list(self):
+        assert xyz.parse_axis_values("text", "Euler a, DDIM") == \
+            ["Euler a", "DDIM"]
+
+    def test_empty_is_single_none(self):
+        assert xyz.parse_axis_values("none", "") == [None]
+        assert xyz.parse_axis_values("int", "") == [None]
+
+    def test_zero_step_raises(self):
+        with pytest.raises(ValueError):
+            xyz.parse_axis_values("int", "1-5 (+0)")
+
+
+def _stub_execute(log):
+    def execute(p):
+        log.append(p)
+        img = np.full((8, 8, 3), len(log) * 10 % 255, np.uint8)
+        return GenerationResult(
+            images=[array_to_b64png(img)], seeds=[p.seed], subseeds=[0],
+            prompts=[p.prompt], negative_prompts=[p.negative_prompt],
+            infotexts=[f"Steps: {p.steps}"], worker_labels=[""])
+    return execute
+
+
+class TestRun:
+    def test_grid_and_cells(self):
+        log = []
+        p = GenerationPayload(
+            prompt="a cat", seed=4, steps=20,
+            script_name="x/y/z plot",
+            script_args=[{"x_axis": "Steps", "x_values": "10,20",
+                          "y_axis": "CFG Scale", "y_values": "5,7,9"}])
+        out = xyz.run_xyz(p, _stub_execute(log))
+        assert len(log) == 6  # 2 x 3 cells
+        assert sorted({c.steps for c in log}) == [10, 20]
+        assert sorted({c.cfg_scale for c in log}) == [5.0, 7.0, 9.0]
+        # every cell shares the fixed base seed
+        assert {c.seed for c in log} == {4}
+        # gallery: 1 grid + 6 cells, grid first
+        assert len(out.images) == 7
+        grid = b64png_to_array(out.images[0])
+        # 2 cols x 3 rows of 8x8 cells + label margins
+        assert grid.shape[0] >= 24 and grid.shape[1] >= 16
+
+    def test_prompt_sr(self):
+        log = []
+        p = GenerationPayload(
+            prompt="a red cat", seed=1, script_name="xyz plot",
+            script_args=[{"x_axis": "Prompt S/R",
+                          "x_values": "red, blue, green"}])
+        xyz.run_xyz(p, _stub_execute(log))
+        assert [c.prompt for c in log] == \
+            ["a red cat", "a blue cat", "a green cat"]
+
+    def test_seed_axis_overrides_base(self):
+        log = []
+        p = GenerationPayload(
+            prompt="x", seed=7, script_name="x/y/z plot",
+            script_args=[{"x_axis": "Seed", "x_values": "100,200"}])
+        xyz.run_xyz(p, _stub_execute(log))
+        assert [c.seed for c in log] == [100, 200]
+
+    def test_unknown_axis_and_cap(self):
+        p = GenerationPayload(
+            prompt="x", script_name="x/y/z plot",
+            script_args=[{"x_axis": "nope", "x_values": "1"}])
+        with pytest.raises(ValueError):
+            xyz.run_xyz(p, _stub_execute([]))
+        p2 = GenerationPayload(
+            prompt="x", script_name="x/y/z plot",
+            script_args=[{"x_axis": "Seed", "x_values": "1-200"}])
+        with pytest.raises(ValueError):
+            xyz.run_xyz(p2, _stub_execute([]))
+
+    def test_unknown_sampler_rejected(self):
+        p = GenerationPayload(
+            prompt="x", script_name="x/y/z plot",
+            script_args=[{"x_axis": "Sampler", "x_values": "Euler a, Bogus"}])
+        with pytest.raises(ValueError):
+            xyz.run_xyz(p, _stub_execute([]), known_samplers=["Euler a"])
+
+    def test_z_axis_multiple_grids(self):
+        log = []
+        p = GenerationPayload(
+            prompt="x", seed=1, script_name="x/y/z plot",
+            script_args=[{"x_axis": "Steps", "x_values": "10,20",
+                          "z_axis": "CFG Scale", "z_values": "5,9"}])
+        out = xyz.run_xyz(p, _stub_execute(log))
+        assert len(log) == 4
+        assert len(out.images) == 6  # 2 grids + 4 cells
+
+    def test_cells_are_full_requests_not_mutations(self):
+        """The base payload must not leak mutations between cells."""
+        log = []
+        p = GenerationPayload(
+            prompt="a red cat", seed=1, script_name="x/y/z plot",
+            script_args=[{"x_axis": "Prompt S/R",
+                          "x_values": "red, blue"},
+                         {"y_axis": "Steps", "y_values": "10,20"}])
+        xyz.run_xyz(p, _stub_execute(log))
+        prompts = [c.prompt for c in log]
+        assert prompts == ["a red cat", "a blue cat"] * 2
