@@ -26,8 +26,13 @@ ETA arithmetic (worker.py:230-286). Extra keys: per-image p50 latency,
 images/sec/chip, and a UNet-FLOPs MFU estimate against the chip's peak.
 
 Env knobs: SDTPU_BENCH_TINY=1 (tiny logic-check mode for CPU-only runs),
-SDTPU_BENCH_INIT_TIMEOUT (seconds before a wedged TPU claim aborts with a
-clear error instead of hanging into the driver's timeout; default 480).
+SDTPU_BENCH_INIT_TIMEOUT (total seconds of init-probe budget before a
+wedged TPU claim aborts with a clear error instead of hanging into the
+driver's timeout; default 480). The budget is spent as TWO subprocess
+probes with a cooldown pause between them (a wedged chip claim sometimes
+clears after the first hung client exits — PERF.md "relay lessons");
+rc=3 only after both probes wedge. SDTPU_BENCH_CHILD=1 marks the inner
+single-attempt process (set automatically).
 """
 
 from __future__ import annotations
@@ -58,11 +63,12 @@ def _peak_for(device_kind: str):
     return None
 
 
-def _start_init_watchdog():
+def _start_init_watchdog(timeout=None):
     """Abort with a readable error if TPU backend init wedges on the chip
     claim (the relay has been seen to hang indefinitely; rc=3 + stderr beats
     the driver's opaque kill)."""
-    timeout = float(os.environ.get("SDTPU_BENCH_INIT_TIMEOUT", "480"))
+    if timeout is None:
+        timeout = float(os.environ.get("SDTPU_BENCH_INIT_TIMEOUT", "480"))
     done = threading.Event()
 
     def watch():
@@ -76,6 +82,43 @@ def _start_init_watchdog():
 
     threading.Thread(target=watch, daemon=True).start()
     return done
+
+
+def _run_with_retry(argv):
+    """Parent mode: run the real bench as a child process; if its backend
+    init wedges (rc=3), cool down and retry ONCE with the remaining budget.
+
+    The per-attempt watchdog only covers backend init — once the child's
+    ``jax.devices()`` returns, its watchdog disarms and the child may
+    legitimately run for many minutes (SDXL first-compile), so the parent
+    never imposes a wall-clock kill (an external SIGTERM mid-XLA-compile is
+    exactly what wedges the pool-side claim; PERF.md "relay lessons")."""
+    import subprocess
+
+    budget = float(os.environ.get("SDTPU_BENCH_INIT_TIMEOUT", "480"))
+    # 45% + pause + remainder keeps the worst case (both probes wedge)
+    # within ~the old single-probe budget: 216 + 48 + 216 ≈ 480 s. The
+    # floors keep tiny budgets meaningful (each probe >= 30 s).
+    probe1 = max(30.0, budget * 0.45)
+    pause = min(60.0, budget * 0.1)
+    probe2 = max(30.0, budget - probe1 - pause)
+    env = dict(os.environ, SDTPU_BENCH_CHILD="1")
+
+    for attempt, probe in enumerate((probe1, probe2)):
+        env["SDTPU_BENCH_INIT_TIMEOUT"] = str(probe)
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__),
+                               *argv], env=env)
+        if proc.returncode != 3:
+            raise SystemExit(proc.returncode)
+        if attempt == 0:
+            print(f"bench: init probe 1 wedged after {probe:.0f}s; cooling "
+                  f"down {pause:.0f}s then retrying once "
+                  "(a dead client sometimes releases the claim)",
+                  file=sys.stderr, flush=True)
+            time.sleep(pause)
+    print("bench: FATAL: both init probes wedged — chip claim not "
+          "obtainable this run", file=sys.stderr, flush=True)
+    raise SystemExit(3)
 
 
 def _zeros(mod, *args, dtype=None):
@@ -441,10 +484,23 @@ def main() -> None:
     # (same protocol and code path, tiny models + payloads; NOT a perf claim).
     tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
 
+    # Real-chip runs go through the probe-twice-with-cooldown parent (the
+    # retry only matters for a wedged TPU claim; tiny/CPU runs skip it).
+    if not tiny and os.environ.get("SDTPU_BENCH_CHILD", "") != "1" \
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        _run_with_retry(sys.argv[1:])
+
     init_done = _start_init_watchdog()
     import jax
 
-    jax.devices()
+    try:
+        jax.devices()
+    except RuntimeError as e:
+        # an UNAVAILABLE pool answers fast but still fails — same rc=3 as
+        # a wedge so the parent retry (cooldown + second probe) applies
+        print(f"bench: FATAL: TPU backend init failed: {e}",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
     init_done.set()
 
     # persist XLA executables across bench invocations (a tuning sweep

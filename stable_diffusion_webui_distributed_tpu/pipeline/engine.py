@@ -342,6 +342,133 @@ class Engine:
 
         return self._cached(key, build)
 
+    def _adaptive_attempt_fn(self, width: int, height: int, batch: int,
+                             n_controls: int = 0,
+                             inpaint: bool = False) -> Callable:
+        """Compiled DPM-adaptive attempt (kd.make_adaptive_attempt): 3 CFG
+        UNet evals + embedded-pair error norm in ONE dispatch, with the
+        log-sigma position/step (s, h) as traced data — the whole adaptive
+        trajectory reuses a single executable."""
+        key = ("adaptive", width, height, batch, n_controls, inpaint,
+               self.family.name)
+
+        def build():
+            def run(unet_params, x, x_prev, s, h, rtol, atol, ctx_u, ctx_c,
+                    cfg, added_u, added_c, controls, inpaint_cond):
+                denoise = self._make_denoise_fn(
+                    unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
+                    controls=controls, total_steps=1,
+                    inpaint_cond=inpaint_cond if inpaint else None)
+                return kd.make_adaptive_attempt(denoise)(
+                    x, x_prev, s, h, rtol, atol)
+
+            return jax.jit(run)
+
+        return self._cached(key, build)
+
+    def _adaptive_pin_fn(self) -> Callable:
+        """Inpaint region pinning after an accepted adaptive step: unmasked
+        area re-noised to the accepted sigma (the adaptive-path analogue of
+        the per-step pinning in _chunk_fn). Noise domain 2_000_000+n keeps
+        it disjoint from the fixed-grid path's 1_000_000+i keys."""
+        key = ("adaptive-pin", self.family.name)
+
+        def build():
+            def pin(x, mask_lat, init_lat, image_keys, sigma, n):
+                def renoise(k):
+                    return jax.random.normal(
+                        jax.random.fold_in(
+                            jax.random.fold_in(k, 2_000_000), n),
+                        init_lat.shape[1:], jnp.float32)
+
+                noise = jax.vmap(renoise)(image_keys)
+                return mask_lat * x + (1 - mask_lat) * (init_lat
+                                                        + noise * sigma)
+
+            return jax.jit(pin)
+
+        return self._cached(key, build)
+
+    def _denoise_adaptive(self, payload, x, image_keys, conds, pooleds,
+                          width, height, start_step, steps, job,
+                          mask_lat, init_lat, controls, end_step,
+                          inpaint_cond):
+        """DPM adaptive: host-side PID loop over the compiled attempt
+        (k-diffusion sample_dpm_adaptive semantics — the step slider only
+        sizes the sigma ladder's endpoints; the controller picks the actual
+        steps). Interrupt is polled between attempts, so latency is one
+        attempt (3 UNet evals). ControlNet guidance windows are honored
+        coarsely here: a unit is active for the whole trajectory (adaptive
+        stepping has no fixed step fractions to gate on)."""
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas = kd.build_sigmas(spec, self.schedule, steps)
+        end = steps if end_step is None else min(end_step, steps)
+        if start_step >= end:
+            return x
+        sigma_max = float(sigmas[start_step])
+        sig_end = float(sigmas[end])
+        sigma_min = sig_end if sig_end > 0 else float(sigmas[end - 1])
+        if sigma_max <= sigma_min:
+            return x
+
+        (ctx_u, ctx_c) = conds
+        au, ac = self._added_cond(*pooleds, width, height)
+        batch = x.shape[0]
+        cfg = jnp.float32(payload.cfg_scale)
+        inpainting = self.family.inpaint and inpaint_cond is not None
+        inp_arg = inpaint_cond if inpainting else jnp.float32(0)
+        masked = mask_lat is not None
+        # coarse window semantics (docstring): widen every unit's guidance
+        # window to the whole run — the in-graph gate compares against a
+        # frozen step fraction here (total_steps=1), which would otherwise
+        # silently disable units whose window excludes 0.5
+        controls = tuple((p, h, w, 0.0, 1.0)
+                         for (p, h, w, _s, _e) in controls)
+        fn = self._adaptive_attempt_fn(width, height, batch,
+                                       n_controls=len(controls),
+                                       inpaint=inpainting)
+
+        def attempt_fn(xx, x_prev, s, h, rtol, atol):
+            with trace.STATS.timer("denoise_chunk"), \
+                    trace.annotate("dpm-adaptive-attempt"):
+                return fn(self.params["unet"], xx, x_prev, s, h, rtol, atol,
+                          ctx_u, ctx_c, cfg, au, ac, tuple(controls),
+                          inp_arg)
+
+        # progress: accepted steps against the slider value (the controller
+        # ignores the slider, so the bar is indicative, like webui's)
+        self.state.begin(job, end - start_step)
+
+        def on_accept(xx, sigma, n):
+            self.state.step(min(n, end - start_step))
+            if masked:
+                xx = self._adaptive_pin_fn()(
+                    xx, mask_lat, init_lat, image_keys,
+                    jnp.float32(sigma), jnp.int32(n))
+            return xx
+
+        x_out, info = kd.sample_dpm_adaptive(
+            attempt_fn, x, sigma_max, sigma_min,
+            should_stop=lambda: self.state.flag.interrupted,
+            on_accept=on_accept)
+        if masked and info["completed"] and end == steps:
+            # terminal pin at sigma=0: the protected region must come back
+            # as the CLEAN init latent, exactly like the fixed-grid path's
+            # last step (which pins with sigmas[steps] == 0) — without this
+            # the whole unmasked area keeps sigma_min-level grain
+            x_out = self._adaptive_pin_fn()(
+                x_out, mask_lat, init_lat, image_keys,
+                jnp.float32(0.0), jnp.int32(0))
+        from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+            get_logger,
+        )
+
+        get_logger().debug(
+            "dpm adaptive: %d accepted / %d rejected steps, %d UNet evals",
+            info["n_accept"], info["n_reject"], info["nfe"])
+        self.state.finish()
+        return x_out
+
     def _decode_fn(self, width: int, height: int, batch: int) -> Callable:
         key = ("decode", width, height, batch, self.family.name)
 
@@ -831,6 +958,11 @@ class Engine:
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
         [start_step, end_step or steps) — a partial range is how the
         base half of a base+refiner pass stops at the switch point."""
+        if kd.resolve_sampler(payload.sampler_name).adaptive:
+            return self._denoise_adaptive(
+                payload, x, image_keys, conds, pooleds, width, height,
+                start_step, steps, job, mask_lat, init_lat, controls,
+                end_step, inpaint_cond)
         (ctx_u, ctx_c) = conds
         au, ac = self._added_cond(*pooleds, width, height)
         batch = x.shape[0]
@@ -1218,8 +1350,12 @@ class Engine:
         decoder scratch stays bounded at SDXL sizes.
 
         ``n`` is how many images to KEEP; latents may carry extra
-        pad-and-drop rows — the decode executable is keyed on the actual
-        row count so padded remainders reuse the full-group compile."""
+        pad-and-drop rows. A final short slice is padded back up to the
+        micro-batch row count (repeating its last row) whenever a
+        full-size slice ran before it, so every dispatch in the loop
+        shares ONE compiled executable; a batch small enough to fit in a
+        single slice keys on its actual row count (that key IS the only
+        one, so there is nothing to reuse)."""
         import os as _os
 
         budget = int(_os.environ.get("SDTPU_DECODE_PIXELS",
@@ -1229,6 +1365,9 @@ class Engine:
         for s in range(0, min(n, latents.shape[0]), per):
             rows = latents[s:s + per]
             keep = min(n - s, rows.shape[0])
+            if s > 0 and rows.shape[0] < per:
+                pad = jnp.repeat(rows[-1:], per - rows.shape[0], axis=0)
+                rows = jnp.concatenate([rows, pad], axis=0)
             decode = self._decode_u8_fn(width, height, rows.shape[0])
             with trace.STATS.timer("vae_decode_dispatch"):
                 imgs = decode(self.params["vae"], rows)

@@ -121,16 +121,35 @@ def _axis_label(axis: str, value: Any) -> str:
     return f"{name}: {value}"
 
 
+#: positional script_args order (webui-style flat list)
+_POSITIONAL_KEYS = ("x_axis", "x_values", "y_axis", "y_values",
+                    "z_axis", "z_values")
+
+
 def _extract_options(payload: GenerationPayload) -> Dict[str, str]:
-    """Accept the dict-argument form (script_args=[{...}]) or fields set
-    directly on the payload (extra=allow)."""
+    """Accept the dict-argument form (script_args=[{...}]), a positional
+    list of [x_axis, x_values, y_axis, ...] STRINGS (axis names, not
+    webui's internal dropdown indices — those index an install-specific
+    AxisOption list and cannot be resolved faithfully here), or fields set
+    directly on the payload (extra=allow). A list mixing in non-string
+    entries is rejected loudly rather than mis-aligned silently."""
     opts: Dict[str, str] = {}
+    positional: List[str] = []
     for a in payload.script_args or []:
         if isinstance(a, dict):
             opts.update({str(k).lower(): v for k, v in a.items()})
+        elif isinstance(a, str):
+            positional.append(a)
+        elif not opts:
+            raise ValueError(
+                "x/y/z plot: positional script_args must be axis-name/value "
+                f"strings, got {type(a).__name__} {a!r} (webui dropdown "
+                "indices are install-specific and not supported — pass "
+                "names, e.g. ['Steps', '10,20'])")
+    if not opts and positional:
+        opts.update(dict(zip(_POSITIONAL_KEYS, positional)))
     extra = getattr(payload, "model_extra", None) or {}
-    for key in ("x_axis", "x_values", "y_axis", "y_values",
-                "z_axis", "z_values"):
+    for key in _POSITIONAL_KEYS:
         if key in extra and key not in opts:
             opts[key] = extra[key]
     return opts
@@ -157,6 +176,11 @@ def run_xyz(
     stop launching cells; completed cells still come back as a partial
     grid (webui returns what it has)."""
     opts = _extract_options(payload)
+    if payload.script_args and not opts:
+        raise ValueError(
+            "x/y/z plot: script_args contained no usable axis options "
+            "(pass a dict {'x_axis': ..., 'x_values': ...} or a positional "
+            "[x_axis, x_values, y_axis, ...] string list)")
 
     axes: List[str] = []
     values: List[List[Any]] = []
@@ -218,6 +242,10 @@ def run_xyz(
                 if state.flag.interrupted:
                     stopped = True
                     break
+            # an interrupt mid-row leaves it short — pad to full width so
+            # _draw_grid's row concat stays rectangular (blank cells render
+            # via the ""->blank path); webui likewise returns what it has
+            row.extend([""] * (len(xs) - len(row)))
             rows.append(row)
             if stopped:
                 break
@@ -235,6 +263,11 @@ def run_xyz(
             out.negative_prompts.extend(res.negative_prompts)
             out.infotexts.extend(res.infotexts)
             out.worker_labels.extend(res.worker_labels)
+        if stopped:
+            # stop the z loop too: every cell's execute() clears the latch
+            # at its own request scope, so letting another slice start
+            # would run a full row before noticing the interrupt again
+            break
 
     # grids go FIRST in the gallery (webui order); one per z value
     first_info = out.infotexts[0] if out.infotexts else ""

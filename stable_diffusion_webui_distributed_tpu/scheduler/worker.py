@@ -542,6 +542,11 @@ class HTTPBackend:
         scheme = "https" if self.tls else "http"
         return f"{scheme}://{self.address}:{self.port}/sdapi/v1/{route}"
 
+    def close(self) -> None:
+        """Release pooled connections (called when a backend is replaced by
+        an endpoint edit, or a transient validation probe is done)."""
+        self.session.close()
+
     def generate(self, payload: GenerationPayload, start_index: int,
                  count: int) -> GenerationResult:
         body = payload.model_dump()

@@ -607,6 +607,97 @@ class World:
         self.save_config()
         return node
 
+    @staticmethod
+    def _merged_endpoint(old, address, port, tls, user, password):
+        """Current backend + pending field edits -> (address, port, tls,
+        user, password). None keeps the stored value; empty strings clear
+        credentials. ONE place owns this merge: the edit itself and the
+        pre-edit validation probe must target the same endpoint."""
+        new_address = address if address is not None else old.address
+        if not new_address:
+            raise ValueError("address required")
+        return (new_address,
+                int(port) if port is not None else old.port,
+                bool(tls) if tls is not None else old.tls,
+                (user if user is not None else old.user) or None,
+                (password if password is not None else old.password) or None)
+
+    def _remote_backend_of(self, label: str):
+        """The worker's HTTPBackend, or raise/None per CRUD conventions."""
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        w = self.get_worker(label)
+        if w is None:
+            return None, None
+        if w.master:
+            raise ValueError("master has no remote endpoint to edit")
+        if not isinstance(w.backend, HTTPBackend):
+            raise ValueError(f"worker '{label}' is not an HTTP remote")
+        return w, w.backend
+
+    def candidate_backend(self, label: str, *, address=None, port=None,
+                          tls=None, user=None, password=None):
+        """A TRANSIENT HTTPBackend for the endpoint these pending edits
+        would produce — used to validate (e.g. probe /sd-models) before
+        the edit is applied. Caller must ``close()`` it. Returns None for
+        an unknown label."""
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        w, old = self._remote_backend_of(label)
+        if w is None:
+            return None
+        a, p, t, u, pw = self._merged_endpoint(old, address, port, tls,
+                                               user, password)
+        return HTTPBackend(a, p, tls=t, user=u, password=pw,
+                           verify_tls=self.verify_tls)
+
+    def update_worker_endpoint(self, label: str, *, address=None, port=None,
+                               tls=None, user=None, password=None) -> bool:
+        """In-place edit of a remote worker's address/port/tls/credentials
+        (the reference's save-worker flow, ui.py:100-159, which updates a
+        registered worker without re-adding it). Unspecified (None) fields
+        keep their current values; empty strings clear credentials. On a
+        real change the backend is rebuilt — a different endpoint is a
+        different process, so cached sync state (loaded model/VAE, script
+        support, memory) is forgotten and an UNAVAILABLE node gets a fresh
+        chance; an ADDRESS change additionally resets speed calibration
+        (the old machine's benchmark means nothing on the new one).
+        Returns False for an unknown label; raises on the master."""
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            HTTPBackend,
+        )
+
+        w, old = self._remote_backend_of(label)
+        if w is None:
+            return False
+        merged = self._merged_endpoint(old, address, port, tls, user,
+                                       password)
+        if merged == (old.address, old.port, old.tls, old.user,
+                      old.password):
+            # no-op edit (the panel form re-sends unchanged fields): keep
+            # the live backend, its sync caches, and the worker's state —
+            # a rebuild would force a needless checkpoint re-sync and
+            # revive a genuinely-down node
+            return True
+        a, p, t, u, pw = merged
+        w.backend = HTTPBackend(a, p, tls=t, user=u, password=pw,
+                                verify_tls=self.verify_tls)
+        old.close()
+        w.loaded_model = None
+        w.loaded_vae = None
+        w.supported_scripts = None
+        w.free_memory = None
+        if a != old.address:
+            w.cal = type(w.cal)()  # fresh machine: re-benchmark from zero
+        if w.state == State.UNAVAILABLE:
+            w.set_state(State.IDLE)
+        self.save_config()
+        return True
+
     def remove_worker(self, label: str) -> bool:
         """Drop a non-master worker from the registry and the persisted
         config (reference Worker Config "Remove" flow, ui.py:173-186).

@@ -371,17 +371,7 @@ class ApiServer:
         workers = []
         if hasattr(self.source, "workers"):
             for w in self.source.workers:
-                workers.append({
-                    "label": w.label,
-                    "state": w.state.name,
-                    "avg_ipm": w.cal.avg_ipm,
-                    "master": w.master,
-                    # control-surface fields: the panel renders its worker
-                    # table (and edit affordances) from this one response
-                    "pixel_cap": w.pixel_cap,
-                    "model_override": w.model_override,
-                    "disabled": w.state.name == "DISABLED",
-                })
+                workers.append(_worker_dict(w))
         p = self.state.progress
         settings = None
         if hasattr(self.source, "job_timeout"):
@@ -466,15 +456,7 @@ class ApiServer:
         ui.py:90-214)."""
         if not hasattr(self.source, "workers"):
             return []
-        return [{
-            "label": w.label,
-            "state": w.state.name,
-            "master": w.master,
-            "avg_ipm": w.cal.avg_ipm,
-            "pixel_cap": w.pixel_cap,
-            "model_override": w.model_override,
-            "disabled": w.state.name == "DISABLED",
-        } for w in self.source.workers]
+        return [_worker_dict(w) for w in self.source.workers]
 
     def handle_workers_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Worker CRUD (reference Worker Config tab, ui.py:90-214):
@@ -512,15 +494,91 @@ class ApiServer:
             return {"removed": label}
         if action != "update":
             raise ApiError(422, f"unknown action '{action}'")
+        # in-place endpoint edit (reference save_worker_btn, ui.py:100-159)
+        endpoint = {k: body[k] for k in
+                    ("address", "port", "tls", "user", "password")
+                    if k in body}
         kwargs = {}
         for key in ("model_override", "pixel_cap", "disabled"):
             if key in body:
                 kwargs[key] = body[key]
-        with self._busy:
-            ok = self.source.configure_worker(label, **kwargs)
-        if not ok:
-            raise ApiError(404, f"no worker '{label}'")
-        return {"updated": label, **kwargs}
+        # validation BEFORE any mutation so a 422 cannot leave the edit
+        # half-applied (a changed endpoint with a rejected pin); with
+        # endpoint fields in flight, validate against the CANDIDATE
+        # endpoint — that is where the pinned model must exist
+        if kwargs.get("model_override"):
+            self._validate_model_pin(label, kwargs["model_override"],
+                                     endpoint or None)
+        if endpoint and hasattr(self.source, "update_worker_endpoint"):
+            try:
+                with self._busy:
+                    ok = self.source.update_worker_endpoint(label, **endpoint)
+            except (ValueError, TypeError) as e:
+                raise ApiError(422, str(e))
+            if not ok:
+                raise ApiError(404, f"no worker '{label}'")
+        if kwargs or not endpoint:
+            with self._busy:
+                ok = self.source.configure_worker(label, **kwargs)
+            if not ok:
+                raise ApiError(404, f"no worker '{label}'")
+        # password is write-only everywhere (_worker_dict): never echo it
+        endpoint.pop("password", None)
+        return {"updated": label, **endpoint, **kwargs}
+
+    def _validate_model_pin(self, label: str, pin: str,
+                            endpoint: Optional[Dict[str, Any]] = None) -> None:
+        """Reject a checkpoint pin the worker does not actually serve (the
+        reference feeds its override dropdown from the remote's /sd-models,
+        ui.py:161-171 + worker.py:623-645 — free text would only fail at
+        the next load_options). ``endpoint``: pending endpoint-field edits;
+        the probe then targets the merged candidate endpoint instead of the
+        current backend. An unreachable worker or an empty model list skips
+        validation: better to accept the pin than to block config on a node
+        that is momentarily down."""
+        w = None
+        for cand in getattr(self.source, "workers", []):
+            if cand.label == label:
+                w = cand
+                break
+        if w is None:
+            return
+        backend, transient = w.backend, None
+        if endpoint and hasattr(self.source, "candidate_backend"):
+            try:
+                # the World owns the field-merge (same one the edit itself
+                # applies), so validation probes exactly the endpoint that
+                # would be saved
+                transient = self.source.candidate_backend(label, **endpoint)
+            except (ValueError, TypeError):
+                return  # malformed fields fail in update_worker_endpoint
+            if transient is not None:
+                backend = transient
+        try:
+            models = backend.available_models()
+        except Exception:  # noqa: BLE001 — node down; accept unvalidated
+            return
+        finally:
+            if transient is not None:
+                transient.close()
+        if models and pin not in models:
+            raise ApiError(
+                422, f"worker '{label}' does not serve model '{pin}' "
+                f"(available: {', '.join(models[:20])})")
+
+    def handle_worker_models(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Model list of ONE worker's backend — feeds the panel's checkpoint
+        pin dropdown (the reference populates its override dropdown from
+        the remote's /sd-models the same way, ui.py:161-171)."""
+        label = body.get("label", "")
+        for w in getattr(self.source, "workers", []):
+            if w.label == label:
+                try:
+                    return {"label": label,
+                            "models": w.backend.available_models()}
+                except Exception as e:  # noqa: BLE001 — node down
+                    return {"label": label, "models": [], "error": str(e)}
+        raise ApiError(404, f"no worker '{label}'")
 
     def handle_benchmark(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Kick a fleet benchmark sweep in the background (the reference's
@@ -565,6 +623,7 @@ class ApiServer:
             ("POST", "/internal/benchmark"): self.handle_benchmark,
             ("GET", "/internal/workers"): self.handle_workers_get,
             ("POST", "/internal/workers"): self.handle_workers_post,
+            ("POST", "/internal/worker-models"): self.handle_worker_models,
             ("POST", "/sdapi/v1/txt2img"): self.handle_txt2img,
             ("POST", "/sdapi/v1/img2img"): self.handle_img2img,
             ("GET", "/sdapi/v1/options"): self.handle_options_get,
@@ -705,6 +764,28 @@ class ApiError(Exception):
         super().__init__(detail)
         self.status = status
         self.detail = detail
+
+
+def _worker_dict(w) -> Dict[str, Any]:
+    """One worker's control-surface row: state/speed plus the editable
+    fields the panel prefills (endpoint fields only for HTTP remotes;
+    password is write-only and never serialized back out)."""
+    d = {
+        "label": w.label,
+        "state": w.state.name,
+        "avg_ipm": w.cal.avg_ipm,
+        "master": w.master,
+        "pixel_cap": w.pixel_cap,
+        "model_override": w.model_override,
+        "disabled": w.state.name == "DISABLED",
+    }
+    backend = w.backend
+    if hasattr(backend, "address"):
+        d["address"] = backend.address
+        d["port"] = backend.port
+        d["tls"] = getattr(backend, "tls", False)
+        d["user"] = getattr(backend, "user", None) or ""
+    return d
 
 
 def _vae_for_sync(vae: str) -> str:

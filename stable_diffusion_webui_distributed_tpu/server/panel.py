@@ -4,11 +4,15 @@ Settings tabs, reborn as one dependency-free HTML page.
 Parity targets (reference ui.py:26-404 + javascript/distributed.js):
 - live worker table with states/speeds + per-worker controls — checkpoint
   pin (model_override), pixel cap, enable/disable (ui.py:90-214);
+- in-place edit of a registered worker's address/port/tls/credentials
+  (the reference's save_worker_btn, ui.py:100-159) with the checkpoint
+  pin as a dropdown fed by that worker's /sd-models (ui.py:161-171);
 - fleet buttons: interrupt all (ui.py:271-272), restart all workers with
   the confirm dialog the reference keeps client-side (ui.py:274-280,
   distributed.js:2-4), re-benchmark, reset MPE (ui.py:282-287);
 - runtime settings: job timeout, complement production, step scaling,
   thin-client (ui.py:26-55) via POST /sdapi/v1/options;
+- a Help section (the reference's Help tab);
 - the 16-line log ring, generation progress, stage timings, and the
   1.5 s auto-refresh cadence (distributed.js:7-23).
 """
@@ -69,6 +73,20 @@ PANEL_HTML = """<!doctype html>
   <label>password <input type="password" id="aw_password" size="8"></label>
   <button type="submit">add worker</button>
 </form>
+<h2>edit worker</h2>
+<form id="editworker" onsubmit="return saveWorker()">
+  <label>worker <select id="ew_label"
+    onchange="fillEditForm()"></select></label>
+  <label>address <input id="ew_address" size="14"></label>
+  <label>port <input type="number" id="ew_port"></label>
+  <label><input type="checkbox" id="ew_tls"> tls</label>
+  <label>user <input id="ew_user" size="8"></label>
+  <label>password <input type="password" id="ew_password" size="8"
+    placeholder="(unchanged)"></label>
+  <label>model pin <select id="ew_pin"></select></label>
+  <label>pixel cap <input type="number" id="ew_cap" min="0"></label>
+  <button type="submit">save worker</button>
+</form>
 <h2>settings</h2>
 <form id="settings" onsubmit="return saveSettings()">
   <label>job timeout (s)
@@ -84,12 +102,53 @@ PANEL_HTML = """<!doctype html>
 </tr></thead><tbody id="timings"></tbody></table>
 <h2>log</h2>
 <div id="logs"></div>
+<details id="help"><summary>help</summary>
+<p><b>Workers.</b> The fleet is a master (this process, generating
+locally on its TPU mesh) plus any number of remote sdapi-v1 nodes —
+other instances of this framework or legacy sdwui servers. States:
+<span class="IDLE">IDLE</span> (schedulable),
+<span class="WORKING">WORKING</span> (request in flight),
+<span class="UNAVAILABLE">UNAVAILABLE</span> (failed a request or ping;
+revived automatically by the next successful ping),
+<span class="DISABLED">DISABLED</span> (operator-excluded). The speed
+column is the measured benchmark average (images/minute); re-run it with
+<i>re-benchmark</i> after hardware changes.</p>
+<p><b>Per-worker controls.</b> <i>model pin</i> holds a worker on one
+checkpoint regardless of fleet-wide model syncs (validated against the
+models that worker actually serves); <i>pixel cap</i> bounds
+width&times;height&times;batch per job (0 = uncapped); <i>disable</i>
+keeps the worker registered but unscheduled. Edit a registered worker's
+address/port/tls/credentials in the <i>edit worker</i> form — leave the
+password blank to keep the stored one.</p>
+<p><b>Settings.</b> <i>job timeout</i>: seconds a worker may lag behind
+the fastest before it is dropped from a request (quicker fleets want it
+small); <i>complementary production</i>: idle workers render bonus
+images beyond the requested batch; <i>step scaling</i>: slower workers
+run fewer steps instead of fewer images; <i>thin client</i>: the master
+only orchestrates and renders nothing locally.</p>
+<p><b>Interrupts.</b> <i>interrupt all</i> aborts the in-flight
+generation everywhere (mid-denoise on the master, via /interrupt on
+remotes). There is no pending-request queue to clear: requests are
+executed synchronously, so interrupting the current one empties the
+node (the reference's debug clear-queue button has no equivalent state
+here).</p>
+<p><b>reset MPE</b> clears every worker's ETA error history — use it
+after driver or hardware changes that invalidate old calibration.
+<b>run sync script</b> executes the operator's <code>sync*</code> hook
+from the config dir's <code>user/</code> folder (e.g. rsync models to
+workers).</p>
+</details>
 <script>
 async function post(url, body) {
   try {
-    await fetch(url, {method: 'POST',
+    const r = await fetch(url, {method: 'POST',
       headers: {'Content-Type': 'application/json'},
       body: JSON.stringify(body)});
+    if (!r.ok) {  // surface validation errors (e.g. a rejected model pin)
+      let msg = 'error ' + r.status;
+      try { msg = (await r.json()).detail || msg; } catch (e) {}
+      alert(msg);
+    }
   } catch (e) { /* server restarting */ }
   tick();
 }
@@ -139,6 +198,79 @@ function addWorker() {
   });
   return false;
 }
+// edit-worker form: select a worker, prefill its endpoint fields, fetch
+// its model list for the pin dropdown (reference ui.py:100-171)
+function refreshEditSelect() {
+  const sel = document.getElementById('ew_label');
+  const cur = sel.value;
+  const labels = workerRows.map(w => w.label);
+  if (labels.join('\\u0000') === sel.dataset.labels) return;
+  sel.dataset.labels = labels.join('\\u0000');
+  sel.innerHTML = workerRows.map(w => {
+    const o = document.createElement('option');
+    o.value = o.textContent = w.label;
+    return o.outerHTML;
+  }).join('');
+  sel.value = labels.includes(cur) ? cur : (labels[0] || '');
+  if (sel.value) fillEditForm();
+}
+async function fillEditForm() {
+  const w = workerRows.find(x => x.label ===
+    document.getElementById('ew_label').value);
+  if (!w) return;
+  const remote = !w.master && w.address !== undefined;
+  for (const f of ['address', 'port', 'user'])
+    document.getElementById('ew_' + f).value = remote ? (w[f] ?? '') : '';
+  for (const f of ['address', 'port', 'tls', 'user', 'password'])
+    document.getElementById('ew_' + f).disabled = !remote;
+  document.getElementById('ew_tls').checked = remote && !!w.tls;
+  document.getElementById('ew_password').value = '';
+  document.getElementById('ew_cap').value = w.pixel_cap || 0;
+  const pin = document.getElementById('ew_pin');
+  pin.innerHTML = '<option value="">(follow fleet)</option>';
+  if (w.model_override) addPinOption(pin, w.model_override);
+  pin.value = w.model_override || '';
+  try {
+    const r = await fetch('/internal/worker-models', {method: 'POST',
+      headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({label: w.label})});
+    const models = (await r.json()).models || [];
+    // the operator may have switched workers while the fetch was in
+    // flight — never populate another worker's dropdown
+    if (document.getElementById('ew_label').value !== w.label) return;
+    for (const m of models) addPinOption(pin, m);
+  } catch (e) { /* node down: keep current pin only */ }
+}
+function addPinOption(sel, name) {
+  if ([...sel.options].some(o => o.value === name)) return;
+  const o = document.createElement('option');
+  o.value = o.textContent = name;
+  sel.appendChild(o);
+}
+async function saveWorker() {
+  const label = document.getElementById('ew_label').value;
+  const w = workerRows.find(x => x.label === label);
+  if (!w) return false;
+  const body = {label: label,
+    model_override: document.getElementById('ew_pin').value,
+    pixel_cap: parseInt(document.getElementById('ew_cap').value) || 0};
+  if (!w.master && w.address !== undefined) {
+    body.address = document.getElementById('ew_address').value;
+    body.port = parseInt(document.getElementById('ew_port').value) || w.port;
+    body.tls = document.getElementById('ew_tls').checked;
+    body.user = document.getElementById('ew_user').value;
+    const pw = document.getElementById('ew_password').value;
+    if (pw) body.password = pw;  // blank = keep stored password
+  }
+  try {
+    const r = await fetch('/internal/workers', {method: 'POST',
+      headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify(body)});
+    if (!r.ok) alert((await r.json()).detail || 'save failed');
+  } catch (e) { alert('save failed: ' + e); }
+  tick();
+  return false;
+}
 function saveSettings() {
   post('/sdapi/v1/options', {
     job_timeout: parseInt(document.getElementById('job_timeout').value),
@@ -168,6 +300,7 @@ async function tick() {
       ).join('');
     document.getElementById('logs').textContent = s.logs.join('\\n');
     workerRows = s.workers;  // one status fetch carries the worker table
+    refreshEditSelect();
     document.getElementById('workers').innerHTML = workerRows.map((w, i) =>
       `<tr><td>${esc(w.label)}</td>` +
       `<td class="${esc(w.state)}">${esc(w.state)}</td>` +

@@ -48,6 +48,24 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import pytest  # noqa: E402
 
+#: modules whose tests compile real (tiny) model pipelines — minutes of XLA
+#: CPU compile time each. Everything else forms the `-m fast` tier (~2 min:
+#: scheduler, config/runtime, server, samplers, xyz, cli, native, prompt).
+_SLOW_MODULES = {
+    "test_pipeline", "test_adapters", "test_inpaint_model",
+    "test_embeddings", "test_registry", "test_esrgan", "test_goldens",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite: `pytest -m fast` for the iteration loop, `-m slow`
+    for the compiled-pipeline tests (see README "Running the tests")."""
+    for item in items:
+        module = item.nodeid.split("/")[-1].split(".py")[0]
+        slow = module in _SLOW_MODULES \
+            or item.get_closest_marker("slow") is not None
+        item.add_marker(pytest.mark.slow if slow else pytest.mark.fast)
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -122,9 +122,13 @@ class TestConfig:
         assert cfg.enabled_i2i is True  # reference pmodels.py:44
 
 
+@pytest.mark.slow
 class TestRng:
     """The seed contract: image i depends only on (seed + i) — the reference's
-    seed-offset fan-out (distributed.py:297-305) reproduced exactly."""
+    seed-offset fan-out (distributed.py:297-305) reproduced exactly.
+
+    (marked slow: the sub-batch/seed-resize cases jit real noise pipelines,
+    ~30 s of the module's wall time)"""
 
     def test_subbatch_equals_full_batch(self):
         shape = (4, 8, 8)

@@ -111,6 +111,89 @@ class TestSamplerMath:
         assert spec.algorithm == "euler_a"  # reference worker.py:457-467
 
 
+class TestDpmAdaptive:
+    """The host-side PID loop (kd.sample_dpm_adaptive): k-diffusion's
+    adaptive controller over the compiled embedded order-2/3 pair."""
+
+    def _attempt(self, denoise):
+        return jax.jit(kd.make_adaptive_attempt(denoise))
+
+    def test_exact_on_point_denoiser(self):
+        # denoised == const: the exponential integrator is exact, every
+        # attempt is accepted, and x lands on the analytic solution
+        # x(sigma) = x0 + (x_start - x0) * sigma/sigma_start.
+        x0 = 2.5
+
+        def denoise(x, sigma, step):
+            return jnp.full_like(x, x0)
+
+        smax, smin = float(SCHEDULE.sigma_max), float(SCHEDULE.sigma_min)
+        x = jnp.full((2, 4, 4, 1), x0 + smax)  # offset = sigma_max
+        out, info = kd.sample_dpm_adaptive(self._attempt(denoise), x,
+                                           smax, smin)
+        exact = x0 + smin  # offset decays proportionally to sigma
+        np.testing.assert_allclose(np.asarray(out), exact, rtol=1e-3,
+                                   atol=1e-3)
+        assert info["n_reject"] == 0
+        assert info["nfe"] == 3 * info["steps"]
+        # the PID grows h on exact solves: far fewer steps than a dense
+        # fixed ladder would need to cross ~6 decades of sigma
+        assert info["n_accept"] < 200
+
+    def test_tracks_analytic_ode_tightly(self):
+        # same ODE family as test_order_of_accuracy_on_analytic_ode
+        k = 0.7
+
+        def denoise(x, sigma, step):
+            return x * k
+
+        smax, smin = float(SCHEDULE.sigma_max), 0.1
+        x = jnp.full((1, 2, 2, 1), smax)
+        out, info = kd.sample_dpm_adaptive(self._attempt(denoise), x,
+                                           smax, smin)
+        exact = smax * (smin / smax) ** (1 - k)
+        got = float(np.asarray(out).mean())
+        assert abs(got - exact) / exact < 0.05, (got, exact, info)
+        # tightening rtol/atol must tighten the result (the controller
+        # actually controls): an order tighter tolerance, ~2x+ less error
+        out2, info2 = kd.sample_dpm_adaptive(
+            self._attempt(denoise), x, smax, smin, rtol=0.005, atol=8e-4)
+        got2 = float(np.asarray(out2).mean())
+        assert abs(got2 - exact) < abs(got - exact) / 2, (got, got2, info2)
+        assert info2["n_accept"] > info["n_accept"]
+
+    def test_interrupt_stops_between_attempts(self):
+        def denoise(x, sigma, step):
+            return jnp.zeros_like(x)
+
+        calls = []
+        x = jnp.full((1, 2, 2, 1), 10.0)
+        out, info = kd.sample_dpm_adaptive(
+            self._attempt(denoise), x, 10.0, 0.1,
+            should_stop=lambda: len(calls) >= 2 or calls.append(None))
+        assert info["steps"] == 2  # stopped after two attempts
+
+    def test_on_accept_transforms_every_accepted_step(self):
+        def denoise(x, sigma, step):
+            return jnp.zeros_like(x)
+
+        seen = []
+
+        def on_accept(x, sigma, n):
+            seen.append((n, sigma))
+            return x
+
+        _, info = kd.sample_dpm_adaptive(
+            self._attempt(denoise), jnp.full((1, 2, 2, 1), 10.0),
+            10.0, 0.5, on_accept=on_accept)
+        assert [n for n, _ in seen] == list(range(1, info["n_accept"] + 1))
+        assert all(s2 < s1 for (_, s1), (_, s2) in zip(seen, seen[1:]))
+
+    def test_spec_is_marked_adaptive(self):
+        assert kd.resolve_sampler("DPM adaptive").adaptive
+        assert not kd.resolve_sampler("Euler a").adaptive
+
+
 class TestShardingContract:
     """Ancestral noise must depend only on the image's key — never on batch
     position — so sub-batches reproduce the full batch exactly."""

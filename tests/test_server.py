@@ -118,16 +118,23 @@ class TestRoutes:
             rows = call(server, "/internal/workers")
             by_label = {r["label"]: r for r in rows}
             assert by_label["r1"]["model_override"] is None
-            # write surface: pin + cap round-trip
+            # write surface: pin + cap round-trip (the pin is validated
+            # against the worker's actual model list, ui.py:161-171)
             out = call(server, "/internal/workers",
-                       {"label": "r1", "model_override": "pinned-v1",
+                       {"label": "r1", "model_override": "stub-model",
                         "pixel_cap": 123456})
             assert out["updated"] == "r1"
-            assert extra.model_override == "pinned-v1"
+            assert extra.model_override == "stub-model"
             assert extra.pixel_cap == 123456
             rows = call(server, "/internal/workers")
             by_label = {r["label"]: r for r in rows}
-            assert by_label["r1"]["model_override"] == "pinned-v1"
+            assert by_label["r1"]["model_override"] == "stub-model"
+            # a pin the worker does not serve -> 422, nothing changed
+            with pytest.raises(urllib.error.HTTPError) as e:
+                call(server, "/internal/workers",
+                     {"label": "r1", "model_override": "typo-model"})
+            assert e.value.code == 422
+            assert extra.model_override == "stub-model"
             # unknown label -> 404
             with pytest.raises(urllib.error.HTTPError) as e:
                 call(server, "/internal/workers", {"label": "ghost",
@@ -135,6 +142,49 @@ class TestRoutes:
             assert e.value.code == 404
         finally:
             world.workers.remove(extra)
+
+    def test_worker_models_route(self, server):
+        world = server.source
+        extra = WorkerNode("rm", StubBackend(), avg_ipm=5.0)
+        world.add_worker(extra)
+        try:
+            out = call(server, "/internal/worker-models", {"label": "rm"})
+            assert out["models"] == ["stub-model"]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                call(server, "/internal/worker-models", {"label": "ghost"})
+            assert e.value.code == 404
+        finally:
+            world.workers.remove(extra)
+
+    def test_worker_endpoint_edit_route(self, server):
+        """In-place address/port/credential edit (reference save_worker_btn,
+        ui.py:100-159) through POST /internal/workers."""
+        world = server.source
+        out = call(server, "/internal/workers",
+                   {"action": "add", "label": "ed", "address": "h1",
+                    "port": 7861, "user": "u1", "password": "p1"})
+        assert out["added"] == "ed"
+        try:
+            w = world.get_worker("ed")
+            out = call(server, "/internal/workers",
+                       {"label": "ed", "address": "h2", "port": 7999,
+                        "tls": True, "user": "u2"})
+            assert out["updated"] == "ed"
+            assert w.backend.address == "h2"
+            assert w.backend.port == 7999
+            assert w.backend.tls is True
+            assert w.backend.user == "u2"
+            assert w.backend.password == "p1"  # omitted field is kept
+            # cached sync state forgotten: new endpoint = new process
+            assert w.loaded_model is None and w.supported_scripts is None
+            # editing the master's endpoint -> 422
+            with pytest.raises(urllib.error.HTTPError) as e:
+                call(server, "/internal/workers",
+                     {"label": "m", "address": "h3"})
+            assert e.value.code == 422
+        finally:
+            call(server, "/internal/workers",
+                 {"action": "remove", "label": "ed"})
 
     def test_embeddings_route_tolerates_broken_file(self, tmp_path):
         from safetensors.numpy import save_file

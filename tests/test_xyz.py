@@ -123,6 +123,87 @@ class TestRun:
         assert len(log) == 4
         assert len(out.images) == 6  # 2 grids + 4 cells
 
+    def test_positional_script_args(self):
+        """webui-style flat [x_axis, x_values, y_axis, ...] string list."""
+        log = []
+        p = GenerationPayload(
+            prompt="x", seed=1, script_name="x/y/z plot",
+            script_args=["Steps", "10,20", "CFG Scale", "5,7"])
+        out = xyz.run_xyz(p, _stub_execute(log))
+        assert len(log) == 4
+        assert sorted({c.steps for c in log}) == [10, 20]
+        assert sorted({c.cfg_scale for c in log}) == [5.0, 7.0]
+        assert len(out.images) == 5  # grid + 4 cells
+
+    def test_unusable_script_args_rejected(self):
+        # webui-style int dropdown indices: rejected loudly (they index an
+        # install-specific AxisOption list), never silently mis-aligned
+        p = GenerationPayload(
+            prompt="x", script_name="x/y/z plot", script_args=[3, 7])
+        with pytest.raises(ValueError, match="axis-name/value strings"):
+            xyz.run_xyz(p, _stub_execute([]))
+        # empty dicts: parsed but yield nothing usable -> still a 422-class
+        # error, not a silent single-cell "nothing" plot
+        p2 = GenerationPayload(
+            prompt="x", script_name="x/y/z plot", script_args=[{}])
+        with pytest.raises(ValueError, match="no usable axis options"):
+            xyz.run_xyz(p2, _stub_execute([]))
+
+    def test_interrupt_mid_row_returns_partial_grid(self):
+        """Interrupting after >=1 full row must still assemble a grid
+        (ragged rows used to crash _draw_grid's concatenate)."""
+        from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+            GenerationState,
+        )
+
+        state = GenerationState()
+        log = []
+        inner = _stub_execute(log)
+
+        def execute(p):
+            res = inner(p)
+            if len(log) == 3:  # interrupt mid-second-row of a 2x3 grid
+                state.flag.interrupt()
+            return res
+
+        p = GenerationPayload(
+            prompt="x", seed=1, script_name="x/y/z plot",
+            script_args=[{"x_axis": "Steps", "x_values": "10,20",
+                          "y_axis": "CFG Scale", "y_values": "5,7,9"}])
+        out = xyz.run_xyz(p, execute, state=state)
+        assert len(log) == 3  # stopped launching cells
+        # grid first, then the 3 completed cells
+        assert len(out.images) == 4
+        grid = b64png_to_array(out.images[0])
+        assert grid.shape[0] >= 16 and grid.shape[1] >= 16
+
+    def test_interrupt_stops_remaining_z_slices(self):
+        """The z loop must stop too: each cell's execute() clears the latch
+        at its own request scope, so a surviving z loop would run a full
+        row per remaining slice after the interrupt."""
+        from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+            GenerationState,
+        )
+
+        state = GenerationState()
+        log = []
+        inner = _stub_execute(log)
+
+        def execute(p):
+            state.flag.clear()  # like World.execute/begin_request
+            res = inner(p)
+            if len(log) == 1:
+                state.flag.interrupt()
+            return res
+
+        p = GenerationPayload(
+            prompt="x", seed=1, script_name="x/y/z plot",
+            script_args=[{"x_axis": "Steps", "x_values": "10,20",
+                          "z_axis": "CFG Scale", "z_values": "5,7,9"}])
+        out = xyz.run_xyz(p, execute, state=state)
+        assert len(log) == 1  # nothing launched after the interrupt
+        assert len(out.images) == 2  # slice-0 partial grid + its one cell
+
     def test_cells_are_full_requests_not_mutations(self):
         """The base payload must not leak mutations between cells."""
         log = []

@@ -17,6 +17,11 @@ Cells are named, e.g. ``c1-bf16``, ``c1-chunk10``, ``c1-flash``,
 a running cell is never killed externally (a SIGTERM mid-XLA-compile
 wedges the pool-side chip claim — PERF.md "relay lessons"); each child
 relies on bench's own init watchdog instead.
+
+Wedge circuit-breaker: if a child exits rc=3 (init watchdog) or dies with
+a relay transport error, the sweep STOPS — every further probe extends
+the pool-side wedge (round-3 postmortem: two post-wedge probes kept the
+claim wedged straight into the driver's end-of-round bench window).
 """
 
 from __future__ import annotations
@@ -67,6 +72,21 @@ DEFAULT_ORDER = [
 
 #: sentinel line prefix the child prints its result row behind
 _ROW_MARK = "SWEEP_ROW:"
+
+#: error substrings that mean the relay/chip claim is gone — not a
+#: per-cell failure. Probing again extends the wedge; stop the sweep.
+_WEDGE_SIGNALS = (
+    "Connection refused", "connection refused", "Socket closed",
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "failed to connect",
+    "relay wedged",
+)
+
+
+def _is_wedge(row, returncode):
+    if returncode == 3:  # bench init watchdog fired
+        return True
+    err = row.get("error", "") if row else ""
+    return any(sig in err for sig in _WEDGE_SIGNALS)
 
 
 def run_cell(name):
@@ -123,6 +143,9 @@ def main():
     if unknown:
         raise SystemExit(f"unknown cells {unknown}; --list to see all")
 
+    # a wedged claim should fail one cell fast and trip the circuit
+    # breaker, not burn bench's full 480 s default per cell
+    os.environ.setdefault("SDTPU_BENCH_INIT_TIMEOUT", "240")
     deadline = time.time() + float(
         os.environ.get("SDTPU_SWEEP_DEADLINE", "3300"))
     out_path = os.path.join(_REPO, "PERF_SWEEP.jsonl")
@@ -149,6 +172,13 @@ def main():
         with open(out_path, "a") as f:
             f.write(json.dumps(row) + "\n")
         print(f"sweep: {json.dumps(row)[:500]}", file=sys.stderr, flush=True)
+        if _is_wedge(row, proc.returncode):
+            print("sweep: CIRCUIT BREAKER: relay/chip-claim wedge detected "
+                  f"(rc={proc.returncode}) — stopping the sweep; further "
+                  "probes would extend the wedge (PERF.md relay lessons). "
+                  "Cool down >=15 min before the next chip touch.",
+                  file=sys.stderr, flush=True)
+            break
 
 
 if __name__ == "__main__":
