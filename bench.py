@@ -84,15 +84,69 @@ def _start_init_watchdog(timeout=None):
     return done
 
 
+def _relay_triage():
+    """Socket-level relay diagnosis (tools/tpu_claim_probe.py): distinguishes
+    relay-down / relay-dead (TCP accept + instant EOF: tunnel up, service
+    behind it gone — the round-5 wedge) / alive, in ~3 s, without touching
+    jax or any pool-side claim. Returns (verdict, detail_json_str)."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import tpu_claim_probe
+
+        relay = tpu_claim_probe.triage_relay()
+        connected = [e for e in relay.values() if e.get("connect")]
+        if not connected:
+            verdict = "relay-down"
+        elif all(e.get("instant_eof") for e in connected):
+            verdict = "relay-dead"
+        else:
+            verdict = "alive"
+        return verdict, json.dumps(relay)
+    except Exception as e:  # noqa: BLE001 — triage is best-effort
+        return "triage-error", str(e)
+
+
 def _run_with_retry(argv):
     """Parent mode: run the real bench as a child process; if its backend
     init wedges (rc=3), cool down and retry ONCE with the remaining budget.
+
+    Before spending any of that budget, a ~3 s socket triage classifies the
+    relay (VERDICT r4 item 1b): relay-down and relay-dead abort immediately
+    with a precise message — a wedged tunnel does not clear within any
+    budget this run can afford (round-5 postmortem, PERF.md "round 5 chip
+    timeline"), so burning 480 s against it only eats the driver's timeout.
 
     The per-attempt watchdog only covers backend init — once the child's
     ``jax.devices()`` returns, its watchdog disarms and the child may
     legitimately run for many minutes (SDXL first-compile), so the parent
     never imposes a wall-clock kill (an external SIGTERM mid-XLA-compile is
     exactly what wedges the pool-side claim; PERF.md "relay lessons")."""
+    if not (os.environ.get("PALLAS_AXON_POOL_IPS")
+            or os.environ.get("AXON_LOOPBACK_RELAY")):
+        # no axon loopback relay in play (e.g. a standard TPU VM with local
+        # libtpu): the triage's hard-coded relay port means nothing there —
+        # skip straight to the normal probe flow
+        return _spawn_probes(argv)
+    verdict, detail = _relay_triage()
+    if verdict in ("relay-down", "relay-dead"):
+        print(f"bench: FATAL: TPU relay triage verdict={verdict} "
+              f"detail={detail} — "
+              + ("nothing is accepting TCP on the relay port; "
+                 if verdict == "relay-down" else
+                 "the relay tunnel accepts TCP but closes instantly (EOF), "
+                 "i.e. the service behind it is dead; ")
+              + "no chip claim can be granted this run. See PERF.md "
+              "'round 5 chip timeline' for the measured evidence chain.",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
+    print(f"bench: relay triage verdict={verdict} detail={detail}",
+          file=sys.stderr, flush=True)
+    return _spawn_probes(argv)
+
+
+def _spawn_probes(argv):
+    """The probe-twice-with-cooldown child loop (see _run_with_retry)."""
     import subprocess
 
     budget = float(os.environ.get("SDTPU_BENCH_INIT_TIMEOUT", "480"))
@@ -431,6 +485,20 @@ def run_config(n, tiny):
 
     metric, engine, payload, segments, rel_cost = _build_config(n, tiny)
     run = engine.img2img if payload.init_images else engine.txt2img
+
+    if os.environ.get("SDTPU_BENCH_PREWARM", "") == "1":
+        # compile-cache pre-warm: ONE warmup request in an expendable
+        # process so the big first compile (config #5's 2048² bucket killed
+        # the relay twice, PERF.md) lands in the persistent XLA cache; a
+        # fresh process then benches against warm caches (VERDICT r4
+        # item 3). Still prints exactly one JSON line.
+        t0 = time.time()
+        result = run(payload)
+        return {"metric": metric + "_prewarm", "value": None,
+                "unit": "images/min", "vs_baseline": None,
+                "prewarm_wall_s": round(time.time() - t0, 1),
+                "images": len(result.images), "config": n,
+                "device": dev.device_kind}
 
     samples = []
     for i in range(WARMUP_SAMPLES + RECORDED_SAMPLES):

@@ -97,6 +97,11 @@ def main() -> int:
 
     port = free_port()
     env = dict(os.environ)
+    # the worker node ALWAYS stays on CPU: with SDTPU_DEMO_PLATFORM=tpu the
+    # master holds the one chip claim, and an inherited claim env would
+    # deadlock the worker's interpreter against it at startup
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     worker = subprocess.Popen(
         [sys.executable, "-m", "stable_diffusion_webui_distributed_tpu.cli",

@@ -397,9 +397,11 @@ class Engine:
         (k-diffusion sample_dpm_adaptive semantics — the step slider only
         sizes the sigma ladder's endpoints; the controller picks the actual
         steps). Interrupt is polled between attempts, so latency is one
-        attempt (3 UNet evals). ControlNet guidance windows are honored
-        coarsely here: a unit is active for the whole trajectory (adaptive
-        stepping has no fixed step fractions to gate on)."""
+        attempt (3 UNet evals). ControlNet guidance windows are gated
+        host-side per attempt: the current log-sigma progress maps to a
+        step fraction and each unit's weight is zeroed outside its window
+        (weights are traced data, so crossing a boundary never recompiles
+        — webui's step-fraction gating at accepted-step granularity)."""
         spec = kd.resolve_sampler(payload.sampler_name)
         sigmas = kd.build_sigmas(spec, self.schedule, steps)
         end = steps if end_step is None else min(end_step, steps)
@@ -407,7 +409,13 @@ class Engine:
             return x
         sigma_max = float(sigmas[start_step])
         sig_end = float(sigmas[end])
-        sigma_min = sig_end if sig_end > 0 else float(sigmas[end - 1])
+        # steps=1 gives sigmas=[sigma_max, 0]: falling back to
+        # sigmas[end-1] would be sigma_max itself and the guard below
+        # would return pure noise — integrate the schedule's full range
+        # instead, like webui's DPM adaptive ignoring the slider.
+        sigma_min = sig_end if sig_end > 0 else max(
+            float(self.schedule.sigma_min),
+            float(sigmas[end - 1]) if end - 1 > start_step else 0.0)
         if sigma_max <= sigma_min:
             return x
 
@@ -418,12 +426,32 @@ class Engine:
         inpainting = self.family.inpaint and inpaint_cond is not None
         inp_arg = inpaint_cond if inpainting else jnp.float32(0)
         masked = mask_lat is not None
-        # coarse window semantics (docstring): widen every unit's guidance
-        # window to the whole run — the in-graph gate compares against a
-        # frozen step fraction here (total_steps=1), which would otherwise
-        # silently disable units whose window excludes 0.5
-        controls = tuple((p, h, w, 0.0, 1.0)
-                         for (p, h, w, _s, _e) in controls)
+        # Guidance-window gating happens HERE on the host, per attempt: the
+        # in-graph gate sees total_steps=1 (frozen fraction 0.5), so each
+        # unit's window is widened to (0, 1) in-graph and its WEIGHT is
+        # zeroed host-side while the trajectory sits outside the window.
+        # Weight is traced data — toggling it never recompiles. Progress
+        # is measured in log-sigma (the quantity the adaptive solver
+        # integrates), matching the fixed-grid path's step fraction at the
+        # ladder's own spacing (ref CN window fields, control_net.py:20-79).
+        import math as _math
+
+        t_lo = -_math.log(sigma_max)
+        t_hi = -_math.log(sigma_min)
+        span = max(t_hi - t_lo, 1e-9)
+        windows = [(g_start, g_end) for (_p, _h, _w, g_start, g_end)
+                   in controls]
+        wide = tuple((p, h, w, 0.0, 1.0) for (p, h, w, _s, _e) in controls)
+
+        def controls_at(s_val: float):
+            frac = min(1.0, max(0.0, (s_val - t_lo) / span))
+            # zero with a PYTHON float: a jnp scalar here would flip the
+            # arg's weak_type at the window boundary and retrace the
+            # 3-UNet-eval attempt executable mid-generation
+            return tuple(
+                (p, h, float(w) if gs <= frac <= ge else 0.0, lo, hi)
+                for (p, h, w, lo, hi), (gs, ge) in zip(wide, windows))
+
         fn = self._adaptive_attempt_fn(width, height, batch,
                                        n_controls=len(controls),
                                        inpaint=inpainting)
@@ -432,7 +460,7 @@ class Engine:
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate("dpm-adaptive-attempt"):
                 return fn(self.params["unet"], xx, x_prev, s, h, rtol, atol,
-                          ctx_u, ctx_c, cfg, au, ac, tuple(controls),
+                          ctx_u, ctx_c, cfg, au, ac, controls_at(float(s)),
                           inp_arg)
 
         # progress: accepted steps against the slider value (the controller
@@ -466,6 +494,17 @@ class Engine:
         get_logger().debug(
             "dpm adaptive: %d accepted / %d rejected steps, %d UNet evals",
             info["n_accept"], info["n_reject"], info["nfe"])
+        if not info["completed"] and not self.state.flag.interrupted:
+            # non-interrupt incompletion (max_attempts backstop — e.g. a
+            # pathological rtol rejecting forever): the latent handed to
+            # the VAE is only partially denoised. Warn AND mark the
+            # image's infotext so a user can tell a half-solved image
+            # from a finished one (VERDICT r4 item 5).
+            get_logger().warning(
+                "dpm adaptive stopped INCOMPLETE after %d attempts "
+                "(%d accepted); the image is partially denoised — "
+                "marked in infotext", info["steps"], info["n_accept"])
+            self._adaptive_incomplete = True
         self.state.finish()
         return x_out
 
@@ -825,6 +864,10 @@ class Engine:
         payload = payload.model_copy()
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
+        # safety reset of the DPM-adaptive incompletion latch (set by
+        # _denoise_adaptive; snapshot-and-cleared PER GROUP by
+        # _queue_decoded so complete batches are never mislabeled)
+        self._adaptive_incomplete = False
         if payload.all_prompts and payload.context_chunks is None:
             # full-request entry (a sub-range over HTTP arrives with the
             # master's value): pin the request-wide context length so
@@ -1358,6 +1401,12 @@ class Engine:
         one, so there is nothing to reuse)."""
         import os as _os
 
+        # snapshot-and-clear the adaptive incompletion latch HERE, at the
+        # only point that knows which images a denoise produced — a sticky
+        # engine-level flag would mislabel other (complete) batches of the
+        # same request once the depth-1 decode pipeline interleaves flushes
+        incomplete = getattr(self, "_adaptive_incomplete", False)
+        self._adaptive_incomplete = False
         budget = int(_os.environ.get("SDTPU_DECODE_PIXELS",
                                      str(self._DECODE_PIXEL_BUDGET)))
         per = max(1, budget // max(1, width * height))
@@ -1371,16 +1420,19 @@ class Engine:
             decode = self._decode_u8_fn(width, height, rows.shape[0])
             with trace.STATS.timer("vae_decode_dispatch"):
                 imgs = decode(self.params["vae"], rows)
-            entries.append((imgs, pos + s, keep, width, height))
+            entries.append((imgs, pos + s, keep, width, height,
+                            incomplete))
         return entries
 
     def _flush_decoded(self, out, payload, pending) -> None:
-        for imgs_dev, pos, n, width, height in pending:
+        for imgs_dev, pos, n, width, height, incomplete in pending:
             with trace.STATS.timer("vae_decode_fetch"):
                 imgs = np.asarray(imgs_dev)
-            self._append_images(out, payload, imgs, pos, n, width, height)
+            self._append_images(out, payload, imgs, pos, n, width, height,
+                                incomplete=incomplete)
 
-    def _append_images(self, out, payload, imgs, pos, n, width, height):
+    def _append_images(self, out, payload, imgs, pos, n, width, height,
+                       incomplete=False):
         pinned = payload.subseed_strength > 0 or payload.same_seed
         for j in range(n):
             i = pos + j
@@ -1394,9 +1446,15 @@ class Engine:
             out.subseeds.append(int(sub_i))
             out.prompts.append(prompt_i)
             out.negative_prompts.append(payload.negative_prompt)
-            out.infotexts.append(build_infotext(
+            text = build_infotext(
                 payload, int(seed_i), int(sub_i), self.model_name,
-                width, height, prompt_override=prompt_i))
+                width, height, prompt_override=prompt_i)
+            if incomplete:
+                # DPM adaptive hit its attempt backstop before reaching
+                # sigma_min — flag the partially-denoised result where
+                # webui users read generation provenance
+                text += ", DPM adaptive: incomplete"
+            out.infotexts.append(text)
             out.worker_labels.append("")
 
 

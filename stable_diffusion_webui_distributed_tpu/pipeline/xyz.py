@@ -140,13 +140,28 @@ def _extract_options(payload: GenerationPayload) -> Dict[str, str]:
             opts.update({str(k).lower(): v for k, v in a.items()})
         elif isinstance(a, str):
             positional.append(a)
-        elif not opts:
+        else:
+            # reject unconditionally — a stray int after a dict is just as
+            # mis-aligned as one before it (docstring contract)
             raise ValueError(
                 "x/y/z plot: positional script_args must be axis-name/value "
                 f"strings, got {type(a).__name__} {a!r} (webui dropdown "
                 "indices are install-specific and not supported — pass "
                 "names, e.g. ['Steps', '10,20'])")
-    if not opts and positional:
+    if opts and positional:
+        # dict form and positional form never mix: with opts present the
+        # strings would be discarded wholesale, which is just as silent a
+        # loss as a dropped tail
+        raise ValueError(
+            "x/y/z plot: script_args mixes dict options with "
+            f"{len(positional)} positional string(s) — pass ONE form "
+            "(a single dict, or the flat [x_axis, x_values, ...] list)")
+    if len(positional) > len(_POSITIONAL_KEYS):
+        raise ValueError(
+            f"x/y/z plot: at most {len(_POSITIONAL_KEYS)} positional "
+            f"script_args ({', '.join(_POSITIONAL_KEYS)}), got "
+            f"{len(positional)} — the tail would be dropped silently")
+    if positional:
         opts.update(dict(zip(_POSITIONAL_KEYS, positional)))
     extra = getattr(payload, "model_extra", None) or {}
     for key in _POSITIONAL_KEYS:

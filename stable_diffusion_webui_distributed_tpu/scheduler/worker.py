@@ -111,6 +111,15 @@ class WorkerNode:
         # checkpoint pin for this worker (reference ui.py:161-171); honored
         # by load_options and persisted via World.save_config
         self.model_override: Optional[str] = model_override
+        # pin provenance: True = checked against the node's model list,
+        # False = accepted while the node was unreachable (typo'd pins
+        # stay visible, not latent), None = no pin / not yet checked.
+        # Re-validated by World.ping_workers on the next successful ping.
+        self.pin_validated: Optional[bool] = None
+        # once a pin is positively refuted against a LIVE model list, ping
+        # sweeps stop re-fetching it (no per-ping RPC/log spam); cleared on
+        # pin change or node reconnect
+        self._pin_refuted = False
         self.response_time: Optional[float] = None
         # free accelerator memory observed at first contact (the reference
         # queries /memory on a worker's first request, worker.py:319-340)
@@ -452,6 +461,7 @@ class StubBackend:
         self.interrupted = False
         self.restarted = False
         self.options: Dict[str, str] = {}
+        self.models: List[str] = ["stub-model"]
 
     def generate(self, payload, start_index, count):
         n = len(self.requests)
@@ -509,7 +519,7 @@ class StubBackend:
         return list(self.behavior.supported_scripts)
 
     def available_models(self) -> List[str]:
-        return ["stub-model"]
+        return list(self.models)
 
     def memory_info(self) -> Dict[str, Any]:
         return {"ram": {"free": 1 << 30, "used": 0, "total": 1 << 30}}

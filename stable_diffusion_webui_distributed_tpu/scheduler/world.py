@@ -19,6 +19,7 @@ The orchestration differences are deliberate TPU redesigns:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
@@ -298,16 +299,56 @@ class World:
             start += job.batch_size
         return self.jobs
 
+    def _plan_no_split(self, payload: GenerationPayload) -> Optional[List[Job]]:
+        """Whole-request plan on the single fastest backend that fits it.
+
+        DPM adaptive's PID controller consumes ONE error norm over the whole
+        batch (k-diffusion semantics; samplers/kdiffusion.py:479), so its
+        step trajectory — and therefore every pixel — depends on batch
+        composition: a 4-image job split 2+2 across workers produces
+        different images than the same job run whole. To keep output
+        independent of fleet topology, adaptive requests are never split
+        (PARITY.md "DPM adaptive" contract exception). Returns None when no
+        single benchmarked backend's pixel cap fits the request; the caller
+        falls back to splitting with a loud warning."""
+        total = payload.total_images
+        px = payload.width * payload.height * total
+        fits = [j.worker for j in self.jobs
+                if j.worker.pixel_cap <= 0 or px <= j.worker.pixel_cap]
+        if not fits:
+            return None
+        best = max(fits, key=lambda w: w.cal.avg_ipm or 0.0)
+        job = Job(best, total)
+        job.start_index = 0
+        return [job]
+
     def plan(self, payload: GenerationPayload) -> List[Job]:
         """make_jobs + optimize_jobs (reference update(), world.py:394-403).
 
         Raises instead of silently planning zero images when the request
         cannot be placed (e.g. every worker's pixel cap is below one image
         of this resolution) — an empty gallery must be an error, not a 200.
+
+        DPM adaptive requests bypass optimize_jobs entirely and run whole
+        on one backend (see _plan_no_split).
         """
         self.make_jobs(payload)
         if not self.jobs:
             raise RuntimeError("no benchmarked, reachable backends")
+        from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import (
+            resolve_sampler,
+        )
+        if resolve_sampler(payload.sampler_name).adaptive:
+            no_split = self._plan_no_split(payload)
+            if no_split is not None:
+                self.jobs = no_split
+                return self.jobs
+            get_logger().warning(
+                "DPM adaptive request (%d images) exceeds every single "
+                "backend's pixel cap; splitting across workers — the PID "
+                "controller's batch-global error norm makes split output "
+                "differ from a whole-batch run (PARITY.md contract "
+                "exception)", payload.total_images)
         jobs = self.optimize_jobs(payload)
         if payload.total_images > 0 and not any(
                 j.batch_size > 0 for j in jobs):
@@ -520,6 +561,34 @@ class World:
             if ok:
                 if w.state == State.UNAVAILABLE:
                     w.set_state(State.IDLE)
+                    w._pin_refuted = False  # reconnect: list may differ
+                if w.model_override and w.pin_validated is not True \
+                        and not getattr(w, "_pin_refuted", False) \
+                        and time.time() - getattr(
+                            w, "_pin_checked_at", 0.0) >= 60.0:
+                    # a pin accepted while the node was down (or loaded
+                    # from config) gets checked on the first successful
+                    # ping — typo'd pins surface here instead of at the
+                    # next load_options failure (ref dropdown-constrained
+                    # pins, ui.py:161-171). A positively REFUTED pin is
+                    # not re-fetched every sweep (no per-ping RPC / log
+                    # spam); the refuted latch clears when the pin is
+                    # re-set (configure_worker) or the node reconnects
+                    # from UNAVAILABLE (its model list may have changed).
+                    # A node answering with an EMPTY list (still loading
+                    # checkpoints?) is retried at most once a minute.
+                    w._pin_checked_at = time.time()
+                    try:
+                        models = w.backend.available_models()
+                    except Exception:  # noqa: BLE001 — stays unvalidated
+                        return
+                    if models:
+                        w.pin_validated = w.model_override in models
+                        if not w.pin_validated:
+                            w._pin_refuted = True
+                            get_logger().warning(
+                                "worker '%s': pinned model '%s' not in its "
+                                "model list", w.label, w.model_override)
             else:
                 w.set_state(State.UNAVAILABLE)
 
@@ -571,6 +640,12 @@ class World:
             return False
         if model_override is not self._UNSET:
             w.model_override = model_override or None
+            # provenance resets with the pin; the API layer promotes it to
+            # True/False per its validation outcome, and ping_workers
+            # re-checks anything not yet True
+            w.pin_validated = None if w.model_override is None else False
+            w._pin_refuted = False
+            w._pin_checked_at = 0.0  # a fresh pin validates on next ping
         if pixel_cap is not self._UNSET and pixel_cap is not None:
             w.pixel_cap = max(0, int(pixel_cap))
         if disabled is not self._UNSET and disabled is not None:

@@ -506,10 +506,18 @@ class ApiServer:
         # half-applied (a changed endpoint with a rejected pin); with
         # endpoint fields in flight, validate against the CANDIDATE
         # endpoint — that is where the pinned model must exist
+        pin_validated = None
         if kwargs.get("model_override"):
-            self._validate_model_pin(label, kwargs["model_override"],
-                                     endpoint or None)
-        if endpoint and hasattr(self.source, "update_worker_endpoint"):
+            pin_validated = self._validate_model_pin(
+                label, kwargs["model_override"], endpoint or None)
+        if endpoint and not hasattr(self.source, "update_worker_endpoint"):
+            # never pretend the edit applied: echoing unapplied endpoint
+            # fields in a 200 would hide the dropped change (this source —
+            # e.g. a bare registry in tests — has no endpoint support)
+            raise ApiError(
+                422, "this server's worker source does not support "
+                f"endpoint edits (fields: {', '.join(sorted(endpoint))})")
+        if endpoint:
             try:
                 with self._busy:
                     ok = self.source.update_worker_endpoint(label, **endpoint)
@@ -522,12 +530,28 @@ class ApiServer:
                 ok = self.source.configure_worker(label, **kwargs)
             if not ok:
                 raise ApiError(404, f"no worker '{label}'")
+        if pin_validated is not None:
+            # promote the provenance configure_worker reset to False:
+            # True only when the node's model list positively contained
+            # the pin (unreachable nodes stay False — visible in the
+            # panel until ping_workers re-validates; VERDICT r4 item 6)
+            cand = self._find_worker(label)
+            if cand is not None and cand.model_override:
+                cand.pin_validated = pin_validated
         # password is write-only everywhere (_worker_dict): never echo it
         endpoint.pop("password", None)
         return {"updated": label, **endpoint, **kwargs}
 
+    def _find_worker(self, label: str):
+        """The single worker-by-label lookup (sources without a registry —
+        e.g. a bare Engine — simply have no ``workers`` attribute)."""
+        for w in getattr(self.source, "workers", []):
+            if w.label == label:
+                return w
+        return None
+
     def _validate_model_pin(self, label: str, pin: str,
-                            endpoint: Optional[Dict[str, Any]] = None) -> None:
+                            endpoint: Optional[Dict[str, Any]] = None) -> bool:
         """Reject a checkpoint pin the worker does not actually serve (the
         reference feeds its override dropdown from the remote's /sd-models,
         ui.py:161-171 + worker.py:623-645 — free text would only fail at
@@ -535,14 +559,13 @@ class ApiServer:
         the probe then targets the merged candidate endpoint instead of the
         current backend. An unreachable worker or an empty model list skips
         validation: better to accept the pin than to block config on a node
-        that is momentarily down."""
-        w = None
-        for cand in getattr(self.source, "workers", []):
-            if cand.label == label:
-                w = cand
-                break
+        that is momentarily down — but the skip is RECORDED: returns True
+        only on a positive match, False when validation was skipped, so the
+        caller can flag the pin as unvalidated (VERDICT r4 item 6) and
+        ping_workers can re-check it later."""
+        w = self._find_worker(label)
         if w is None:
-            return
+            return False
         backend, transient = w.backend, None
         if endpoint and hasattr(self.source, "candidate_backend"):
             try:
@@ -551,13 +574,16 @@ class ApiServer:
                 # would be saved
                 transient = self.source.candidate_backend(label, **endpoint)
             except (ValueError, TypeError):
-                return  # malformed fields fail in update_worker_endpoint
+                return False  # malformed fields fail in update_worker_endpoint
             if transient is not None:
                 backend = transient
         try:
             models = backend.available_models()
         except Exception:  # noqa: BLE001 — node down; accept unvalidated
-            return
+            get_logger().warning(
+                "worker '%s' unreachable; accepting pin '%s' UNVALIDATED",
+                label, pin)
+            return False
         finally:
             if transient is not None:
                 transient.close()
@@ -565,20 +591,20 @@ class ApiServer:
             raise ApiError(
                 422, f"worker '{label}' does not serve model '{pin}' "
                 f"(available: {', '.join(models[:20])})")
+        return bool(models)
 
     def handle_worker_models(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Model list of ONE worker's backend — feeds the panel's checkpoint
         pin dropdown (the reference populates its override dropdown from
         the remote's /sd-models the same way, ui.py:161-171)."""
         label = body.get("label", "")
-        for w in getattr(self.source, "workers", []):
-            if w.label == label:
-                try:
-                    return {"label": label,
-                            "models": w.backend.available_models()}
-                except Exception as e:  # noqa: BLE001 — node down
-                    return {"label": label, "models": [], "error": str(e)}
-        raise ApiError(404, f"no worker '{label}'")
+        w = self._find_worker(label)
+        if w is None:
+            raise ApiError(404, f"no worker '{label}'")
+        try:
+            return {"label": label, "models": w.backend.available_models()}
+        except Exception as e:  # noqa: BLE001 — node down
+            return {"label": label, "models": [], "error": str(e)}
 
     def handle_benchmark(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Kick a fleet benchmark sweep in the background (the reference's
@@ -777,6 +803,7 @@ def _worker_dict(w) -> Dict[str, Any]:
         "master": w.master,
         "pixel_cap": w.pixel_cap,
         "model_override": w.model_override,
+        "pin_validated": w.pin_validated,
         "disabled": w.state.name == "DISABLED",
     }
     backend = w.backend

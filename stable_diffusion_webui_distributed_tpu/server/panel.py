@@ -83,7 +83,9 @@ PANEL_HTML = """<!doctype html>
   <label>user <input id="ew_user" size="8"></label>
   <label>password <input type="password" id="ew_password" size="8"
     placeholder="(unchanged)"></label>
-  <label>model pin <select id="ew_pin"></select></label>
+  <label>model pin <input id="ew_pin" list="ew_pin_models" size="22"
+    placeholder="(follow fleet)"><datalist id="ew_pin_models"></datalist>
+  </label>
   <label>pixel cap <input type="number" id="ew_cap" min="0"></label>
   <button type="submit">save worker</button>
 </form>
@@ -115,7 +117,9 @@ column is the measured benchmark average (images/minute); re-run it with
 <i>re-benchmark</i> after hardware changes.</p>
 <p><b>Per-worker controls.</b> <i>model pin</i> holds a worker on one
 checkpoint regardless of fleet-wide model syncs (validated against the
-models that worker actually serves); <i>pixel cap</i> bounds
+models that worker actually serves; a &#9888; marks a pin accepted
+while its node was unreachable — it is re-checked automatically on the
+next successful ping); <i>pixel cap</i> bounds
 width&times;height&times;batch per job (0 = uncapped); <i>disable</i>
 keeps the worker registered but unscheduled. Edit a registered worker's
 address/port/tls/credentials in the <i>edit worker</i> form — leave the
@@ -164,11 +168,15 @@ let workerRows = [];
 const esc = s => String(s).replace(/[&<>"']/g, c => ({
   '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;'}[c]));
 function setPin(i) {
+  // route to the edit-worker form: its pin input carries a <datalist>
+  // fed by that worker's actual model list (reference ui.py:161-171),
+  // so pins are picked, not typed blind (free text still allowed)
   const w = workerRows[i];
-  const v = prompt(`checkpoint pin for '${w.label}' (empty = follow fleet)`,
-                   w.model_override || '');
-  if (v !== null) post('/internal/workers',
-                       {label: w.label, model_override: v});
+  const sel = document.getElementById('ew_label');
+  sel.value = w.label;
+  fillEditForm();
+  document.getElementById('editworker').scrollIntoView();
+  document.getElementById('ew_pin').focus();
 }
 function setCap(i) {
   const w = workerRows[i];
@@ -227,8 +235,9 @@ async function fillEditForm() {
   document.getElementById('ew_password').value = '';
   document.getElementById('ew_cap').value = w.pixel_cap || 0;
   const pin = document.getElementById('ew_pin');
-  pin.innerHTML = '<option value="">(follow fleet)</option>';
-  if (w.model_override) addPinOption(pin, w.model_override);
+  const list = document.getElementById('ew_pin_models');
+  list.innerHTML = '';
+  if (w.model_override) addPinOption(list, w.model_override);
   pin.value = w.model_override || '';
   try {
     const r = await fetch('/internal/worker-models', {method: 'POST',
@@ -236,9 +245,9 @@ async function fillEditForm() {
       body: JSON.stringify({label: w.label})});
     const models = (await r.json()).models || [];
     // the operator may have switched workers while the fetch was in
-    // flight — never populate another worker's dropdown
+    // flight — never populate another worker's datalist
     if (document.getElementById('ew_label').value !== w.label) return;
-    for (const m of models) addPinOption(pin, m);
+    for (const m of models) addPinOption(list, m);
   } catch (e) { /* node down: keep current pin only */ }
 }
 function addPinOption(sel, name) {
@@ -308,8 +317,12 @@ async function tick() {
       `<td>${w.master ? 'yes' : ''}</td>` +
       `<td><a href="#" onclick="setCap(${i});return false">` +
       `${w.pixel_cap || '—'}</a></td>` +
-      `<td><a href="#" onclick="setPin(${i});return false">` +
-      `${w.model_override ? esc(w.model_override) : '—'}</a></td>` +
+      `<td><a href="#" onclick="setPin(${i});return false" ` +
+      `${w.model_override && w.pin_validated === false ?
+        'title="pin not confirmed against this worker\\'s model list ' +
+        '(node unreachable at set time; re-checked on next ping)"' : ''}>` +
+      `${w.model_override ? esc(w.model_override) +
+        (w.pin_validated === false ? ' &#9888;' : '') : '—'}</a></td>` +
       `<td><button onclick="toggle(${i})">` +
       `${w.disabled ? 'enable' : 'disable'}</button></td>` +
       `<td>${w.master ? '' :

@@ -289,3 +289,45 @@ class TestPreprocessors:
         img = np.full((8, 8, 3), 128, np.uint8)
         out = run_preprocessor("mystery-module", img)
         np.testing.assert_allclose(out, 128 / 255.0, atol=1e-6)
+
+
+class TestAdaptiveGuidanceWindows:
+    """DPM adaptive gates ControlNet units host-side per attempt from
+    log-sigma progress (engine._denoise_adaptive controls_at; VERDICT r4
+    item 4) — a windowed unit must actually change behavior vs the old
+    whole-run widening."""
+
+    def _engine(self):
+        params = init_params(TINY)
+        cfg = TINY.unet
+        converted = convert_controlnet(make_ldm_controlnet(cfg), cfg)
+        return Engine(TINY, params, chunk_size=4, state=GenerationState(),
+                      controlnet_provider=lambda n: converted)
+
+    def _run(self, eng, **unit_overrides):
+        hint_img = (RNG.random((32, 32, 3)) * 255).astype(np.uint8)
+        unit = {"enabled": True, "image": array_to_b64png(hint_img),
+                "module": "none", "model": "cn", "weight": 1.0,
+                **unit_overrides}
+        return eng.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=5,
+            sampler_name="DPM adaptive",
+            alwayson_scripts={"controlnet": {"args": [unit]}}))
+
+    def test_window_gates_unit(self):
+        eng = self._engine()
+        full = self._run(eng)
+        early_only = self._run(eng, guidance_start=0.0, guidance_end=0.15)
+        # the unit must be inactive for most of the trajectory — different
+        # pixels than the full-window run (the pre-fix widening made these
+        # byte-identical)
+        assert early_only.images[0] != full.images[0]
+
+    def test_zero_width_window_equals_no_unit(self):
+        eng = self._engine()
+        plain = eng.txt2img(GenerationPayload(
+            prompt="c", steps=6, width=32, height=32, seed=5,
+            sampler_name="DPM adaptive"))
+        # window that can never contain any fraction > its end at start 1.0
+        never = self._run(eng, guidance_start=0.999, guidance_end=0.9991)
+        assert never.images[0] == plain.images[0]

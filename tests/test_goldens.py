@@ -199,10 +199,12 @@ class TestPathGoldens:
         _check("path/controlnet-canny", engine.txt2img(p))
 
     def test_controlnet_adaptive(self, engine):
-        """ControlNet under DPM adaptive (guidance windows widened to the
-        whole trajectory — engine._denoise_adaptive's coarse semantics).
-        The window below excludes 0.5, the frozen step fraction the
-        in-graph gate sees: the unit must still fire."""
+        """ControlNet under DPM adaptive with a WINDOWED unit (guidance
+        gated host-side per attempt from log-sigma progress —
+        engine._denoise_adaptive controls_at; VERDICT r4 item 4). The
+        window excludes 0.5, the frozen fraction the in-graph gate sees:
+        the unit must still fire early, then switch off — so the output
+        differs BOTH from no-unit and from a full-window unit."""
         unit = {"enabled": True, "image": _hint_b64(), "module": "none",
                 "model": "gold-cn", "weight": 1.0,
                 "guidance_start": 0.0, "guidance_end": 0.3}
@@ -213,7 +215,10 @@ class TestPathGoldens:
         with_cn = engine.txt2img(p)
         plain = engine.txt2img(p.model_copy(
             update={"alwayson_scripts": {}}))
-        assert with_cn.images != plain.images  # unit fired
+        assert with_cn.images != plain.images  # unit fired at all
+        full = engine.txt2img(p.model_copy(update={"alwayson_scripts": {
+            "controlnet": {"args": [{**unit, "guidance_end": 1.0}]}}}))
+        assert with_cn.images != full.images   # window actually gates
         _check("path/controlnet-adaptive", with_cn)
 
     def test_xl_refiner(self, engine_xl):

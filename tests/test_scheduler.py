@@ -760,3 +760,83 @@ class TestBenchmark:
         base = r.seeds[0]
         assert base != -1
         assert r.seeds == [base, base + 1, base + 2, base + 3]
+
+
+class TestAdaptiveNoSplit:
+    """DPM adaptive's batch-global PID error norm makes pixels depend on
+    batch composition, so adaptive requests must never split across
+    workers (PARITY.md contract exception; advisor r4 medium finding)."""
+
+    def test_whole_request_on_fastest(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("fast", 30.0))
+        jobs = w.plan(payload(batch_size=4, sampler_name="DPM adaptive"))
+        assert len(jobs) == 1
+        assert jobs[0].worker.label == "fast"
+        assert jobs[0].batch_size == 4
+        assert jobs[0].start_index == 0
+
+    def test_pixel_cap_picks_fitting_backend(self):
+        w = World(ConfigModel())
+        # fastest cannot fit 4 x 512x512; slower uncapped one can
+        w.add_worker(node("capped", 30.0, pixel_cap=2 * 512 * 512))
+        w.add_worker(node("roomy", 10.0, master=True))
+        jobs = w.plan(payload(batch_size=4, sampler_name="DPM adaptive"))
+        assert len(jobs) == 1
+        assert jobs[0].worker.label == "roomy"
+
+    def test_falls_back_to_split_when_nothing_fits(self):
+        w = World(ConfigModel())
+        w.add_worker(node("a", 10.0, master=True, pixel_cap=2 * 512 * 512))
+        w.add_worker(node("b", 10.0, pixel_cap=2 * 512 * 512))
+        jobs = w.plan(payload(batch_size=4, sampler_name="DPM adaptive"))
+        assert sum(j.batch_size for j in jobs) == 4
+        assert len(jobs) == 2  # documented degraded mode, loudly logged
+
+    def test_fixed_grid_sampler_still_splits(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("a", 10.0))
+        jobs = w.plan(payload(batch_size=4, sampler_name="Euler a"))
+        assert len(jobs) == 2
+
+    def test_execute_merges_single_job(self):
+        w = World(ConfigModel())
+        w.add_worker(node("m", 10.0, master=True))
+        w.add_worker(node("a", 30.0))
+        r = w.execute(payload(batch_size=3, seed=77,
+                              sampler_name="DPM adaptive"))
+        assert len(r.images) == 3
+        assert r.seeds == [77, 78, 79]
+        assert set(r.worker_labels) == {"a"}
+
+
+class TestPinValidation:
+    def test_ping_revalidates_unvalidated_pin(self):
+        w = World(ConfigModel())
+        n = node("a", 10.0)
+        n.backend.models = ["good.safetensors", "other.ckpt"]
+        w.add_worker(n)
+        w.configure_worker("a", model_override="good.safetensors")
+        assert n.pin_validated is False  # set without validation
+        w.ping_workers()
+        assert n.pin_validated is True
+
+    def test_ping_flags_typod_pin(self):
+        w = World(ConfigModel())
+        n = node("a", 10.0)
+        n.backend.models = ["good.safetensors"]
+        w.add_worker(n)
+        w.configure_worker("a", model_override="typo.safetensors")
+        w.ping_workers()
+        assert n.pin_validated is False
+
+    def test_clearing_pin_clears_flag(self):
+        w = World(ConfigModel())
+        n = node("a", 10.0)
+        w.add_worker(n)
+        w.configure_worker("a", model_override="x")
+        w.configure_worker("a", model_override="")
+        assert n.model_override is None
+        assert n.pin_validated is None

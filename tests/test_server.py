@@ -451,3 +451,69 @@ class TestLoopClosure:
         assert isinstance(backend.available_models(), list)
         backend.load_options("some-model")  # no registry -> option recorded
         assert backend.memory_info()["cuda"]["system"]["free"] >= 0
+
+
+class TestEndpointEditUnsupportedSource:
+    def test_endpoint_fields_422_not_silently_dropped(self):
+        """A source without update_worker_endpoint must reject endpoint
+        edits (advisor r4: a 200 echoing unapplied fields hides the drop)."""
+
+        class BareSource:
+            workers = []
+
+            def execute(self, payload):
+                raise NotImplementedError
+
+            def configure_worker(self, label, **kw):
+                return True
+
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            ApiError, ApiServer,
+        )
+
+        srv = ApiServer(BareSource(), state=GenerationState())
+        with pytest.raises(ApiError) as e:
+            srv.handle_workers_post(
+                {"label": "w", "address": "10.0.0.1", "port": 7860})
+        assert e.value.status == 422
+        assert "endpoint edits" in e.value.detail
+
+
+class TestPinValidatedSurface:
+    def test_worker_rows_carry_pin_validated(self, server):
+        world = server.source
+        n = WorkerNode("pv", StubBackend(), avg_ipm=5.0)
+        n.backend.models = ["served.safetensors"]
+        world.add_worker(n)
+        try:
+            call(server, "/internal/workers",
+                 {"label": "pv", "model_override": "served.safetensors"})
+            rows = call(server, "/internal/workers")
+            row = next(r for r in rows if r["label"] == "pv")
+            # validated live against the stub's model list
+            assert row["pin_validated"] is True
+        finally:
+            world.workers.remove(n)
+
+    def test_unreachable_node_pin_flagged_unvalidated(self, server):
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            StubBehavior,
+        )
+
+        world = server.source
+        n = WorkerNode("down", StubBackend(StubBehavior(fail_reachable=True)),
+                       avg_ipm=5.0)
+
+        def boom():
+            raise ConnectionError("down")
+
+        n.backend.available_models = boom
+        world.add_worker(n)
+        try:
+            call(server, "/internal/workers",
+                 {"label": "down", "model_override": "typo.safetensors"})
+            rows = call(server, "/internal/workers")
+            row = next(r for r in rows if r["label"] == "down")
+            assert row["pin_validated"] is False
+        finally:
+            world.workers.remove(n)

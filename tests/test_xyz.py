@@ -215,3 +215,24 @@ class TestRun:
         xyz.run_xyz(p, _stub_execute(log))
         prompts = [c.prompt for c in log]
         assert prompts == ["a red cat", "a blue cat"] * 2
+
+
+class TestStrictArgValidation:
+    """Advisor r4: non-string entries must be rejected even after a dict,
+    and positional lists longer than the 6 axis keys must raise instead of
+    silently dropping the tail."""
+
+    def test_non_string_after_dict_rejected(self):
+        p = GenerationPayload(
+            prompt="x", script_name="x/y/z plot",
+            script_args=[{"x_axis": "Steps", "x_values": "10,20"}, 3])
+        with pytest.raises(ValueError, match="axis-name/value strings"):
+            xyz.run_xyz(p, _stub_execute([]))
+
+    def test_overlong_positional_rejected(self):
+        p = GenerationPayload(
+            prompt="x", script_name="x/y/z plot",
+            script_args=["Steps", "10", "CFG Scale", "5", "Seed", "1,2",
+                         "extra-tail"])
+        with pytest.raises(ValueError, match="at most 6 positional"):
+            xyz.run_xyz(p, _stub_execute([]))

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""One-command TPU chip-window plan: capture EVERYTHING a chip session owes.
+
+Round-5 context: the relay tunnel died before the session began (PERF.md
+"round 5 chip timeline"), so this script encodes the full measurement plan
+the moment a chip window opens — a future session (or operator) runs ONE
+command instead of re-deriving the round-3/4 verdict items:
+
+  phase triage  — socket triage + one bounded claim probe (aborts cleanly
+                  on relay-dead/claim-held; never wedges further)
+  phase sweep   — tools/sweep.py cells (BASELINE configs #1-#4; writes
+                  PERF_SWEEP.jsonl) with its wedge circuit-breaker
+  phase trace   — config #2 (SDXL base+refiner b8) under jax.profiler with
+                  per-stage StageStats accounting -> traces/c2/ +
+                  PERF_TRACE_C2.md (the north-star breakdown VERDICT r3/r4
+                  ordered; BASELINE.md >=8 img/s v5e-16 target)
+  phase c5      — config #5 (hires two-pass): compile-cache PRE-WARM in an
+                  expendable child (SDTPU_BENCH_PREWARM=1; the 2048² first
+                  compile killed the relay twice, PERF.md round 3), then
+                  the real bench in a fresh process against warm caches
+  phase hetero  — examples/hetero_fleet_demo.py with SDTPU_DEMO_PLATFORM=tpu
+                  (TPU master + CPU serve worker — the reference's core
+                  deployment shape, distributed.py:284-319)
+
+Usage: python tools/chip_session.py [--phases triage,sweep,trace,c5,hetero]
+       [--deadline-s 5400]
+Every phase appends a timestamped JSON line to CHIP_SESSION.jsonl; stop at
+any point and the evidence so far is on disk. Only ONE chip process runs at
+a time (phases are sequential subprocesses). The reference anchor for the
+whole exercise: its measured-speed credibility loop,
+/root/reference/scripts/spartan/worker.py:506-575.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+LOG_PATH = os.path.join(REPO, "CHIP_SESSION.jsonl")
+
+
+def log_row(phase: str, **fields) -> None:
+    row = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"), "phase": phase,
+           **fields}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"chip_session: {json.dumps(row)}", file=sys.stderr, flush=True)
+
+
+def phase_triage(deadline) -> bool:
+    import tpu_claim_probe
+
+    res = tpu_claim_probe.diagnose(timeout_s=120)
+    log_row("triage", **res)
+    return res["verdict"] == "ok"
+
+
+def phase_sweep(deadline) -> bool:
+    cells = ["c1-chunk10", "c3-bf16", "c2-bf16", "c4-bf16"]
+    env = dict(os.environ,
+               SDTPU_SWEEP_DEADLINE=str(max(300, int(deadline - time.time()))))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep.py"), *cells],
+        env=env).returncode
+    log_row("sweep", rc=rc, cells=cells)
+    return rc == 0
+
+
+_TRACE_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["SDTPU_REPO"])
+import bench
+from stable_diffusion_webui_distributed_tpu.runtime import trace
+from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+    enable_compilation_cache)
+
+init_done = bench._start_init_watchdog()
+import jax
+jax.devices()
+init_done.set()
+enable_compilation_cache()
+
+metric, engine, payload, segments, rel = bench._build_config(2, False)
+run = engine.img2img if payload.init_images else engine.txt2img
+t0 = time.time(); run(payload)          # warmup (compiles)
+print(f"trace: warmup {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+trace.STATS.clear()
+out_dir = os.path.join(os.environ["SDTPU_REPO"], "traces", "c2")
+os.makedirs(out_dir, exist_ok=True)
+with trace.capture(out_dir):
+    t0 = time.time(); result = run(payload); wall = time.time() - t0
+stages = trace.STATS.summary()
+md = ["# Config #2 (SDXL base+refiner 1024² b8) — profiled stage table",
+      "", f"- device: {jax.devices()[0].device_kind}",
+      f"- request wall: {wall:.2f}s for {len(result.images)} images "
+      f"({len(result.images)/wall:.3f} img/s/chip)",
+      f"- jax.profiler trace: traces/c2/ (TensorBoard-loadable)", "",
+      "| stage | p50 | mean | count | est. total (mean*count) |",
+      "|---|---|---|---|---|"]
+for name, s in sorted(stages.items(),
+                      key=lambda kv: -kv[1]["mean"] * kv[1]["count"]):
+    md.append(f"| {name} | {s['p50']*1e3:.1f} ms | {s['mean']*1e3:.1f} ms "
+              f"| {s['count']} | {s['mean']*s['count']:.2f} s |")
+md.append("")
+md.append(f"Unaccounted (dispatch gaps/host): "
+          f"{wall - sum(s['mean']*s['count'] for s in stages.values()):.2f}s "
+          f"of {wall:.2f}s wall")
+open(os.path.join(os.environ["SDTPU_REPO"], "PERF_TRACE_C2.md"),
+     "w").write("\n".join(md) + "\n")
+print("TRACE_OK " + json.dumps({"wall_s": round(wall, 2),
+                                "images": len(result.images)}), flush=True)
+"""
+
+
+def phase_trace(deadline) -> bool:
+    env = dict(os.environ, SDTPU_REPO=REPO)
+    proc = subprocess.run([sys.executable, "-c", _TRACE_CHILD], env=env,
+                          capture_output=True, text=True)
+    ok = "TRACE_OK" in proc.stdout
+    log_row("trace", rc=proc.returncode, ok=ok,
+            tail=(proc.stdout + proc.stderr).strip().splitlines()[-4:])
+    return ok
+
+
+def phase_c5(deadline) -> bool:
+    # pre-warm child (expendable: its only job is populating the persistent
+    # XLA compile cache; a relay death here costs nothing lasting)
+    env = dict(os.environ, SDTPU_BENCH_PREWARM="1")
+    pre = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config", "5"],
+        env=env, capture_output=True, text=True)
+    log_row("c5-prewarm", rc=pre.returncode,
+            tail=pre.stdout.strip().splitlines()[-1:])
+    # the real row, fresh process, warm caches
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config", "5"],
+        capture_output=True, text=True)
+    row = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+    log_row("c5-bench", rc=proc.returncode, row=row)
+    if row and row.get("value"):
+        with open(os.path.join(REPO, "PERF_SWEEP.jsonl"), "a") as f:
+            f.write(json.dumps({**row, "cell": "c5-bf16-prewarmed"}) + "\n")
+        return True
+    return False
+
+
+def phase_hetero(deadline) -> bool:
+    env = dict(os.environ, SDTPU_DEMO_PLATFORM="tpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "hetero_fleet_demo.py")],
+        env=env, capture_output=True, text=True)
+    log_row("hetero", rc=proc.returncode,
+            tail=(proc.stdout + proc.stderr).strip().splitlines()[-4:])
+    return proc.returncode == 0
+
+
+PHASES = {"triage": phase_triage, "sweep": phase_sweep, "trace": phase_trace,
+          "c5": phase_c5, "hetero": phase_hetero}
+DEFAULT = ["triage", "sweep", "trace", "c5", "hetero"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phases", default=",".join(DEFAULT))
+    ap.add_argument("--deadline-s", type=float, default=5400.0,
+                    help="stop launching phases this many seconds from now")
+    args = ap.parse_args()
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    unknown = [p for p in phases if p not in PHASES]
+    if unknown:
+        raise SystemExit(f"unknown phases {unknown}; valid: {list(PHASES)}")
+    deadline = time.time() + args.deadline_s
+    for p in phases:
+        if time.time() > deadline - 180:
+            log_row("deadline", skipped_from=p)
+            break
+        ok = PHASES[p](deadline)
+        if p == "triage" and not ok:
+            log_row("abort", reason="triage failed — no chip this window")
+            return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
