@@ -1,9 +1,16 @@
 """Pallas flash attention for the UNet's latent-token self-attention.
 
-Online-softmax blockwise attention: K/V stream through VMEM in
-``block_k``-sized tiles per ``block_q`` query tile, so the (T x S) score
-matrix never materializes in HBM — the standard memory-bound win at SDXL
-resolutions (T = 4096 latent tokens at 1024², 16384 at 2048² hires).
+Online-softmax blockwise attention in the canonical TPU form: the grid is
+``(batch*heads, T/block_q, S/block_k)`` with the key dimension innermost,
+K/V arrive as ``block_k`` tiles through the pallas pipeline (double-buffered
+DMA, never whole-sequence resident in VMEM), and the running softmax state
+(m, l, acc) lives in VMEM scratch that persists across the sequential grid
+steps of one query tile. The (T x S) score matrix never materializes in
+HBM — the standard memory-bound win at SDXL resolutions (T = 4096 latent
+tokens at 1024²) and the only viable form at the hires second pass
+(T = 65536 at 2048², where even one (T x S) bf16 score matrix would be
+8 GB). Whole-K-in-VMEM variants stop fitting around S≈16k at f32; tile
+streaming has no such ceiling.
 
 Falls back to ``jax.nn.dot_product_attention`` when shapes don't tile
 (cross-attention's 77-token context) or when running on CPU test platforms
@@ -20,46 +27,58 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
-    """One (batch*head, q-tile) program: stream K/V tiles, online softmax."""
-    q = q_ref[0].astype(jnp.float32) * scale           # (block_q, D)
-    block_q, d = q.shape
-    s_len = k_ref.shape[1]
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                 scale: float):
+    """One (batch*head, q-tile, k-tile) step: fold one K/V tile into the
+    running online-softmax state; finalize on the last k-tile."""
+    j = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T                                 # (block_q, block_k)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + p @ v_blk
-        return m_new, l_new, acc_new
+    q = q_ref[0].astype(jnp.float32) * scale            # (block_q, D)
+    k_blk = k_ref[0].astype(jnp.float32)                # (block_k, D)
+    v_blk = v_ref[0].astype(jnp.float32)
 
-    m, l, acc = jax.lax.fori_loop(0, s_len // block_k, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    s = q @ k_blk.T                                     # (block_q, block_k)
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + p @ v_blk
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
 
 
 def _flash_bhtd(q, k, v, scale, block_q, block_k, interpret):
     """(BH, T, D) x (BH, S, D) -> (BH, T, D)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, t, d = q.shape
-    kernel = functools.partial(_attn_kernel, scale=scale, block_k=block_k)
+    s_len = k.shape[1]
+    kernel = functools.partial(_attn_kernel, scale=scale)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(bh, t // block_q),
+        grid=(bh, t // block_q, s_len // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, k.shape[1], d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, k.shape[1], d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),   # unnormalized acc
+        ],
         interpret=interpret,
     )(q, k, v)
 

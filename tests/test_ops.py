@@ -110,3 +110,27 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                    np.asarray(reference(q, k, v)),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestFlashAttentionStreaming:
+    """The k-tile streaming form (grid innermost over S/block_k with VMEM
+    scratch carry) must fold MANY tiles correctly — the shape class the
+    hires 2048² pass hits (S >> block_k), where whole-K VMEM residency is
+    impossible."""
+
+    def test_many_k_tiles_asymmetric_blocks(self):
+        q, k, v = qkv(2, 512, 2, 32)
+        out = flash_attention(q, k, v, block_q=128, block_k=64,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_sd15_head_dim_40(self):
+        # production head_dim for SD1.5 latent self-attention
+        q, k, v = qkv(1, 256, 8, 40)
+        out = flash_attention(q, k, v, block_q=64, block_k=64,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)

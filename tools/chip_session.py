@@ -63,14 +63,20 @@ def phase_triage(deadline) -> bool:
     return res["verdict"] == "ok"
 
 
-def phase_sweep(deadline) -> bool:
-    cells = ["c1-chunk10", "c3-bf16", "c2-bf16", "c4-bf16"]
-    env = dict(os.environ,
-               SDTPU_SWEEP_DEADLINE=str(max(300, int(deadline - time.time()))))
+WEDGE = "wedge"  # phase outcome that must stop ALL further chip probing
+
+
+def phase_sweep(deadline):
+    cells = ["c1-chunk10", "c3-bf16", "c2-chunk10", "c2-flash", "c4-bf16"]
+    # leave the later phases (trace/c5/hetero) at least 25 min of window
+    budget = max(300, int(deadline - time.time() - 1500))
+    env = dict(os.environ, SDTPU_SWEEP_DEADLINE=str(budget))
     rc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "sweep.py"), *cells],
         env=env).returncode
-    log_row("sweep", rc=rc, cells=cells)
+    log_row("sweep", rc=rc, cells=cells, budget_s=budget)
+    if rc == 9:  # sweep's wedge circuit breaker (tools/sweep.py)
+        return WEDGE
     return rc == 0
 
 
@@ -120,17 +126,19 @@ print("TRACE_OK " + json.dumps({"wall_s": round(wall, 2),
 """
 
 
-def phase_trace(deadline) -> bool:
+def phase_trace(deadline):
     env = dict(os.environ, SDTPU_REPO=REPO)
     proc = subprocess.run([sys.executable, "-c", _TRACE_CHILD], env=env,
                           capture_output=True, text=True)
     ok = "TRACE_OK" in proc.stdout
     log_row("trace", rc=proc.returncode, ok=ok,
             tail=(proc.stdout + proc.stderr).strip().splitlines()[-4:])
+    if proc.returncode == 3:  # init watchdog: claim wedged mid-window
+        return WEDGE
     return ok
 
 
-def phase_c5(deadline) -> bool:
+def phase_c5(deadline):
     # pre-warm child (expendable: its only job is populating the persistent
     # XLA compile cache; a relay death here costs nothing lasting)
     env = dict(os.environ, SDTPU_BENCH_PREWARM="1")
@@ -139,6 +147,8 @@ def phase_c5(deadline) -> bool:
         env=env, capture_output=True, text=True)
     log_row("c5-prewarm", rc=pre.returncode,
             tail=pre.stdout.strip().splitlines()[-1:])
+    if pre.returncode == 3:
+        return WEDGE
     # the real row, fresh process, warm caches
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--config", "5"],
@@ -150,11 +160,36 @@ def phase_c5(deadline) -> bool:
         except ValueError:
             continue
     log_row("c5-bench", rc=proc.returncode, row=row)
-    if row and row.get("value"):
-        with open(os.path.join(REPO, "PERF_SWEEP.jsonl"), "a") as f:
-            f.write(json.dumps({**row, "cell": "c5-bf16-prewarmed"}) + "\n")
-        return True
-    return False
+    if proc.returncode == 3:
+        return WEDGE
+    if not (row and row.get("value")):
+        return False
+    with open(os.path.join(REPO, "PERF_SWEEP.jsonl"), "a") as f:
+        f.write(json.dumps({**row, "cell": "c5-bf16-prewarmed"}) + "\n")
+    # c5 variants, only with comfortable headroom (hetero still needs its
+    # own window after this — cap the variants' budget explicitly)
+    if time.time() < deadline - 2400:
+        # c5-flash compiles a DIFFERENT executable (attention impl is part
+        # of the HLO), so the base prewarm does not cover it: give it its
+        # own expendable prewarm child before the measured row
+        pre_env = dict(os.environ, SDTPU_BENCH_PREWARM="1",
+                       SDTPU_ATTENTION="flash", SDTPU_CHUNK="10")
+        pf = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--config", "5"], env=pre_env, capture_output=True, text=True)
+        log_row("c5-flash-prewarm", rc=pf.returncode,
+                tail=pf.stdout.strip().splitlines()[-1:])
+        if pf.returncode == 3:
+            return WEDGE
+        budget = int(min(1800.0, deadline - time.time() - 1200))
+        env = dict(os.environ, SDTPU_SWEEP_DEADLINE=str(max(300, budget)))
+        sp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "sweep.py"),
+             "c5-flash", "c5-decode4m"], env=env)
+        log_row("c5-variants", rc=sp.returncode, budget_s=budget)
+        if sp.returncode == 9:
+            return WEDGE
+    return True
 
 
 def phase_hetero(deadline) -> bool:
@@ -188,10 +223,16 @@ def main() -> int:
         if time.time() > deadline - 180:
             log_row("deadline", skipped_from=p)
             break
-        ok = PHASES[p](deadline)
-        if p == "triage" and not ok:
+        outcome = PHASES[p](deadline)
+        if p == "triage" and outcome is not True:
             log_row("abort", reason="triage failed — no chip this window")
             return 4
+        if outcome == WEDGE:
+            # round-3 lesson: every probe against a wedged claim extends
+            # it — no later phase may touch the chip this window
+            log_row("abort", reason=f"wedge during {p}; stopping all "
+                    "further chip phases")
+            return 3
     return 0
 
 

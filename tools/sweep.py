@@ -49,7 +49,7 @@ def _policy(param="bf16", attention="xla", remat=False):
     )
 
 
-#: cell name -> (config number, policy kwargs, chunk size)
+#: cell name -> (config number, policy kwargs, chunk size[, env overrides])
 CELLS = {
     "c1-f32":     (1, {"param": "f32"}, 5),
     "c1-bf16":    (1, {}, 5),
@@ -59,10 +59,19 @@ CELLS = {
     "c1-chunk8":  (1, {}, 8),
     "c1-flash10": (1, {"attention": "flash"}, 10),
     "c2-bf16":    (2, {}, 5),
+    "c2-chunk10": (2, {}, 10),   # round-3's c2 row predates the chunk-10
+                                 # default win on c1 — measure it on SDXL
+    "c2-flash":   (2, {"attention": "flash"}, 10),  # 4096-token SDXL attn
     "c2-remat":   (2, {"remat": True}, 5),
     "c3-bf16":    (3, {}, 5),
     "c4-bf16":    (4, {}, 5),
     "c5-bf16":    (5, {}, 5),
+    # hires 2048² second pass: 65536-token SD1.5 self-attention is the
+    # quadratic blowup flash attention exists for; decode4m doubles the
+    # VAE micro-batch pixel budget (decode runs bf16-conv/f32-GroupNorm,
+    # so scratch per pixel is half the round-3 OOM estimate)
+    "c5-flash":   (5, {"attention": "flash"}, 10),
+    "c5-decode4m": (5, {}, 10, {"SDTPU_DECODE_PIXELS": "4194304"}),
 }
 
 DEFAULT_ORDER = [
@@ -109,9 +118,11 @@ def run_cell(name):
 
     enable_compilation_cache()
 
-    cfg_n, pol_kwargs, chunk = CELLS[name]
+    cfg_n, pol_kwargs, chunk, *rest = CELLS[name]
     dtypes.TPU = _policy(**pol_kwargs)  # bench._make_engine reads dtypes.TPU
     os.environ["SDTPU_CHUNK"] = str(chunk)
+    for key, val in (rest[0] if rest else {}).items():
+        os.environ[key] = val
 
     t0 = time.time()
     out = bench.run_config(cfg_n, tiny=False)
@@ -178,7 +189,7 @@ def main():
                   "probes would extend the wedge (PERF.md relay lessons). "
                   "Cool down >=15 min before the next chip touch.",
                   file=sys.stderr, flush=True)
-            break
+            sys.exit(9)  # explicit wedge contract (chip_session stops too)
 
 
 if __name__ == "__main__":
