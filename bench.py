@@ -139,6 +139,15 @@ def _run_with_retry(argv):
               + "no chip claim can be granted this run. See PERF.md "
               "'round 5 chip timeline' for the measured evidence chain.",
               file=sys.stderr, flush=True)
+        # still exactly one JSON line on stdout: value null says plainly
+        # that NO measurement happened, but the recorded artifact carries
+        # the machine-readable diagnosis instead of nothing at all
+        print(json.dumps({
+            "metric": "tpu_relay_triage", "value": None, "unit": "verdict",
+            "vs_baseline": None, "verdict": verdict,
+            "relay": json.loads(detail) if detail.startswith("{") else detail,
+            "measurement": False,
+            "see": "PERF.md 'round 5 chip timeline'"}))
         raise SystemExit(3)
     print(f"bench: relay triage verdict={verdict} detail={detail}",
           file=sys.stderr, flush=True)

@@ -146,7 +146,15 @@ class Engine:
                          attention_impl=attn_impl,
                          use_remat=policy.use_remat,
                          mesh=attn_mesh)
-        self.vae = VAE(family.vae, dtype=cd)
+        vae_cfg = family.vae
+        if getattr(policy, "decode_in_bf16", False) and \
+                vae_cfg.force_decoder_f32:
+            # policy opt-in (SDTPU_DECODE_DTYPE=bf16): decoder convs in the
+            # compute dtype; GroupNorm stats and conv_out stay f32 (vae.py)
+            import dataclasses as _dc
+
+            vae_cfg = _dc.replace(vae_cfg, force_decoder_f32=False)
+        self.vae = VAE(vae_cfg, dtype=cd)
 
         self._cache: Dict[Tuple, Callable] = {}
         self._cache_lock = threading.Lock()

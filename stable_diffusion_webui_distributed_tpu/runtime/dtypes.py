@@ -24,6 +24,15 @@ class Policy:
     # rematerialize transformer blocks: trades UNet FLOPs for HBM at large
     # batch/resolution (SDTPU_REMAT=1 flips the default TPU policy).
     use_remat: bool = False
+    # Decoder conv dtype override (SDTPU_DECODE_DTYPE=bf16): runs the VAE
+    # decoder's convs in bf16 while GroupNorm statistics and the final
+    # conv_out stay f32 (models/vae.py). Halves decode HBM scratch — the
+    # round-3 b8 1024² OOM was 16 GB of f32 conv temps — and halves
+    # decode bytes fetched per dispatch under the pixel budget. Off by
+    # default: banding risk is unvalidated without real weights
+    # (README "numerical-parity status"); measure via sweep cell
+    # c2-decodebf16 before promoting.
+    decode_in_bf16: bool = False
 
 
 def _default_attention() -> str:
@@ -73,10 +82,26 @@ def _default_param_dtype() -> jnp.dtype:
     return jnp.dtype(jnp.float32)
 
 
+def _default_decode_bf16() -> bool:
+    import os
+
+    value = os.environ.get("SDTPU_DECODE_DTYPE", "f32").strip().lower()
+    if value in ("bf16", "bfloat16"):
+        return True
+    if value not in ("f32", "float32", "fp32"):
+        import warnings
+
+        warnings.warn(
+            f"SDTPU_DECODE_DTYPE={value!r} is not one of ('bf16', 'f32'); "
+            "using 'f32'", stacklevel=2)
+    return False
+
+
 #: Default policy for real TPU runs.
 TPU = Policy(param_dtype=_default_param_dtype(),
              attention_impl=_default_attention(),
-             use_remat=_env_flag("SDTPU_REMAT"))
+             use_remat=_env_flag("SDTPU_REMAT"),
+             decode_in_bf16=_default_decode_bf16())
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 

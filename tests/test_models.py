@@ -396,3 +396,58 @@ class TestTokenizer:
         a, b = tok(["same prompt"]), tok(["same prompt"])
         np.testing.assert_array_equal(a, b)
         assert (tok(["other"]) != a).any()
+
+
+class TestDecodeDtypePolicy:
+    """SDTPU_DECODE_DTYPE=bf16 (Policy.decode_in_bf16): decoder convs drop
+    to bf16 while GroupNorm statistics and the final conv_out stay f32 —
+    the HBM-scratch lever for the b8 1024² decode (round-3 OOM dump shows
+    16 GB of f32 conv temps)."""
+
+    def _decode_hlo(self, force_f32):
+        import dataclasses
+        import re
+
+        cfg = dataclasses.replace(TINY.vae, force_decoder_f32=force_f32)
+        vae = VAE(cfg, dtype=jnp.bfloat16)
+        params = vae.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                          jax.random.key(1))["params"]
+        lat = jnp.zeros((1, 4, 4, 4), jnp.float32)
+        hlo = jax.jit(
+            lambda p, l: vae.apply({"params": p}, l, method=VAE.decode)
+        ).lower(params, lat).as_text()
+        return re.findall(r'stablehlo\.convolution.*-> tensor<[0-9x]+x'
+                          r'(f32|bf16)>', hlo), params, vae, lat
+
+    def test_bf16_decoder_convs(self):
+        dtypes_found, params, vae, lat = self._decode_hlo(force_f32=False)
+        assert dtypes_found, "no convolutions found in decode HLO"
+        # all convs except the final conv_out (pinned f32) are bf16
+        assert dtypes_found.count("f32") == 1, dtypes_found
+        assert dtypes_found[-1] == "f32"  # conv_out stays f32
+        out = jax.jit(lambda p, l: vae.apply({"params": p}, l,
+                                             method=VAE.decode))(params, lat)
+        assert out.dtype == jnp.float32  # image always comes back f32
+
+    def test_f32_default_unchanged(self):
+        dtypes_found, *_ = self._decode_hlo(force_f32=True)
+        assert set(dtypes_found) == {"f32"}
+
+    def test_engine_policy_wires_it(self):
+        from stable_diffusion_webui_distributed_tpu.pipeline.engine import (
+            Engine,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+        from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+            GenerationState,
+        )
+
+        pol = dtypes.Policy(decode_in_bf16=True)
+        from test_pipeline import init_params
+
+        eng = Engine(TINY, init_params(TINY), policy=pol,
+                     state=GenerationState())
+        assert eng.vae.cfg.force_decoder_f32 is False
+        # default policy leaves the family config untouched
+        eng2 = Engine(TINY, init_params(TINY), state=GenerationState())
+        assert eng2.vae.cfg.force_decoder_f32 is True

@@ -181,8 +181,8 @@ def phase_c5(deadline):
                 tail=pf.stdout.strip().splitlines()[-1:])
         if pf.returncode == 3:
             return WEDGE
-        budget = int(min(1800.0, deadline - time.time() - 1200))
-        env = dict(os.environ, SDTPU_SWEEP_DEADLINE=str(max(300, budget)))
+        budget = max(300, int(min(1800.0, deadline - time.time() - 1200)))
+        env = dict(os.environ, SDTPU_SWEEP_DEADLINE=str(budget))
         sp = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "sweep.py"),
              "c5-flash", "c5-decode4m"], env=env)

@@ -36,7 +36,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def _policy(param="bf16", attention="xla", remat=False):
+def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False):
     import jax.numpy as jnp
 
     from stable_diffusion_webui_distributed_tpu.runtime import dtypes
@@ -46,6 +46,7 @@ def _policy(param="bf16", attention="xla", remat=False):
                               else jnp.float32),
         attention_impl=attention,
         use_remat=remat,
+        decode_in_bf16=decode_bf16,
     )
 
 
@@ -71,7 +72,16 @@ CELLS = {
     # VAE micro-batch pixel budget (decode runs bf16-conv/f32-GroupNorm,
     # so scratch per pixel is half the round-3 OOM estimate)
     "c5-flash":   (5, {"attention": "flash"}, 10),
-    "c5-decode4m": (5, {}, 10, {"SDTPU_DECODE_PIXELS": "4194304"}),
+    # 4M-pixel decode micro-batches are only safe with bf16 conv temps
+    # (f32 at 4.2 Mpx is ~8 GB scratch — the round-3 OOM class)
+    "c5-decode4m": (5, {"decode_bf16": True}, 10,
+                    {"SDTPU_DECODE_PIXELS": "4194304"}),
+    # bf16 decoder convs (f32 GroupNorm/conv_out): halves the decode
+    # scratch that OOM'd round 3's b8 1024² decode and halves decode HBM
+    # bytes; quality vs f32 must be eyeballed with real weights before
+    # this becomes a default
+    "c2-decodebf16": (2, {"decode_bf16": True}, 10,
+                      {"SDTPU_DECODE_PIXELS": "4194304"}),
 }
 
 DEFAULT_ORDER = [
