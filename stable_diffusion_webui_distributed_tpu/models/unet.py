@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.models.configs import UNetConfig
+from stable_diffusion_webui_distributed_tpu.ops.quant import linear as _linear
 
 
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
@@ -87,18 +88,22 @@ class Attention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     impl: str = "xla"
     mesh: Optional[object] = None
+    quant_linears: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
         B, T, C = x.shape
         head_dim = C // self.num_heads
+        qz = self.quant_linears
         if context is None:
-            qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype, name="qkv")(x)
+            qkv = _linear(qz, 3 * C, use_bias=False, dtype=self.dtype,
+                          name="qkv")(x)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             ctx_len = T
         else:
-            q = nn.Dense(C, use_bias=False, dtype=self.dtype, name="q")(x)
-            kv = nn.Dense(2 * C, use_bias=False, dtype=self.dtype, name="kv")(context)
+            q = _linear(qz, C, use_bias=False, dtype=self.dtype, name="q")(x)
+            kv = _linear(qz, 2 * C, use_bias=False, dtype=self.dtype,
+                         name="kv")(context)
             k, v = jnp.split(kv, 2, axis=-1)
             ctx_len = context.shape[1]
 
@@ -127,16 +132,19 @@ class Attention(nn.Module):
             out = jax.nn.dot_product_attention(
                 q, k, v, scale=1.0 / head_dim**0.5)
         out = out.reshape(B, T, C)
-        return nn.Dense(C, dtype=self.dtype, name="out_proj")(out)
+        return _linear(self.quant_linears, C, dtype=self.dtype,
+                       name="out_proj")(out)
 
 
 class GEGLU(nn.Module):
     dim_out: int
     dtype: jnp.dtype = jnp.float32
+    quant_linears: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = nn.Dense(2 * self.dim_out, dtype=self.dtype, name="proj")(x)
+        h = _linear(self.quant_linears, 2 * self.dim_out, dtype=self.dtype,
+                    name="proj")(x)
         a, g = jnp.split(h, 2, axis=-1)
         return a * nn.gelu(g)
 
@@ -148,21 +156,25 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     mesh: Optional[object] = None
+    quant_linears: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         C = x.shape[-1]
+        qz = self.quant_linears
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           impl=self.attention_impl, mesh=self.mesh,
-                          name="attn1")(
+                          quant_linears=qz, name="attn1")(
             nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         )
-        x = x + Attention(self.num_heads, dtype=self.dtype, name="attn2")(
+        x = x + Attention(self.num_heads, dtype=self.dtype,
+                          quant_linears=qz, name="attn2")(
             nn.LayerNorm(dtype=jnp.float32, name="ln2")(x), context
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln3")(x)
-        h = GEGLU(4 * C, dtype=self.dtype, name="geglu")(h)
-        h = nn.Dense(C, dtype=self.dtype, name="ff_out")(h)
+        h = GEGLU(4 * C, dtype=self.dtype, quant_linears=qz,
+                  name="geglu")(h)
+        h = _linear(qz, C, dtype=self.dtype, name="ff_out")(h)
         return x + h
 
 
@@ -175,21 +187,25 @@ class SpatialTransformer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     mesh: Optional[object] = None
+    quant_linears: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         B, H, W, C = x.shape
         residual = x
         h = GroupNorm32(name="norm")(x).reshape(B, H * W, C)
-        h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h)
+        h = _linear(self.quant_linears, C, dtype=self.dtype,
+                    name="proj_in")(h)
         block = TransformerBlock
         if self.use_remat:
             block = nn.remat(TransformerBlock, static_argnums=())
         for i in range(self.depth):
             h = block(self.num_heads, dtype=self.dtype,
                       attention_impl=self.attention_impl, mesh=self.mesh,
+                      quant_linears=self.quant_linears,
                       name=f"block_{i}")(h, context)
-        h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
+        h = _linear(self.quant_linears, C, dtype=self.dtype,
+                    name="proj_out")(h)
         return residual + h.reshape(B, H, W, C)
 
 
@@ -229,6 +245,9 @@ class UNet(nn.Module):
     use_remat: bool = False
     attention_impl: str = "xla"
     mesh: Optional[object] = None
+    # experimental dynamic W8A8 for transformer linears (ops/quant.py;
+    # SDTPU_UNET_INT8=1) — the int8-MXU lever from PERF.md's roofline
+    quant_linears: bool = False
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -278,6 +297,7 @@ class UNet(nn.Module):
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
                         self.attention_impl, self.mesh,
+                        quant_linears=self.quant_linears,
                         name=f"down_{level}_attn_{i}")(x, context)
                 skips.append(x)
             if level < len(c.block_out_channels) - 1:
@@ -291,6 +311,7 @@ class UNet(nn.Module):
             x = SpatialTransformer(
                 c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
                 self.dtype, self.attention_impl, self.mesh,
+                quant_linears=self.quant_linears,
                 name="mid_attn")(x, context)
         x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
 
@@ -317,6 +338,7 @@ class UNet(nn.Module):
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
                         self.attention_impl, self.mesh,
+                        quant_linears=self.quant_linears,
                         name=f"up_{level}_attn_{i}")(x, context)
             if level > 0:
                 x = Upsample(ch, dtype=self.dtype, name=f"up_{level}_us")(x)

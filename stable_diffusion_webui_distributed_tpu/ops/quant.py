@@ -1,0 +1,85 @@
+"""Dynamic W8A8 int8 linears for the UNet's transformer blocks.
+
+The v5e MXU multiplies s8 x s8 -> s32 at double the bf16 rate
+(394 vs 197 TOP/s), and PERF.md's round-5 roofline analysis shows the
+SDXL north-star target sits ABOVE the bf16 roofline — int8 is the only
+single-chip lever that clears it (0.96 img/s/chip ceiling vs the 0.5
+target). This module provides the minimal, checkpoint-compatible form:
+
+- ``QuantDense`` stores exactly the same ``kernel``/``bias`` parameters
+  as ``flax.linen.Dense`` (same names, same shapes, same initializers),
+  so converted checkpoints, LoRA merges, and the param cache all work
+  unchanged — quantization happens at CALL time, not load time.
+- Quantization is dynamic and symmetric: per-token activation scales
+  (max-abs over the feature axis) and per-output-channel weight scales,
+  int32 accumulation, rescale to the layer dtype. No calibration pass,
+  no stored scales.
+
+Scope and honesty: only the transformer-block linears (qkv/out_proj,
+GEGLU, ff_out, proj_in/out) quantize — convs, time embeddings, and
+norms stay in the bf16/f32 policy. Dynamic W8A8 on diffusion UNets is
+known to cost some image fidelity; this stays OFF unless
+``SDTPU_UNET_INT8=1`` (Policy.unet_int8), and its quality must be
+eyeballed with real weights before any default flip (README
+"numerical-parity status"). Throughput is measured by sweep cells
+``c2-int8`` / ``c4-int8``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def int8_dot(x: jax.Array, kernel: jax.Array, eps: float = 1e-8):
+    """Dynamic symmetric W8A8 matmul: ``x @ kernel`` with int8 operands and
+    int32 accumulation.
+
+    x: (..., in_features) any float dtype; kernel: (in, out).
+    Per-token activation scales, per-output-channel weight scales.
+    Returns f32 of shape (..., out_features).
+    """
+    xf = x.astype(jnp.float32)
+    kf = kernel.astype(jnp.float32)
+    s_x = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0 + eps
+    s_w = jnp.max(jnp.abs(kf), axis=0, keepdims=True) / 127.0 + eps
+    xq = jnp.round(xf / s_x).astype(jnp.int8)
+    wq = jnp.round(kf / s_w).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * s_x * s_w
+
+
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense`` with the int8 dynamic-quant forward.
+
+    Parameter tree is IDENTICAL to ``nn.Dense`` (kernel (in, out) via
+    lecun_normal, optional bias zeros), so a module can switch between
+    the two purely by construction flag with no checkpoint migration.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features))
+        out = int8_dot(x, kernel)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,))
+            out = out + bias.astype(jnp.float32)
+        return out.astype(self.dtype)
+
+
+def linear(quant: bool, features: int, *, use_bias: bool = True,
+           dtype=jnp.float32, name: str):
+    """The transformer-linear factory: ``nn.Dense`` or ``QuantDense``
+    under the same parameter names."""
+    cls = QuantDense if quant else nn.Dense
+    return cls(features, use_bias=use_bias, dtype=dtype, name=name)

@@ -145,7 +145,8 @@ class Engine:
         self.unet = UNet(family.unet, dtype=cd,
                          attention_impl=attn_impl,
                          use_remat=policy.use_remat,
-                         mesh=attn_mesh)
+                         mesh=attn_mesh,
+                         quant_linears=getattr(policy, "unet_int8", False))
         vae_cfg = family.vae
         if getattr(policy, "decode_in_bf16", False) and \
                 vae_cfg.force_decoder_f32:

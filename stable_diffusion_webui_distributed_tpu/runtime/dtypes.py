@@ -33,6 +33,13 @@ class Policy:
     # (README "numerical-parity status"); measure via sweep cell
     # c2-decodebf16 before promoting.
     decode_in_bf16: bool = False
+    # Experimental dynamic W8A8 int8 for the UNet transformer linears
+    # (SDTPU_UNET_INT8=1; ops/quant.py). The int8 MXU path is the only
+    # single-chip lever above the bf16 roofline (PERF.md round-5
+    # analysis: 0.96 vs 0.48 img/s/chip ceiling on SDXL b8). Image
+    # fidelity under dynamic quantization is UNVALIDATED without real
+    # weights — strictly opt-in, measured by sweep cells c2-int8/c4-int8.
+    unet_int8: bool = False
 
 
 def _default_attention() -> str:
@@ -101,7 +108,8 @@ def _default_decode_bf16() -> bool:
 TPU = Policy(param_dtype=_default_param_dtype(),
              attention_impl=_default_attention(),
              use_remat=_env_flag("SDTPU_REMAT"),
-             decode_in_bf16=_default_decode_bf16())
+             decode_in_bf16=_default_decode_bf16(),
+             unet_int8=_env_flag("SDTPU_UNET_INT8"))
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 

@@ -134,3 +134,67 @@ class TestFlashAttentionStreaming:
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(reference(q, k, v)),
                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+class TestInt8Quant:
+    """Dynamic W8A8 linears (ops/quant.py): numerics vs f32 matmul, exact
+    nn.Dense parameter compatibility, and the UNet flag wiring (the UNet
+    case compiles two full TINY forwards — slow tier)."""
+
+    def test_int8_dot_close_to_f32(self):
+        from stable_diffusion_webui_distributed_tpu.ops.quant import int8_dot
+
+        x = jnp.asarray(RNG.standard_normal((4, 64, 96), np.float32))
+        w = jnp.asarray(RNG.standard_normal((96, 128), np.float32))
+        got = np.asarray(int8_dot(x, w))
+        want = np.asarray(x @ w)
+        cos = (got * want).sum() / (np.linalg.norm(got)
+                                    * np.linalg.norm(want))
+        assert cos > 0.999, cos
+        # 8-bit symmetric quantization error stays proportional to scale
+        rel = np.abs(got - want).mean() / np.abs(want).mean()
+        assert rel < 0.05, rel
+
+    def test_quantdense_param_tree_matches_dense(self):
+        import flax.linen as nn
+
+        from stable_diffusion_webui_distributed_tpu.ops.quant import (
+            QuantDense,
+        )
+
+        x = jnp.zeros((2, 16))
+        dense = nn.Dense(24).init(jax.random.key(0), x)["params"]
+        quant = QuantDense(24).init(jax.random.key(0), x)["params"]
+        assert jax.tree_util.tree_structure(dense) == \
+            jax.tree_util.tree_structure(quant)
+        assert all(
+            a.shape == b.shape
+            for a, b in zip(jax.tree_util.tree_leaves(dense),
+                            jax.tree_util.tree_leaves(quant)))
+        # identical initializers => identical init values: a checkpoint
+        # trained/converted for one loads into the other byte-for-byte
+        for a, b in zip(jax.tree_util.tree_leaves(dense),
+                        jax.tree_util.tree_leaves(quant)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_unet_quant_flag_same_params_close_output(self):
+        from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+        from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+
+        cfg = TINY.unet
+        lat = jnp.asarray(RNG.standard_normal((1, 8, 8, cfg.in_channels),
+                                              np.float32))
+        t = jnp.ones((1,))
+        ctx = jnp.asarray(RNG.standard_normal(
+            (1, 77, cfg.cross_attention_dim), np.float32)) * 0.1
+        base = UNet(cfg)
+        params = base.init(jax.random.key(0), lat, t, ctx)["params"]
+        quant = UNet(cfg, quant_linears=True)
+        # the SAME param tree drives both (checkpoint compatibility)
+        out_f32 = base.apply({"params": params}, lat, t, ctx)
+        out_q = quant.apply({"params": params}, lat, t, ctx)
+        err = np.abs(np.asarray(out_q) - np.asarray(out_f32)).mean()
+        ref = np.abs(np.asarray(out_f32)).mean()
+        assert err / ref < 0.2, (err, ref)  # quantization noise, not garbage
+        assert np.isfinite(np.asarray(out_q)).all()

@@ -36,7 +36,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False):
+def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False,
+            int8=False):
     import jax.numpy as jnp
 
     from stable_diffusion_webui_distributed_tpu.runtime import dtypes
@@ -47,6 +48,7 @@ def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False):
         attention_impl=attention,
         use_remat=remat,
         decode_in_bf16=decode_bf16,
+        unet_int8=int8,
     )
 
 
@@ -82,6 +84,12 @@ CELLS = {
     # this becomes a default
     "c2-decodebf16": (2, {"decode_bf16": True}, 10,
                       {"SDTPU_DECODE_PIXELS": "4194304"}),
+    # dynamic W8A8 transformer linears (ops/quant.py): the int8-MXU lever
+    # from PERF.md's roofline; throughput row only — image fidelity needs
+    # real weights to judge
+    "c2-int8":    (2, {"int8": True}, 10),   # control: c2-chunk10
+    "c4-int8":    (4, {"int8": True}, 10),
+    "c4-chunk10": (4, {}, 10),               # chunk-10 control for c4-int8
 }
 
 DEFAULT_ORDER = [
