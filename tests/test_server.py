@@ -285,6 +285,11 @@ class TestRoutes:
             assert "text/html" in r.headers["Content-Type"]
             body = r.read().decode()
         assert "sdtpu" in body and "/internal/status" in body
+        # pin UX (VERDICT r4 items 6/7): datalist-fed pin input + the
+        # unvalidated-pin warning marker wired into the worker table
+        assert 'list="ew_pin_models"' in body
+        assert 'datalist id="ew_pin_models"' in body
+        assert "pin_validated" in body
 
     def test_internal_status(self, server):
         out = call(server, "/internal/status")
