@@ -26,7 +26,10 @@ import jax
 import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.models.configs import UNetConfig
-from stable_diffusion_webui_distributed_tpu.ops.quant import linear as _linear
+from stable_diffusion_webui_distributed_tpu.ops.quant import (
+    conv as _conv,
+    linear as _linear,
+)
 
 
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
@@ -55,22 +58,24 @@ class GroupNorm32(nn.Module):
 class ResBlock(nn.Module):
     out_channels: int
     dtype: jnp.dtype = jnp.float32
+    quant_convs: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array) -> jax.Array:
+        qc = self.quant_convs
         h = nn.silu(GroupNorm32(name="norm1")(x))
-        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
-                    name="conv1")(h)
+        h = _conv(qc, self.out_channels, padding=1, dtype=self.dtype,
+                  name="conv1")(h)
         t = nn.Dense(self.out_channels, dtype=self.dtype, name="time_proj")(
             nn.silu(temb)
         )
         h = h + t[:, None, None]
         h = nn.silu(GroupNorm32(name="norm2")(h))
-        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
-                    name="conv2")(h)
+        h = _conv(qc, self.out_channels, padding=1, dtype=self.dtype,
+                  name="conv2")(h)
         if x.shape[-1] != self.out_channels:
-            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
-                        name="skip")(x)
+            x = _conv(qc, self.out_channels, (1, 1), padding=0,
+                      dtype=self.dtype, name="skip")(x)
         return (x.astype(jnp.float32) + h.astype(jnp.float32)).astype(self.dtype)
 
 
@@ -212,23 +217,25 @@ class SpatialTransformer(nn.Module):
 class Downsample(nn.Module):
     channels: int
     dtype: jnp.dtype = jnp.float32
+    quant_convs: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        return nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=1,
-                       dtype=self.dtype, name="conv")(x)
+        return _conv(self.quant_convs, self.channels, strides=(2, 2),
+                     padding=1, dtype=self.dtype, name="conv")(x)
 
 
 class Upsample(nn.Module):
     channels: int
     dtype: jnp.dtype = jnp.float32
+    quant_convs: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         B, H, W, C = x.shape
         x = jax.image.resize(x, (B, H * 2, W * 2, C), method="nearest")
-        return nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype,
-                       name="conv")(x)
+        return _conv(self.quant_convs, self.channels, padding=1,
+                     dtype=self.dtype, name="conv")(x)
 
 
 class UNet(nn.Module):
@@ -248,6 +255,10 @@ class UNet(nn.Module):
     # experimental dynamic W8A8 for transformer linears (ops/quant.py;
     # SDTPU_UNET_INT8=1) — the int8-MXU lever from PERF.md's roofline
     quant_linears: bool = False
+    # ...and for the ResBlock/Down/Up convs (SDTPU_UNET_INT8_CONV=1) —
+    # the conv-dominated configs' (#1/#3) half of the same lever;
+    # conv_in/conv_out and the time MLP stay in the policy dtype
+    quant_convs: bool = False
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -292,7 +303,9 @@ class UNet(nn.Module):
         skips = [x]
         for level, (ch, depth) in enumerate(zip(c.block_out_channels, c.down_blocks)):
             for i in range(c.layers_per_block):
-                x = ResBlock(ch, dtype=self.dtype, name=f"down_{level}_res_{i}")(x, temb)
+                x = ResBlock(ch, dtype=self.dtype,
+                             quant_convs=self.quant_convs,
+                             name=f"down_{level}_res_{i}")(x, temb)
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
@@ -301,19 +314,23 @@ class UNet(nn.Module):
                         name=f"down_{level}_attn_{i}")(x, context)
                 skips.append(x)
             if level < len(c.block_out_channels) - 1:
-                x = Downsample(ch, dtype=self.dtype, name=f"down_{level}_ds")(x)
+                x = Downsample(ch, dtype=self.dtype,
+                               quant_convs=self.quant_convs,
+                               name=f"down_{level}_ds")(x)
                 skips.append(x)
 
         # --- mid ---
         mid_ch = c.block_out_channels[-1]
-        x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_0")(x, temb)
+        x = ResBlock(mid_ch, dtype=self.dtype,
+                     quant_convs=self.quant_convs, name="mid_res_0")(x, temb)
         if c.mid_block_depth is not None:
             x = SpatialTransformer(
                 c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
                 self.dtype, self.attention_impl, self.mesh,
                 quant_linears=self.quant_linears,
                 name="mid_attn")(x, context)
-        x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
+        x = ResBlock(mid_ch, dtype=self.dtype,
+                     quant_convs=self.quant_convs, name="mid_res_1")(x, temb)
 
         # ControlNet residual injection: one residual per skip + one for the
         # mid block output (the standard ControlNet contract; the reference
@@ -333,7 +350,9 @@ class UNet(nn.Module):
             depth = c.down_blocks[level]
             for i in range(c.layers_per_block + 1):
                 x = jnp.concatenate([x, skips.pop()], axis=-1)
-                x = ResBlock(ch, dtype=self.dtype, name=f"up_{level}_res_{i}")(x, temb)
+                x = ResBlock(ch, dtype=self.dtype,
+                             quant_convs=self.quant_convs,
+                             name=f"up_{level}_res_{i}")(x, temb)
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
@@ -341,7 +360,9 @@ class UNet(nn.Module):
                         quant_linears=self.quant_linears,
                         name=f"up_{level}_attn_{i}")(x, context)
             if level > 0:
-                x = Upsample(ch, dtype=self.dtype, name=f"up_{level}_us")(x)
+                x = Upsample(ch, dtype=self.dtype,
+                             quant_convs=self.quant_convs,
+                             name=f"up_{level}_us")(x)
         assert not skips, f"{len(skips)} unconsumed skip connections"
 
         x = nn.silu(GroupNorm32(name="norm_out")(x))

@@ -83,3 +83,75 @@ def linear(quant: bool, features: int, *, use_bias: bool = True,
     under the same parameter names."""
     cls = QuantDense if quant else nn.Dense
     return cls(features, use_bias=use_bias, dtype=dtype, name=name)
+
+
+def int8_conv(x: jax.Array, kernel: jax.Array, *, strides=(1, 1),
+              padding, eps: float = 1e-8):
+    """Dynamic symmetric W8A8 NHWC conv with int32 accumulation.
+
+    x: (B, H, W, Cin) float; kernel: (kh, kw, Cin, Cout) — flax layout.
+    Per-IMAGE activation scales (max-abs over H, W, C — spatial weight
+    sharing means one scale per image, not per pixel) and per-output-
+    channel weight scales. Symmetric quant maps 0 -> 0, so zero padding
+    is exact. Returns f32 (B, H', W', Cout).
+    """
+    xf = x.astype(jnp.float32)
+    kf = kernel.astype(jnp.float32)
+    s_x = jnp.max(jnp.abs(xf), axis=(1, 2, 3), keepdims=True) / 127.0 + eps
+    s_w = jnp.max(jnp.abs(kf), axis=(0, 1, 2), keepdims=True) / 127.0 + eps
+    xq = jnp.round(xf / s_x).astype(jnp.int8)
+    wq = jnp.round(kf / s_w).astype(jnp.int8)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, window_strides=strides, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * s_x * s_w.reshape(1, 1, 1, -1)
+
+
+class QuantConv(nn.Module):
+    """Drop-in for ``nn.Conv`` (NHWC, HWIO) with the int8 forward.
+
+    Parameter tree matches ``nn.Conv`` (kernel (kh, kw, in, out) via
+    lecun_normal, bias zeros) so checkpoints swap freely. Supports the
+    subset the UNet/VAE use: 2-D kernels, strides, int or explicit-pair
+    padding; no dilation/groups/masking.
+    """
+
+    features: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: object = 0
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features))
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad), (pad, pad)]
+        elif isinstance(pad, (tuple, list)) and pad and \
+                not isinstance(pad[0], (tuple, list)):
+            pad = [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
+                   for p in pad]
+        out = int8_conv(x, kernel, strides=tuple(self.strides), padding=pad)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,))
+            out = out + bias.astype(jnp.float32)
+        return out.astype(self.dtype)
+
+
+def conv(quant: bool, features: int, kernel_size=(3, 3), *, strides=(1, 1),
+         padding=1, dtype=jnp.float32, name: str):
+    """ResBlock/Down/Up conv factory: ``nn.Conv`` or ``QuantConv`` under
+    the same parameter names."""
+    if quant:
+        return QuantConv(features, tuple(kernel_size),
+                         strides=tuple(strides), padding=padding,
+                         dtype=dtype, name=name)
+    return nn.Conv(features, tuple(kernel_size), strides=tuple(strides),
+                   padding=padding, dtype=dtype, name=name)

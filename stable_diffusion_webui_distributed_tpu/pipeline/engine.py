@@ -146,7 +146,9 @@ class Engine:
                          attention_impl=attn_impl,
                          use_remat=policy.use_remat,
                          mesh=attn_mesh,
-                         quant_linears=getattr(policy, "unet_int8", False))
+                         quant_linears=getattr(policy, "unet_int8", False),
+                         quant_convs=getattr(policy, "unet_int8_conv",
+                                             False))
         vae_cfg = family.vae
         if getattr(policy, "decode_in_bf16", False) and \
                 vae_cfg.force_decoder_f32:

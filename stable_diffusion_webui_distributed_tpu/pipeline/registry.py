@@ -33,11 +33,16 @@ class ModelRegistry:
 
     def __init__(self, model_dir: str = "models",
                  policy: dtypes.Policy = dtypes.TPU,
-                 chunk_size: int = 10,
+                 chunk_size: Optional[int] = None,
                  state=None,
                  mesh=None):
         self.model_dir = model_dir
         self.policy = policy
+        # SDTPU_CHUNK tunes the denoise chunk in SERVER/CLI deployments
+        # too, not just bench.py — the README documents it as a policy
+        # knob; the sweep-measured default is 10 (PERF.md)
+        if chunk_size is None:
+            chunk_size = int(os.environ.get("SDTPU_CHUNK", "10"))
         self.chunk_size = chunk_size
         self.state = state
         self.mesh = mesh

@@ -40,6 +40,10 @@ class Policy:
     # fidelity under dynamic quantization is UNVALIDATED without real
     # weights — strictly opt-in, measured by sweep cells c2-int8/c4-int8.
     unet_int8: bool = False
+    # ...and the same lever for the ResBlock/Down/Up convs
+    # (SDTPU_UNET_INT8_CONV=1) — configs #1/#3 are conv-dominated, so
+    # int8 linears alone barely move them. Same opt-in caveats.
+    unet_int8_conv: bool = False
 
 
 def _default_attention() -> str:
@@ -109,7 +113,8 @@ TPU = Policy(param_dtype=_default_param_dtype(),
              attention_impl=_default_attention(),
              use_remat=_env_flag("SDTPU_REMAT"),
              decode_in_bf16=_default_decode_bf16(),
-             unet_int8=_env_flag("SDTPU_UNET_INT8"))
+             unet_int8=_env_flag("SDTPU_UNET_INT8"),
+             unet_int8_conv=_env_flag("SDTPU_UNET_INT8_CONV"))
 #: Full-f32 policy for numerics tests on CPU.
 F32 = Policy(compute_dtype=jnp.dtype(jnp.float32))
 

@@ -198,3 +198,74 @@ class TestInt8Quant:
         ref = np.abs(np.asarray(out_f32)).mean()
         assert err / ref < 0.2, (err, ref)  # quantization noise, not garbage
         assert np.isfinite(np.asarray(out_q)).all()
+
+
+@pytest.mark.slow
+class TestInt8Conv:
+    """Dynamic W8A8 convs (ops/quant.py QuantConv): numerics, exact
+    nn.Conv parameter compatibility, and the quant_convs UNet flag."""
+
+    def test_int8_conv_close_to_f32(self):
+        from stable_diffusion_webui_distributed_tpu.ops.quant import (
+            int8_conv,
+        )
+
+        x = jnp.asarray(RNG.standard_normal((2, 16, 16, 8), np.float32))
+        w = jnp.asarray(RNG.standard_normal((3, 3, 8, 12), np.float32))
+        got = np.asarray(int8_conv(x, w, padding=[(1, 1), (1, 1)]))
+        want = np.asarray(jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        cos = (got * want).sum() / (np.linalg.norm(got)
+                                    * np.linalg.norm(want))
+        assert cos > 0.999, cos
+
+    def test_quantconv_param_tree_matches_conv(self):
+        import flax.linen as nn
+
+        from stable_diffusion_webui_distributed_tpu.ops.quant import (
+            QuantConv,
+        )
+
+        x = jnp.zeros((1, 8, 8, 4))
+        ref = nn.Conv(6, (3, 3), padding=1).init(jax.random.key(0), x)[
+            "params"]
+        qnt = QuantConv(6, (3, 3), padding=1).init(jax.random.key(0), x)[
+            "params"]
+        assert jax.tree_util.tree_structure(ref) == \
+            jax.tree_util.tree_structure(qnt)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(qnt)):
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_strided_matches_downsample_shape(self):
+        from stable_diffusion_webui_distributed_tpu.ops.quant import (
+            QuantConv,
+        )
+
+        x = jnp.asarray(RNG.standard_normal((1, 16, 16, 4), np.float32))
+        mod = QuantConv(4, (3, 3), strides=(2, 2), padding=1)
+        params = mod.init(jax.random.key(1), x)["params"]
+        out = mod.apply({"params": params}, x)
+        assert out.shape == (1, 8, 8, 4)
+
+    def test_unet_quant_convs_same_params_close_output(self):
+        from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+        from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+
+        cfg = TINY.unet
+        lat = jnp.asarray(RNG.standard_normal((1, 8, 8, cfg.in_channels),
+                                              np.float32))
+        t = jnp.ones((1,))
+        ctx = jnp.asarray(RNG.standard_normal(
+            (1, 77, cfg.cross_attention_dim), np.float32)) * 0.1
+        base = UNet(cfg)
+        params = base.init(jax.random.key(0), lat, t, ctx)["params"]
+        quant = UNet(cfg, quant_linears=True, quant_convs=True)
+        out_f32 = base.apply({"params": params}, lat, t, ctx)
+        out_q = quant.apply({"params": params}, lat, t, ctx)
+        err = np.abs(np.asarray(out_q) - np.asarray(out_f32)).mean()
+        ref = np.abs(np.asarray(out_f32)).mean()
+        assert err / ref < 0.35, (err, ref)
+        assert np.isfinite(np.asarray(out_q)).all()

@@ -118,3 +118,19 @@ class TestRegistry:
         restored = engine.txt2img(p).images[0]
         assert restored == base
         assert not reg.set_vae("nonexistent")
+
+
+class TestChunkKnob:
+    def test_sdtpu_chunk_env_reaches_engines(self, monkeypatch, tmp_path):
+        """README documents SDTPU_CHUNK as a deployment knob — the registry
+        (server/CLI engine factory) must honor it, not just bench.py."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.registry import (
+            ModelRegistry,
+        )
+
+        monkeypatch.setenv("SDTPU_CHUNK", "7")
+        reg = ModelRegistry(str(tmp_path))
+        assert reg.chunk_size == 7
+        # explicit argument still wins
+        reg2 = ModelRegistry(str(tmp_path), chunk_size=3)
+        assert reg2.chunk_size == 3

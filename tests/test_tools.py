@@ -1,0 +1,137 @@
+"""Tests for the chip-window tooling: the relay triage (the round-5
+diagnosis layer bench.py's rc=3 reporting depends on) and the sweep's
+wedge contract. All socket behavior is synthesized locally — no TPU, no
+relay, no jax."""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+import tpu_claim_probe  # noqa: E402  (tools/ on path)
+
+
+class _FakeRelay:
+    """A localhost listener with pluggable accept behavior."""
+
+    def __init__(self, mode):
+        self.mode = mode            # "dead" = accept+close, "alive" = hold
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._held = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            if self.mode == "dead":
+                conn.close()        # instant EOF — the round-5 wedge
+            else:
+                self._held.append(conn)  # hold open like a live server
+
+    def close(self):
+        self._stop.set()
+        self.thread.join(timeout=2)
+        for c in self._held:
+            c.close()
+        self.sock.close()
+
+
+@pytest.fixture
+def patch_ports(monkeypatch):
+    def _patch(port):
+        monkeypatch.setattr(tpu_claim_probe, "RELAY_PORTS", (port,))
+    return _patch
+
+
+class TestTriage:
+    def test_relay_dead_detected(self, patch_ports):
+        relay = _FakeRelay("dead")
+        try:
+            patch_ports(relay.port)
+            out = tpu_claim_probe.triage_relay(peek_s=1.0)
+            entry = out[relay.port]
+            assert entry["connect"] is True
+            assert entry["instant_eof"] is True
+            res = tpu_claim_probe.diagnose(triage_only=True)
+            assert res["verdict"] == "relay-dead"
+        finally:
+            relay.close()
+
+    def test_relay_alive_holds_connection(self, patch_ports):
+        relay = _FakeRelay("alive")
+        try:
+            patch_ports(relay.port)
+            out = tpu_claim_probe.triage_relay(peek_s=0.5)
+            entry = out[relay.port]
+            assert entry["connect"] is True
+            assert entry["instant_eof"] is False
+            res = tpu_claim_probe.diagnose(triage_only=True)
+            assert res["verdict"] == "relay-alive-unprobed"
+        finally:
+            relay.close()
+
+    def test_relay_down_detected(self, patch_ports):
+        # grab a port, then close it so nothing is listening
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        patch_ports(port)
+        res = tpu_claim_probe.diagnose(triage_only=True)
+        assert res["verdict"] == "relay-down"
+
+    def test_cli_exit_codes(self):
+        """SDTPU_PROBE_PORTS points the REAL CLI at the synthetic dead
+        relay: the rc=7 relay-dead path is pinned end-to-end."""
+        relay = _FakeRelay("dead")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "tools/tpu_claim_probe.py", "--triage-only",
+                 "--json"],
+                capture_output=True, text=True,
+                env={"PATH": "/usr/bin:/bin",
+                     "SDTPU_PROBE_PORTS": str(relay.port)})
+        finally:
+            relay.close()
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["verdict"] == "relay-dead", (out, proc.stderr)
+        assert proc.returncode == 7
+        assert out["relay"][str(relay.port)]["instant_eof"] is True
+
+
+class TestSweepWedgeContract:
+    def test_is_wedge_classification(self):
+        sys.path.insert(0, "tools")
+        import sweep
+
+        assert sweep._is_wedge({}, 3) is True            # init watchdog
+        assert sweep._is_wedge(
+            {"error": "ConnectionError: Connection refused"}, 1) is True
+        assert sweep._is_wedge({"error": "relay wedged mid-claim"}, 1) is True
+        assert sweep._is_wedge({"error": "assert 2 == 3"}, 1) is False
+        assert sweep._is_wedge({"value": 27.0}, 0) is False
+
+    def test_cells_unpack(self):
+        import sweep
+
+        for name, cell in sweep.CELLS.items():
+            cfg_n, pol_kwargs, chunk, *rest = cell
+            assert 1 <= cfg_n <= 5, name
+            assert isinstance(pol_kwargs, dict), name
+            assert chunk > 0, name
+            if rest:
+                assert isinstance(rest[0], dict), name

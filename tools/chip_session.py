@@ -67,7 +67,13 @@ WEDGE = "wedge"  # phase outcome that must stop ALL further chip probing
 
 
 def phase_sweep(deadline):
-    cells = ["c1-chunk10", "c3-bf16", "c2-chunk10", "c2-flash", "c4-bf16"]
+    # every round-5 lever cell (PERF.md "levers implemented" table), in
+    # priority order — the sweep's own deadline gate trims the tail if
+    # the window is short; the c5 cells run in phase_c5 (they need the
+    # prewarm choreography)
+    cells = ["c1-chunk10", "c3-bf16", "c2-chunk10", "c2-flash", "c4-bf16",
+             "c2-int8", "c2-decodebf16", "c4-chunk10", "c4-int8",
+             "c1-int8", "c3-chunk10", "c3-int8"]
     # leave the later phases (trace/c5/hetero) at least 25 min of window
     budget = max(300, int(deadline - time.time() - 1500))
     env = dict(os.environ, SDTPU_SWEEP_DEADLINE=str(budget))

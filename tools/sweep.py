@@ -37,7 +37,7 @@ sys.path.insert(0, _REPO)
 
 
 def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False,
-            int8=False):
+            int8=False, int8_conv=False):
     import jax.numpy as jnp
 
     from stable_diffusion_webui_distributed_tpu.runtime import dtypes
@@ -49,6 +49,7 @@ def _policy(param="bf16", attention="xla", remat=False, decode_bf16=False,
         use_remat=remat,
         decode_in_bf16=decode_bf16,
         unet_int8=int8,
+        unet_int8_conv=int8_conv,
     )
 
 
@@ -90,6 +91,11 @@ CELLS = {
     "c2-int8":    (2, {"int8": True}, 10),   # control: c2-chunk10
     "c4-int8":    (4, {"int8": True}, 10),
     "c4-chunk10": (4, {}, 10),               # chunk-10 control for c4-int8
+    # conv-dominated configs want the conv half of the int8 lever too
+    # (chunk-10 controls: c1-chunk10 / c3-chunk10)
+    "c1-int8":    (1, {"int8": True, "int8_conv": True}, 10),
+    "c3-int8":    (3, {"int8": True, "int8_conv": True}, 10),
+    "c3-chunk10": (3, {}, 10),
 }
 
 DEFAULT_ORDER = [

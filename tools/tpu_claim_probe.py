@@ -56,7 +56,11 @@ import time
 # The loopback relay's front door (observed: the only listener in this
 # container; the claim leg's Redirect is rewritten to 127.0.0.1 by
 # AXON_LOOPBACK_RELAY=1 — see the baked sitecustomize).
-RELAY_PORTS = (2024,)
+# SDTPU_PROBE_PORTS overrides (comma-separated) — tests point it at
+# synthetic listeners to pin each verdict path.
+RELAY_PORTS = tuple(
+    int(p) for p in os.environ.get("SDTPU_PROBE_PORTS", "2024").split(",")
+    if p.strip())
 
 _CHILD_SRC = r"""
 import os, sys, time, uuid
