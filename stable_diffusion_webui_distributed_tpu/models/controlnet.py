@@ -26,7 +26,6 @@ import numpy as np
 
 from stable_diffusion_webui_distributed_tpu.models.configs import UNetConfig
 from stable_diffusion_webui_distributed_tpu.models.unet import (
-    GroupNorm32,
     ResBlock,
     SpatialTransformer,
     Downsample,

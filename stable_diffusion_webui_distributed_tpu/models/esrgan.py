@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import functools
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ TP rules (applied by param-path pattern, the Megatron split):
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 

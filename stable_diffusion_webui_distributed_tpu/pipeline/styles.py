@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 
 def load_styles(path: str) -> Dict[str, Tuple[str, str]]:

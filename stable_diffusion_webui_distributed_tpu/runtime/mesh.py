@@ -15,7 +15,7 @@ registered at runtime/flags.py:33-38).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 

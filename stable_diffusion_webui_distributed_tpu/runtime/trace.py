@@ -14,7 +14,7 @@ import contextlib
 import threading
 import time
 from collections import defaultdict, deque
-from typing import Deque, Dict, Iterator, Optional, Tuple
+from typing import Deque, Dict, Iterator, Optional
 
 
 class StageStats:

@@ -19,7 +19,7 @@ worker.py:75-94): "Euler a", "Euler", "Heun", "DDIM", "DPM++ 2M",
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, NamedTuple, Optional, Tuple
+from typing import Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -25,7 +25,7 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
+from typing import Any, Dict, List, Optional, Protocol, Tuple
 
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
