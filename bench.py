@@ -46,6 +46,12 @@ import time
 
 NOMINAL_SINGLE_GPU_IPM = 30.0
 
+
+def tiny_env() -> bool:
+    """One shared parse of SDTPU_BENCH_TINY (bench, sweep, chip_session):
+    tiny mode is a CPU logic-check, never a perf claim."""
+    return os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+
 # bf16 peak FLOPs/s per chip, by device_kind substring (public specs).
 _PEAK_FLOPS = {
     "v6e": 918e12, "v6": 918e12,
@@ -559,7 +565,7 @@ def main() -> None:
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
     # (same protocol and code path, tiny models + payloads; NOT a perf claim).
-    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+    tiny = tiny_env()
 
     # Real-chip runs go through the probe-twice-with-cooldown parent (the
     # retry only matters for a wedged TPU claim; tiny/CPU runs skip it).

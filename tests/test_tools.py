@@ -135,3 +135,64 @@ class TestSweepWedgeContract:
             assert chunk > 0, name
             if rest:
                 assert isinstance(rest[0], dict), name
+
+
+@pytest.mark.slow
+class TestSweepRehearsal:
+    """End-to-end rehearsal of the sweep machinery on CPU tiny mode: the
+    subprocess choreography, SWEEP_ROW parsing, and jsonl append are the
+    exact code path a chip window runs — validated here instead of being
+    first exercised on scarce silicon."""
+
+    def test_one_cell_tiny(self, tmp_path):
+        import os
+
+        out_file = tmp_path / "sweep_out.jsonl"
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(JAX_PLATFORMS="cpu", SDTPU_BENCH_TINY="1",
+                   SDTPU_SWEEP_OUT=str(out_file),
+                   SDTPU_SWEEP_DEADLINE="3000")
+        proc = subprocess.run(
+            [sys.executable, "tools/sweep.py", "c1-bf16"],
+            capture_output=True, text=True, env=env, timeout=900)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        rows = [json.loads(l) for l in out_file.read_text().splitlines()]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["cell"] == "c1-bf16"
+        assert row.get("value"), row      # a real ipm number came through
+        assert row["unit"] == "images/min"
+        assert "wall_s" in row
+
+
+@pytest.mark.slow
+class TestChipSessionTraceRehearsal:
+    """chip_session's profiler-trace phase, rehearsed on CPU tiny mode:
+    produces PERF_TRACE_C2.md with the per-stage table and a TensorBoard
+    trace dir — the exact artifact the north-star breakdown needs."""
+
+    def test_trace_phase_tiny(self, tmp_path):
+        import os
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(JAX_PLATFORMS="cpu", SDTPU_BENCH_TINY="1",
+                   SDTPU_REPO=os.getcwd(),
+                   SDTPU_TRACE_OUT=str(tmp_path))  # never touch the repo
+        import chip_session
+
+        proc = subprocess.run(
+            [sys.executable, "-c", chip_session._TRACE_CHILD], env=env,
+            capture_output=True, text=True, timeout=600)
+        assert "TRACE_OK" in proc.stdout, (proc.stdout[-500:],
+                                           proc.stderr[-1500:])
+        md = (tmp_path / "PERF_TRACE_C2.md").read_text()
+        assert "| stage |" in md
+        assert "img/s/chip" in md
+        # tiny artifacts self-identify so they can never masquerade as
+        # silicon evidence
+        assert "TINY LOGIC-CHECK" in md and "NOT a perf claim" in md
+        assert (tmp_path / "traces" / "c2").is_dir()
+        # and nothing leaked into the repo
+        assert not os.path.exists("PERF_TRACE_C2.md")

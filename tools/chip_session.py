@@ -100,23 +100,33 @@ jax.devices()
 init_done.set()
 enable_compilation_cache()
 
-metric, engine, payload, segments, rel = bench._build_config(2, False)
+tiny = bench.tiny_env()
+# SDTPU_TRACE_OUT: artifact root override so tiny-mode rehearsals (tests)
+# never overwrite silicon evidence at the repo root
+out_root = os.environ.get("SDTPU_TRACE_OUT", os.environ["SDTPU_REPO"])
+metric, engine, payload, segments, rel = bench._build_config(2, tiny)
 run = engine.img2img if payload.init_images else engine.txt2img
 t0 = time.time(); run(payload)          # warmup (compiles)
 print(f"trace: warmup {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
 trace.STATS.clear()
-out_dir = os.path.join(os.environ["SDTPU_REPO"], "traces", "c2")
+out_dir = os.path.join(out_root, "traces", "c2")
 os.makedirs(out_dir, exist_ok=True)
 with trace.capture(out_dir):
     t0 = time.time(); result = run(payload); wall = time.time() - t0
 stages = trace.STATS.summary()
-md = ["# Config #2 (SDXL base+refiner 1024² b8) — profiled stage table",
-      "", f"- device: {jax.devices()[0].device_kind}",
-      f"- request wall: {wall:.2f}s for {len(result.images)} images "
-      f"({len(result.images)/wall:.3f} img/s/chip)",
-      f"- jax.profiler trace: traces/c2/ (TensorBoard-loadable)", "",
-      "| stage | p50 | mean | count | est. total (mean*count) |",
-      "|---|---|---|---|---|"]
+title = ("# Config #2 TINY LOGIC-CHECK (" + metric + ") — NOT a perf claim"
+         if tiny else
+         "# Config #2 (SDXL base+refiner 1024² b8) — profiled stage table")
+md = [title, ""]
+if tiny:
+    md += ["**MODE: tiny CPU rehearsal — stage table plumbing only; no "
+           "number below is a silicon measurement.**", ""]
+md += [f"- device: {jax.devices()[0].device_kind}",
+       f"- request wall: {wall:.2f}s for {len(result.images)} images "
+       f"({len(result.images)/wall:.3f} img/s/chip)",
+       f"- jax.profiler trace: traces/c2/ (TensorBoard-loadable)", "",
+       "| stage | p50 | mean | count | est. total (mean*count) |",
+       "|---|---|---|---|---|"]
 for name, s in sorted(stages.items(),
                       key=lambda kv: -kv[1]["mean"] * kv[1]["count"]):
     md.append(f"| {name} | {s['p50']*1e3:.1f} ms | {s['mean']*1e3:.1f} ms "
@@ -125,7 +135,7 @@ md.append("")
 md.append(f"Unaccounted (dispatch gaps/host): "
           f"{wall - sum(s['mean']*s['count'] for s in stages.values()):.2f}s "
           f"of {wall:.2f}s wall")
-open(os.path.join(os.environ["SDTPU_REPO"], "PERF_TRACE_C2.md"),
+open(os.path.join(out_root, "PERF_TRACE_C2.md"),
      "w").write("\n".join(md) + "\n")
 print("TRACE_OK " + json.dumps({"wall_s": round(wall, 2),
                                 "images": len(result.images)}), flush=True)

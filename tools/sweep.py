@@ -148,8 +148,13 @@ def run_cell(name):
     for key, val in (rest[0] if rest else {}).items():
         os.environ[key] = val
 
+    # SDTPU_BENCH_TINY=1 rehearses the whole sweep machinery (subprocess
+    # choreography, row parsing, jsonl append, wedge contract) on CPU
+    # with tiny models — the measurement plumbing is validated by tests,
+    # not first exercised during a scarce chip window
+    tiny = bench.tiny_env()
     t0 = time.time()
-    out = bench.run_config(cfg_n, tiny=False)
+    out = bench.run_config(cfg_n, tiny=tiny)
     out["cell"] = name
     out["wall_s"] = round(time.time() - t0, 1)
     return out
@@ -183,7 +188,13 @@ def main():
     os.environ.setdefault("SDTPU_BENCH_INIT_TIMEOUT", "240")
     deadline = time.time() + float(
         os.environ.get("SDTPU_SWEEP_DEADLINE", "3300"))
-    out_path = os.path.join(_REPO, "PERF_SWEEP.jsonl")
+    # SDTPU_SWEEP_OUT overrides the result file; tiny-mode rehearsals
+    # additionally DEFAULT away from the silicon record, so forgetting the
+    # override can never mix logic-check rows into PERF_SWEEP.jsonl
+    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+    default_name = "PERF_SWEEP_TINY.jsonl" if tiny else "PERF_SWEEP.jsonl"
+    out_path = os.environ.get("SDTPU_SWEEP_OUT",
+                              os.path.join(_REPO, default_name))
 
     for name in cells:
         if time.time() > deadline - 120:
