@@ -269,3 +269,35 @@ class TestInt8Conv:
         ref = np.abs(np.asarray(out_f32)).mean()
         assert err / ref < 0.35, (err, ref)
         assert np.isfinite(np.asarray(out_q)).all()
+
+
+@pytest.mark.slow
+class TestInt8LoraInterop:
+    """LoRA merges mutate the SAME kernel params QuantDense reads at call
+    time (dynamic quantization has no stored scales), so a merged adapter
+    must change the int8 path's output exactly like the f32 path's."""
+
+    def test_merged_lora_affects_int8_forward(self):
+        from stable_diffusion_webui_distributed_tpu.models import (
+            lora as lora_mod,
+        )
+        from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+        from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+        from test_adapters import make_lora_sd
+
+        cfg = TINY.unet
+        lat = jnp.asarray(RNG.standard_normal((1, 8, 8, cfg.in_channels),
+                                              np.float32))
+        t = jnp.ones((1,))
+        ctx = jnp.asarray(RNG.standard_normal(
+            (1, 77, cfg.cross_attention_dim), np.float32)) * 0.1
+        base = UNet(cfg)
+        params = base.init(jax.random.key(0), lat, t, ctx)["params"]
+        merged, applied, _ = lora_mod.merge_lora(
+            {"unet": params, "text_encoder": {}}, make_lora_sd(), 1.0, TINY)
+        assert applied > 0
+        quant = UNet(cfg, quant_linears=True)
+        out_base = quant.apply({"params": params}, lat, t, ctx)
+        out_merged = quant.apply({"params": merged["unet"]}, lat, t, ctx)
+        assert not np.allclose(np.asarray(out_base),
+                               np.asarray(out_merged))

@@ -187,12 +187,14 @@ class TestChipSessionTraceRehearsal:
             capture_output=True, text=True, timeout=600)
         assert "TRACE_OK" in proc.stdout, (proc.stdout[-500:],
                                            proc.stderr[-1500:])
-        md = (tmp_path / "PERF_TRACE_C2.md").read_text()
+        md = (tmp_path / "PERF_TRACE_C2_TINY.md").read_text()
         assert "| stage |" in md
         assert "img/s/chip" in md
         # tiny artifacts self-identify so they can never masquerade as
         # silicon evidence
         assert "TINY LOGIC-CHECK" in md and "NOT a perf claim" in md
-        assert (tmp_path / "traces" / "c2").is_dir()
-        # and nothing leaked into the repo
-        assert not os.path.exists("PERF_TRACE_C2.md")
+        assert (tmp_path / "traces" / "c2-tiny").is_dir()
+        # and no tiny artifact leaked into the repo (the real
+        # PERF_TRACE_C2.md may legitimately exist after a chip window)
+        assert not os.path.exists("PERF_TRACE_C2_TINY.md")
+        assert not os.path.isdir(os.path.join("traces", "c2-tiny"))

@@ -109,7 +109,10 @@ run = engine.img2img if payload.init_images else engine.txt2img
 t0 = time.time(); run(payload)          # warmup (compiles)
 print(f"trace: warmup {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
 trace.STATS.clear()
-out_dir = os.path.join(out_root, "traces", "c2")
+# tiny artifacts get DISTINCT names even at a default out_root, so a
+# rehearsal can never clobber a prior chip window's silicon evidence
+suffix = "-tiny" if tiny else ""
+out_dir = os.path.join(out_root, "traces", "c2" + suffix)
 os.makedirs(out_dir, exist_ok=True)
 with trace.capture(out_dir):
     t0 = time.time(); result = run(payload); wall = time.time() - t0
@@ -135,8 +138,8 @@ md.append("")
 md.append(f"Unaccounted (dispatch gaps/host): "
           f"{wall - sum(s['mean']*s['count'] for s in stages.values()):.2f}s "
           f"of {wall:.2f}s wall")
-open(os.path.join(out_root, "PERF_TRACE_C2.md"),
-     "w").write("\n".join(md) + "\n")
+open(os.path.join(out_root, "PERF_TRACE_C2_TINY.md" if tiny
+                  else "PERF_TRACE_C2.md"), "w").write("\n".join(md) + "\n")
 print("TRACE_OK " + json.dumps({"wall_s": round(wall, 2),
                                 "images": len(result.images)}), flush=True)
 """

@@ -191,7 +191,9 @@ def main():
     # SDTPU_SWEEP_OUT overrides the result file; tiny-mode rehearsals
     # additionally DEFAULT away from the silicon record, so forgetting the
     # override can never mix logic-check rows into PERF_SWEEP.jsonl
-    tiny = os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
+    import bench  # no jax at import time; same parse as run_cell
+
+    tiny = bench.tiny_env()
     default_name = "PERF_SWEEP_TINY.jsonl" if tiny else "PERF_SWEEP.jsonl"
     out_path = os.environ.get("SDTPU_SWEEP_OUT",
                               os.path.join(_REPO, default_name))
