@@ -398,6 +398,7 @@ class TestTokenizer:
         assert (tok(["other"]) != a).any()
 
 
+@pytest.mark.slow
 class TestDecodeDtypePolicy:
     """SDTPU_DECODE_DTYPE=bf16 (Policy.decode_in_bf16): decoder convs drop
     to bf16 while GroupNorm statistics and the final conv_out stay f32 —

@@ -301,3 +301,27 @@ class TestInt8LoraInterop:
         out_merged = quant.apply({"params": merged["unet"]}, lat, t, ctx)
         assert not np.allclose(np.asarray(out_base),
                                np.asarray(out_merged))
+
+
+@pytest.mark.slow
+class TestInt8UnderMesh:
+    """int8_dot under GSPMD: per-token activation scales and per-channel
+    weight scales must compose with dp/tp shardings (multi-chip int8 is
+    how the roofline lever scales past one chip)."""
+
+    def test_int8_dot_sharded_matches_single_device(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from stable_diffusion_webui_distributed_tpu.ops.quant import int8_dot
+
+        x = jnp.asarray(RNG.standard_normal((8, 32, 64), np.float32))
+        w = jnp.asarray(RNG.standard_normal((64, 96), np.float32))
+        want = np.asarray(int8_dot(x, w))
+        xs = jax.device_put(x, NamedSharding(mesh8, P("dp", None, None)))
+        ws = jax.device_put(w, NamedSharding(mesh8, P(None, "tp")))
+        got = np.asarray(jax.jit(int8_dot)(xs, ws))
+        # dp shards tokens (per-token scales are token-local: exact);
+        # tp shards output channels (per-channel scales channel-local:
+        # exact) — the sharded result must match bit-for-bit up to XLA
+        # reduction-order noise in the int32->f32 rescale
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
