@@ -934,16 +934,14 @@ class Engine:
         # sdapi payloads the reference forwards.
         ensd = int((payload.override_settings or {})
                    .get("eta_noise_seed_delta", 0) or 0)
-        seed = payload.seed + ensd
-        idx = jnp.arange(batch, dtype=jnp.uint32) + jnp.uint32(start)
-        if payload.subseed_strength > 0 or payload.same_seed:
-            # Variation batches and same-seed (prompt-matrix) batches:
-            # the base key is fixed (see runtime/rng.py).
-            return jax.vmap(
-                lambda i: rng.key_for_image(seed, jnp.uint32(0))
-            )(idx)
-        return jax.vmap(
-            lambda i: rng.key_for_image(seed, i))(idx)
+        # wrap like a 32-bit seed register: seed+ENSD can leave uint32
+        # range (seed near 2**32 with the community ENSD 31337, or a
+        # negative ENSD) and the host-side uint32 cast would raise
+        seed = (payload.seed + ensd) % (2 ** 32)
+        # variation/same-seed batches pin every key to image 0
+        # (see runtime/rng.py); jitted — one dispatch, not an eager vmap
+        pin = payload.subseed_strength > 0 or payload.same_seed
+        return rng.batch_keys(seed, start, batch, pin_index=pin)
 
     def _group_conds(self, payload, pos, gen_n, refiner):
         """Per-image conditioning for images [pos, pos+gen_n) of a request

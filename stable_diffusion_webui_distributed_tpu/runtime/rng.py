@@ -19,6 +19,7 @@ batch explores the neighbourhood of ONE base noise. The init noise is
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -95,8 +96,27 @@ def batch_noise(
     "from" resolution and pasted centered into the target latent — the
     uncovered border stays zero, exactly webui's quirk — so one seed keeps
     its composition across aspect-ratio changes.
+
+    Jitted (seeds/strength/start are data; batch/shape/resize/pin key the
+    executable): the eager vmap-of-cond form cost ~1.9 s of host tracing
+    per request and, on TPU, dispatched each tiny op through the relay
+    (~50 ms/op, PERF.md "relay lessons"). One compiled call per
+    (batch, shape) bucket instead.
     """
-    idx = jnp.arange(batch_size, dtype=jnp.uint32) + jnp.asarray(start_index, jnp.uint32)
+    # cast seeds on the host: webui seeds span the full uint32 range, which
+    # overflows jit's default int32 argument conversion
+    return _batch_noise_jit(
+        jnp.asarray(seed, jnp.uint32), jnp.asarray(subseed, jnp.uint32),
+        subseed_strength, jnp.asarray(start_index, jnp.uint32),
+        int(batch_size), tuple(shape), jnp.dtype(dtype),
+        tuple(seed_resize) if seed_resize is not None else None,
+        bool(pin_index))
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+def _batch_noise_jit(seed, subseed, subseed_strength, start_index,
+                     batch_size, shape, dtype, seed_resize, pin_index):
+    idx = jnp.arange(batch_size, dtype=jnp.uint32) + start_index
     if pin_index:
         idx = jnp.zeros_like(idx)
     if seed_resize is None:
@@ -111,6 +131,25 @@ def batch_noise(
                                   from_shape, dtype)
     )(idx)
     return _paste_centered(noise, (batch_size,) + tuple(shape), dtype)
+
+
+def batch_keys(seed, start_index, batch_size: int,
+               pin_index: bool = False) -> jax.Array:
+    """Per-image PRNG keys for images [start, start+batch) — the jitted
+    companion of :func:`batch_noise` for sampler-noise keys (same eager-
+    dispatch concern; ``pin_index`` fixes every key to image 0 for
+    variation/same-seed batches)."""
+    return _batch_keys_jit(jnp.asarray(seed, jnp.uint32),
+                           jnp.asarray(start_index, jnp.uint32),
+                           int(batch_size), bool(pin_index))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _batch_keys_jit(seed, start_index, batch_size, pin_index):
+    idx = jnp.arange(batch_size, dtype=jnp.uint32) + start_index
+    if pin_index:
+        idx = jnp.zeros_like(idx)
+    return jax.vmap(lambda i: key_for_image(seed, i))(idx)
 
 
 def _paste_centered(noise: jax.Array, target_shape: Sequence[int],

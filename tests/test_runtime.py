@@ -3,6 +3,7 @@
 import json
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -227,3 +228,39 @@ class TestLogging:
         assert len(lines) == 16  # capacity parity with shared.py:44
         assert lines[-1].endswith("msg 19")
         assert lines[0].endswith("msg 4")
+
+
+class TestBatchKeys:
+    """rng.batch_keys carries the sampler-key seed discipline (start-offset
+    continuity + variation pinning) that engine._image_keys delegates to —
+    pinned here against the eager per-image form."""
+
+    def test_subrange_matches_full(self):
+        import numpy as np
+
+        full = rng.batch_keys(1234, 0, 6)
+        sub = rng.batch_keys(1234, 2, 3)
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(full))[2:5],
+            np.asarray(jax.random.key_data(sub)))
+
+    def test_matches_eager_key_for_image(self):
+        import numpy as np
+
+        keys = rng.batch_keys(77, 3, 2)
+        for j, i in enumerate((3, 4)):
+            np.testing.assert_array_equal(
+                np.asarray(jax.random.key_data(keys))[j],
+                np.asarray(jax.random.key_data(rng.key_for_image(77, i))))
+
+    def test_pin_index_fixes_every_key(self):
+        import numpy as np
+
+        keys = np.asarray(jax.random.key_data(
+            rng.batch_keys(9, 5, 4, pin_index=True)))
+        base = np.asarray(jax.random.key_data(rng.key_for_image(9, 0)))
+        for row in keys:
+            np.testing.assert_array_equal(row, base)
+
+    def test_full_uint32_seed_range(self):
+        rng.batch_keys(2 ** 32 - 1, 0, 2)  # must not overflow
