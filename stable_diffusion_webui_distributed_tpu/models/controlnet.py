@@ -62,6 +62,11 @@ class ControlNet(nn.Module):
 
     cfg: UNetConfig
     dtype: jnp.dtype = jnp.float32
+    # same experimental W8A8 flags as the UNet (runtime/dtypes.py): the
+    # CN forward is ~half a UNet, so leaving it bf16 would dilute the
+    # int8 cells on ControlNet configs (#3)
+    quant_linears: bool = False
+    quant_convs: bool = False
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -110,26 +115,31 @@ class ControlNet(nn.Module):
                 zip(c.block_out_channels, c.down_blocks)):
             for i in range(c.layers_per_block):
                 x = ResBlock(ch, dtype=self.dtype,
+                             quant_convs=self.quant_convs,
                              name=f"down_{level}_res_{i}")(x, temb)
                 if depth is not None:
                     x = SpatialTransformer(
                         depth, self.heads_for(ch), False, self.dtype,
+                        quant_linears=self.quant_linears,
                         name=f"down_{level}_attn_{i}")(x, context)
                 residuals.append(zero_conv(n, x))
                 n += 1
             if level < len(c.block_out_channels) - 1:
                 x = Downsample(ch, dtype=self.dtype,
+                               quant_convs=self.quant_convs,
                                name=f"down_{level}_ds")(x)
                 residuals.append(zero_conv(n, x))
                 n += 1
 
         mid_ch = c.block_out_channels[-1]
-        x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_0")(x, temb)
+        x = ResBlock(mid_ch, dtype=self.dtype,
+                     quant_convs=self.quant_convs, name="mid_res_0")(x, temb)
         if c.mid_block_depth is not None:
             x = SpatialTransformer(
                 c.mid_block_depth, self.heads_for(mid_ch), False, self.dtype,
-                name="mid_attn")(x, context)
-        x = ResBlock(mid_ch, dtype=self.dtype, name="mid_res_1")(x, temb)
+                quant_linears=self.quant_linears, name="mid_attn")(x, context)
+        x = ResBlock(mid_ch, dtype=self.dtype,
+                     quant_convs=self.quant_convs, name="mid_res_1")(x, temb)
         residuals.append(nn.Conv(mid_ch, (1, 1),
                                  kernel_init=nn.initializers.zeros,
                                  dtype=self.dtype, name="mid_out")(x))

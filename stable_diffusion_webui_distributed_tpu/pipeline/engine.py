@@ -116,8 +116,10 @@ class Engine:
             ControlNet,
         )
 
-        self.controlnet_module = ControlNet(family.unet,
-                                            dtype=policy.compute_dtype)
+        self.controlnet_module = ControlNet(
+            family.unet, dtype=policy.compute_dtype,
+            quant_linears=getattr(policy, "unet_int8", False),
+            quant_convs=getattr(policy, "unet_int8_conv", False))
         # resolves another loaded engine by checkpoint name — the SDXL
         # base+refiner handoff (BASELINE config #2)
         self.engine_provider = engine_provider

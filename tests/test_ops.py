@@ -325,3 +325,46 @@ class TestInt8UnderMesh:
         # exact) — the sharded result must match bit-for-bit up to XLA
         # reduction-order noise in the int32->f32 rescale
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.slow
+class TestInt8ControlNet:
+    def test_controlnet_quant_same_params_close_output(self):
+        """The CN copy of the UNet honors the same quant flags with the
+        same param tree (c3-int8 would otherwise leave half the FLOPs in
+        bf16)."""
+        from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            ControlNet,
+        )
+
+        cfg = TINY.unet
+        lat = jnp.asarray(RNG.standard_normal((1, 8, 8, cfg.in_channels),
+                                              np.float32))
+        t = jnp.ones((1,))
+        ctx = jnp.asarray(RNG.standard_normal(
+            (1, 77, cfg.cross_attention_dim), np.float32)) * 0.1
+        hint = jnp.asarray(RNG.random((1, 64, 64, 3)), jnp.float32)
+        base = ControlNet(cfg)
+        params = base.init(jax.random.key(0), lat, t, ctx, hint)["params"]
+        # randomize the zero-initialized output convs, otherwise every
+        # residual is exactly zero on both paths and the comparison below
+        # would be vacuous
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(
+                RNG.standard_normal(x.shape).astype(np.float32) * 0.05)
+            if x.ndim == 4 else x, params)
+        quant = ControlNet(cfg, quant_linears=True, quant_convs=True)
+        out_b = base.apply({"params": params}, lat, t, ctx, hint)
+        out_q = quant.apply({"params": params}, lat, t, ctx, hint)
+        assert len(out_b) == len(out_q)
+        worst = 0.0
+        for a, b in zip(out_b, out_q):
+            a, b = np.asarray(a), np.asarray(b)
+            assert np.isfinite(b).all()
+            assert a.shape == b.shape
+            denom = max(np.abs(a).mean(), 1e-6)
+            worst = max(worst, float(np.abs(a - b).mean() / denom))
+        assert worst < 0.5, worst   # quantization noise, not garbage
+        # and the residuals are genuinely non-zero (comparison is real)
+        assert max(float(np.abs(np.asarray(r)).max()) for r in out_b) > 0
