@@ -67,6 +67,13 @@ class ControlNet(nn.Module):
     # int8 cells on ControlNet configs (#3)
     quant_linears: bool = False
     quant_convs: bool = False
+    # mirror the UNet's attention configuration: on sp>1 meshes the CN's
+    # self-attention must ride the same ring (token-sharded activations),
+    # or it all-gathers and materializes the dense score matrix the ring
+    # exists to avoid
+    use_remat: bool = False
+    attention_impl: str = "xla"
+    mesh: object = None
 
     def heads_for(self, channels: int) -> int:
         if self.cfg.num_attention_heads is not None:
@@ -119,7 +126,8 @@ class ControlNet(nn.Module):
                              name=f"down_{level}_res_{i}")(x, temb)
                 if depth is not None:
                     x = SpatialTransformer(
-                        depth, self.heads_for(ch), False, self.dtype,
+                        depth, self.heads_for(ch), self.use_remat,
+                        self.dtype, self.attention_impl, self.mesh,
                         quant_linears=self.quant_linears,
                         name=f"down_{level}_attn_{i}")(x, context)
                 residuals.append(zero_conv(n, x))
@@ -136,7 +144,8 @@ class ControlNet(nn.Module):
                      quant_convs=self.quant_convs, name="mid_res_0")(x, temb)
         if c.mid_block_depth is not None:
             x = SpatialTransformer(
-                c.mid_block_depth, self.heads_for(mid_ch), False, self.dtype,
+                c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
+                self.dtype, self.attention_impl, self.mesh,
                 quant_linears=self.quant_linears, name="mid_attn")(x, context)
         x = ResBlock(mid_ch, dtype=self.dtype,
                      quant_convs=self.quant_convs, name="mid_res_1")(x, temb)

@@ -20,8 +20,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _ring_chunk() -> int:
+    """Upper bound on the key-block chunk folded per inner step
+    (SDTPU_RING_CHUNK, default 1024): the per-device score buffer is
+    (b, h, t_loc, chunk) instead of (b, h, t_loc, t_loc) — at the hires
+    65k-token scale a full local score matrix would be GBs of HBM per
+    ring step; chunked folding keeps it flat."""
+    import os
+
+    return max(128, int(os.environ.get("SDTPU_RING_CHUNK", "1024")))
+
+
 def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
-    """Per-device computation: local Q against the rotating K/V ring."""
+    """Per-device computation: local Q against the rotating K/V ring.
+
+    Each ring step folds its K/V block into the running online softmax in
+    bounded key-chunks (an inner ``lax.scan``) — the same associative
+    (m, l, acc) update at two granularities, so the result is identical
+    to the dense fold up to float summation order."""
     n = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -38,18 +54,41 @@ def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
     l0 = varying(jnp.zeros((b, h, t_loc, 1), jnp.float32))
     acc0 = varying(jnp.zeros((b, h, t_loc, d), jnp.float32))
 
-    def step(_, carry):
-        m, l, acc, k_blk, v_blk = carry
-        s = jnp.einsum("bthd,bshd->bhts", qf, k_blk.astype(jnp.float32))
+    s_loc = k.shape[1]
+    chunk = min(_ring_chunk(), s_loc)
+    # non-divisor request: round DOWN to the largest divisor so the HBM
+    # bound holds at every resolution (a silent dense fallback would
+    # reintroduce the full (t_loc, s_loc) score buffer exactly at the
+    # odd-shaped hires scales this exists for)
+    while s_loc % chunk:
+        chunk -= 1
+    n_chunks = s_loc // chunk
+
+    def fold(carry, kv):
+        m, l, acc = carry
+        k_c, v_c = kv                               # (b, chunk, h, d)
+        s = jnp.einsum("bthd,bshd->bhts", qf, k_c.astype(jnp.float32))
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+            "bhts,bshd->bhtd", p, v_c.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    def step(_, carry):
+        m, l, acc, k_blk, v_blk = carry
+        if n_chunks == 1:
+            (m, l, acc), _ = fold((m, l, acc), (k_blk, v_blk))
+        else:
+            kc = k_blk.reshape(b, n_chunks, chunk, h, d).transpose(
+                1, 0, 2, 3, 4)
+            vc = v_blk.reshape(b, n_chunks, chunk, h, d).transpose(
+                1, 0, 2, 3, 4)
+            (m, l, acc), _ = lax.scan(fold, (m, l, acc), (kc, vc))
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+        return m, l, acc, k_next, v_next
 
     m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
     out = acc / l                                  # (b, h, t_loc, d)

@@ -116,10 +116,9 @@ class Engine:
             ControlNet,
         )
 
-        self.controlnet_module = ControlNet(
-            family.unet, dtype=policy.compute_dtype,
-            quant_linears=getattr(policy, "unet_int8", False),
-            quant_convs=getattr(policy, "unet_int8_conv", False))
+        # constructed AFTER the attention-impl resolution below would be
+        # cleaner, but attn_impl/attn_mesh are computed a few lines down —
+        # so the CN module is (re)bound there alongside the UNet
         # resolves another loaded engine by checkpoint name — the SDXL
         # base+refiner handoff (BASELINE config #2)
         self.engine_provider = engine_provider
@@ -151,6 +150,15 @@ class Engine:
                          quant_linears=getattr(policy, "unet_int8", False),
                          quant_convs=getattr(policy, "unet_int8_conv",
                                              False))
+        # the CN copy mirrors the UNet's full block configuration —
+        # attention impl/mesh included, so sequence parallelism and the
+        # int8 flags cover the CN's ~half-a-UNet of FLOPs too
+        self.controlnet_module = ControlNet(
+            family.unet, dtype=cd,
+            use_remat=policy.use_remat,
+            attention_impl=attn_impl, mesh=attn_mesh,
+            quant_linears=getattr(policy, "unet_int8", False),
+            quant_convs=getattr(policy, "unet_int8_conv", False))
         vae_cfg = family.vae
         if getattr(policy, "decode_in_bf16", False) and \
                 vae_cfg.force_decoder_f32:

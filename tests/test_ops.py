@@ -368,3 +368,28 @@ class TestInt8ControlNet:
         assert worst < 0.5, worst   # quantization noise, not garbage
         # and the residuals are genuinely non-zero (comparison is real)
         assert max(float(np.abs(np.asarray(r)).max()) for r in out_b) > 0
+
+
+class TestRingChunking:
+    """The ring body folds each rotating K/V block in bounded key-chunks;
+    the chunked fold must match the dense fold (same associative update,
+    finer granularity)."""
+
+    def test_chunked_matches_unchunked(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
+            ring_attention,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        mesh = build_mesh("sp=4")
+        q, k, v = qkv(1, 4 * 512, 2, 16)   # t_loc = 512 per device
+        monkeypatch.setenv("SDTPU_RING_CHUNK", "1024")  # 1 chunk (dense)
+        dense = np.asarray(ring_attention(q, k, v, mesh))
+        monkeypatch.setenv("SDTPU_RING_CHUNK", "128")   # 4 chunks per block
+        chunked = np.asarray(jax.jit(
+            lambda a, b, c: ring_attention(a, b, c, mesh))(q, k, v))
+        np.testing.assert_allclose(chunked, dense, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            dense, np.asarray(reference(q, k, v)), rtol=2e-4, atol=2e-4)
