@@ -81,8 +81,9 @@ def _start_init_watchdog(timeout=None):
         if not done.wait(timeout):
             print(
                 f"bench: FATAL: jax backend init did not complete within "
-                f"{timeout:.0f}s — TPU claim relay wedged? "
-                "(see memory: axon chip claim has no client timeout)",
+                f"{timeout:.0f}s — chip claim not granted (the axon client "
+                "waits forever by default; run tools/tpu_claim_probe.py "
+                "for a relay-down/relay-dead/claim-held verdict)",
                 file=sys.stderr, flush=True)
             os._exit(3)
 
