@@ -1,0 +1,160 @@
+"""Two-stage device pipeline: base UNet and refiner on DISJOINT meshes.
+
+The measured SDXL base+refiner request (BASELINE config #2) runs its two
+models back-to-back on one device group, so the refiner serializes behind
+the base for every dispatch group — one of the two hypothesized components
+of the north-star gap (VERDICT r3/r4; PERF.md roofline). With two device
+groups the stages overlap: while group ``i`` refines on mesh B, group
+``i+1``'s base half is already running on mesh A. Dispatch is async, so a
+single host thread drives both groups — the engines' ``sync=False``
+denoise mode (engine._denoise_range) keeps the host from blocking on
+either mesh; latents hop meshes via ``jax.device_put`` (ICI on silicon).
+
+This is pipeline parallelism in the form that fits THIS workload: the
+model is small enough to replicate, so stages split by MODEL (base |
+refiner), not by layer — no microbatch bubbles beyond the first/last
+group, and each mesh can still shard dp/tp internally.
+
+Scope: txt2img, fixed-grid samplers, no hires/inpaint/ControlNet (the
+config-#2 shape). Single-chip runs gain nothing (a device executes
+serially) — this exists for multi-chip meshes and is validated on the
+virtual CPU mesh (tests/test_parallel_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.runtime import rng
+from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
+
+
+def _to_mesh(x, mesh, batch: bool):
+    """Commit ``x`` to ``mesh`` (dp-sharded batch dim when it divides,
+    replicated otherwise); None mesh = leave placement alone."""
+    if mesh is None or x is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape.get("dp", 1)
+    if batch and dp > 1 and x.shape[0] % dp == 0:
+        return jax.device_put(x, NamedSharding(mesh, P("dp")))
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def pipelined_txt2img(base, refiner, payload, *, group_size: Optional[int] = None):
+    """Generate ``payload`` with the base half on ``base``'s mesh and the
+    refiner half on ``refiner``'s mesh, pipelined across dispatch groups.
+
+    ``base`` and ``refiner`` are Engines constructed over (ideally
+    disjoint) meshes. Returns a GenerationResult identical in content to
+    the sequential single-group path — the seed contract keys every draw
+    by global image index, so the pipeline layout never changes pixels.
+    """
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationResult, fix_seed,
+    )
+
+    if payload.init_images or payload.enable_hr:
+        raise ValueError("stage pipeline: txt2img without hires only")
+    if kd.resolve_sampler(payload.sampler_name).adaptive:
+        raise ValueError("stage pipeline: fixed-grid samplers only "
+                         "(DPM adaptive's host loop is inherently serial)")
+    if not (0.0 < payload.refiner_switch_at < 1.0):
+        raise ValueError("stage pipeline: needs refiner_switch_at in (0,1)")
+    if payload.all_prompts:
+        raise ValueError("stage pipeline: per-image prompts (prompt "
+                         "matrix / scheduler sub-ranges) take the "
+                         "sequential path")
+    if base._parse_controlnet_units(payload):
+        raise ValueError("stage pipeline: ControlNet units take the "
+                         "sequential path")
+    if base.family.inpaint:
+        raise ValueError("stage pipeline: inpainting checkpoints take "
+                         "the sequential path")
+
+    payload = payload.model_copy()
+    payload.seed = fix_seed(payload.seed)
+    payload.subseed = fix_seed(payload.subseed)
+    base._adaptive_incomplete = False
+    base.state.begin_request()
+    base._apply_prompt_loras(payload)   # same engine the sequential path
+                                        # applies/deactivates LoRA tags on
+
+    width, height = payload.width, payload.height
+    h, w = base._latent_hw(width, height)
+    # sampled latent channels — NOT unet.in_channels (engine.py:1132)
+    C = base.family.vae.latent_channels
+    steps = payload.steps
+    # same clamp as _split_denoise (engine.py): switch may be 0, in which
+    # case the base range is empty and the refiner runs every step
+    switch = max(0, min(steps - 1, int(steps * payload.refiner_switch_at)))
+
+    conds, pooleds = base.encode_prompts(payload)
+    ref_conds, ref_pooleds = refiner.encode_prompts(payload)
+    rmesh = refiner.mesh
+    ref_conds = tuple(_to_mesh(c, rmesh, batch=False) for c in ref_conds)
+    ref_pooleds = tuple(_to_mesh(p, rmesh, batch=False)
+                        for p in ref_pooleds)
+
+    spec = kd.resolve_sampler(payload.sampler_name)
+    sigmas = kd.build_sigmas(spec, base.schedule, steps)
+
+    out = GenerationResult(parameters=payload.model_dump())
+    group = max(1, group_size or payload.batch_size)
+    total = payload.total_images
+    pos = 0
+    pending = []   # (decode entries, already queued on base mesh)
+    in_flight = []  # (refined latents on refiner mesh, pos, n)
+
+    def flush_one():
+        lat_r, p0, n0 = in_flight.pop(0)
+        lat_back = _to_mesh(lat_r, base.mesh, batch=True) \
+            if base.mesh is not None else jax.device_put(lat_r)
+        pending.extend(base._queue_decoded(lat_back, p0, n0,
+                                           width, height))
+
+    while pos < total and not base.state.flag.interrupted:
+        n = min(group, total - pos)
+        noise = rng.batch_noise(
+            payload.seed, payload.subseed, payload.subseed_strength,
+            pos, n, (h, w, C),
+            seed_resize=base._seed_resize_latent(payload),
+            pin_index=payload.same_seed)
+        x = base._place_batch(noise.astype(jnp.float32) * sigmas[0])
+        keys = base._image_keys(payload, pos, n)
+        # base half on mesh A — dispatched without host blocking
+        lat = base._denoise_range(
+            payload, x, keys, conds, pooleds, width, height, 0, steps,
+            "txt2img", None, None, (), end_step=switch, sync=False)
+        if base.state.flag.interrupted:
+            # like _split_denoise: an interrupt during the base half skips
+            # the refiner; the partial latents decode as-is
+            pending.extend(base._queue_decoded(lat, pos, n, width, height))
+            break
+        # hop to mesh B (async ICI copy; arguments may still be futures)
+        lat_b = _to_mesh(lat, rmesh, batch=True)
+        keys_b = _to_mesh(keys, rmesh, batch=True)
+        refined = refiner._denoise_range(
+            payload, lat_b, keys_b, ref_conds, ref_pooleds, width, height,
+            switch, steps, "txt2img+refiner", None, None, sync=False)
+        in_flight.append((refined, pos, n))
+        # decode trails one group behind — the NEWEST group stays in
+        # flight so base(g+1) dispatches ahead of decode(g) on the base
+        # mesh's in-order stream (draining it here would chain decode(g)
+        # behind refine(g) and re-serialize the stages)
+        while len(in_flight) > 1:
+            flush_one()
+        if len(pending) > 1:
+            base._flush_decoded(out, payload, pending[:-1])
+            pending = pending[-1:]
+        pos += n
+
+    while in_flight:
+        flush_one()
+    base._flush_decoded(out, payload, pending)
+    base.state.finish()
+    return out

@@ -1014,12 +1014,17 @@ class Engine:
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
                        mask_lat, init_lat, controls=(), end_step=None,
-                       inpaint_cond=None):
+                       inpaint_cond=None, sync=True):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
         [start_step, end_step or steps) — a partial range is how the
-        base half of a base+refiner pass stops at the switch point."""
+        base half of a base+refiner pass stops at the switch point.
+
+        ``sync=False`` (parallel/stage_pipeline.py) skips every
+        ``block_until_ready`` so the host can keep dispatching to OTHER
+        device groups while this one chews — progress then reports at
+        group granularity and interrupt latency grows to a full range."""
         if kd.resolve_sampler(payload.sampler_name).adaptive:
             return self._denoise_adaptive(
                 payload, x, image_keys, conds, pooleds, width, height,
@@ -1063,13 +1068,13 @@ class Engine:
                 carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
                            ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
                            active, inp_arg)
-                if pending is not None:
+                if sync and pending is not None:
                     pending[0].x.block_until_ready()
                     done += pending[1]
                     self.state.step(done)
             pending = (carry, length)
             pos += length
-        if pending is not None:
+        if sync and pending is not None:
             pending[0].x.block_until_ready()
             done += pending[1]
             self.state.step(done)
