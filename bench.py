@@ -102,14 +102,7 @@ def _relay_triage():
         import tpu_claim_probe
 
         relay = tpu_claim_probe.triage_relay()
-        connected = [e for e in relay.values() if e.get("connect")]
-        if not connected:
-            verdict = "relay-down"
-        elif all(e.get("instant_eof") for e in connected):
-            verdict = "relay-dead"
-        else:
-            verdict = "alive"
-        return verdict, json.dumps(relay)
+        return tpu_claim_probe.classify_triage(relay), json.dumps(relay)
     except Exception as e:  # noqa: BLE001 — triage is best-effort
         return "triage-error", str(e)
 

@@ -35,14 +35,16 @@ from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 def _to_mesh(x, mesh, batch: bool):
     """Commit ``x`` to ``mesh`` (dp-sharded batch dim when it divides,
     replicated otherwise); None mesh = leave placement alone."""
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+        batch_sharding, replicated,
+    )
+
     if mesh is None or x is None:
         return x
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     dp = mesh.shape.get("dp", 1)
     if batch and dp > 1 and x.shape[0] % dp == 0:
-        return jax.device_put(x, NamedSharding(mesh, P("dp")))
-    return jax.device_put(x, NamedSharding(mesh, P()))
+        return jax.device_put(x, batch_sharding(mesh))
+    return jax.device_put(x, replicated(mesh))
 
 
 def pipelined_txt2img(base, refiner, payload, *, group_size: Optional[int] = None):

@@ -116,9 +116,8 @@ class Engine:
             ControlNet,
         )
 
-        # constructed AFTER the attention-impl resolution below would be
-        # cleaner, but attn_impl/attn_mesh are computed a few lines down —
-        # so the CN module is (re)bound there alongside the UNet
+        # (the ControlNet module is constructed below, after the
+        # attention impl/mesh are resolved, so it mirrors the UNet's)
         # resolves another loaded engine by checkpoint name — the SDXL
         # base+refiner handoff (BASELINE config #2)
         self.engine_provider = engine_provider

@@ -116,6 +116,17 @@ def triage_relay(peek_s: float = 3.0) -> dict:
     return out
 
 
+def classify_triage(relay: dict) -> str:
+    """Map a triage_relay() result to a verdict — the single home of the
+    relay-down / relay-dead / alive rules (bench.py reuses it)."""
+    connected = [e for e in relay.values() if e.get("connect")]
+    if not connected:
+        return "relay-down"
+    if all(e.get("instant_eof") for e in connected):
+        return "relay-dead"
+    return "alive"
+
+
 def probe_claim(timeout_s: int, hard_kill_grace: int = 60) -> dict:
     """One bounded claim attempt in a child process.
 
@@ -152,11 +163,9 @@ def probe_claim(timeout_s: int, hard_kill_grace: int = 60) -> dict:
 def diagnose(timeout_s: int = 90, triage_only: bool = False) -> dict:
     """triage + (if the relay looks alive) one bounded claim attempt."""
     relay = triage_relay()
-    if not any(e.get("connect") for e in relay.values()):
-        return {"verdict": "relay-down", "relay": relay, "probe": None}
-    if all(e.get("instant_eof") for e in relay.values()
-           if e.get("connect")):
-        return {"verdict": "relay-dead", "relay": relay, "probe": None}
+    verdict = classify_triage(relay)
+    if verdict in ("relay-down", "relay-dead"):
+        return {"verdict": verdict, "relay": relay, "probe": None}
     if triage_only:
         return {"verdict": "relay-alive-unprobed", "relay": relay,
                 "probe": None}
