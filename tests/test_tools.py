@@ -198,3 +198,18 @@ class TestChipSessionTraceRehearsal:
         # PERF_TRACE_C2.md may legitimately exist after a chip window)
         assert not os.path.exists("PERF_TRACE_C2_TINY.md")
         assert not os.path.isdir(os.path.join("traces", "c2-tiny"))
+
+
+class TestClassifyTriage:
+    def test_rules(self):
+        c = tpu_claim_probe.classify_triage
+        assert c({}) == "relay-down"
+        assert c({2024: {"connect": False}}) == "relay-down"
+        assert c({2024: {"connect": True, "instant_eof": True}}) == \
+            "relay-dead"
+        assert c({2024: {"connect": True, "instant_eof": False}}) == "alive"
+        # mixed ports: ANY live port means not dead
+        assert c({1: {"connect": True, "instant_eof": True},
+                  2: {"connect": True, "instant_eof": False}}) == "alive"
+        assert c({1: {"connect": False},
+                  2: {"connect": True, "instant_eof": True}}) == "relay-dead"
