@@ -568,10 +568,91 @@ def run_config(n, tiny):
     return out
 
 
+def run_serving(tiny):
+    """Serving-layer microbench: 8 concurrent mixed-shape requests through
+    the continuous-batching dispatcher. The headline value is the coalesce
+    factor (requests per device dispatch); chunk-compile count and bucket
+    hit rate ride along. Counts, not wall-clock — meaningful on CPU."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    if tiny or dev.platform == "cpu":
+        ladder, steps = [(64, 64), (96, 96)], 4
+        shapes = [(64, 64), (48, 64), (96, 96), (80, 80)]
+        family = C.TINY
+    else:
+        ladder, steps = [(512, 512), (768, 768)], 20
+        shapes = [(512, 512), (448, 512), (768, 768), (640, 640)]
+        family = C.SD15
+    engine = _make_engine(family)
+    # one batch bucket: any partition of the 8 requests into groups pads
+    # to the same compiled batch, so compile count == shape-ladder size
+    bucketer = ShapeBucketer(shapes=ladder, batches=[4])
+    dispatcher = ServingDispatcher(engine, bucketer=bucketer, window=0.5)
+
+    METRICS.clear()
+    results, errs = [], []
+
+    def submit(i, w, h):
+        p = GenerationPayload(prompt=f"bench cow {i % 4}", steps=steps,
+                              width=w, height=h, seed=100 + i,
+                              sampler_name="Euler a")
+        try:
+            results.append(dispatcher.submit(p))
+        except Exception as e:  # noqa: BLE001 — reported in the JSON line
+            errs.append(repr(e))
+
+    t0 = time.time()
+    threads = [threading.Thread(target=submit, args=(i, *shapes[i % 4]))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    s = METRICS.summary()
+    images = sum(len(r.images) for r in results)
+    return {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "serving_coalesce_factor",
+        "value": round(s["coalesce_factor"] or 0.0, 3),
+        "unit": "requests/dispatch",
+        "vs_baseline": None,
+        "chunk_compiles": s["compiles"].get("chunk", 0),
+        "bucket_hit_rate": s["bucket_hit_rate"],
+        "dispatches": s["dispatches"],
+        "coalesced_dispatches": s["coalesced_dispatches"],
+        "avg_queue_wait_s": round(s["avg_queue_wait_s"] or 0.0, 4),
+        "avg_padding_ratio": round(s["avg_padding_ratio"] or 1.0, 4),
+        "requests": 8,
+        "raw_shapes": len(set(shapes)),
+        "bucket_ladder": [f"{w}x{h}" for w, h in bucketer.shapes],
+        "images": images,
+        "errors": errs,
+        "wall_s": round(wall, 2),
+        "device": dev.device_kind,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", type=int, default=1, choices=range(1, 6),
                     help="BASELINE.md config number (default 1)")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving-layer microbench: coalesce factor + "
+                         "compile counts (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -605,7 +686,10 @@ def main() -> None:
 
     enable_compilation_cache()
 
-    print(json.dumps(run_config(args.config, tiny)))
+    if args.serving:
+        print(json.dumps(run_serving(tiny)))
+    else:
+        print(json.dumps(run_config(args.config, tiny)))
 
 
 if __name__ == "__main__":

@@ -299,6 +299,25 @@ def cmd_serve(args) -> int:
     server = ApiServer(world, registry=registry, host=args.listen,
                        port=args.port, user=args.api_auth_user,
                        password=args.api_auth_password)
+    # AOT warmup: pre-build the bucket ladder's executables on the local
+    # engine before accepting traffic, so the first request of every
+    # bucket pays dispatch cost, not compile cost (SDTPU_WARMUP=0 skips;
+    # the persistent XLA cache makes later restarts near-free too).
+    if os.environ.get("SDTPU_WARMUP", "") not in ("", "0"):
+        from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+            ShapeBucketer,
+        )
+        from stable_diffusion_webui_distributed_tpu.serving.warmup import (
+            warmup_engine,
+        )
+
+        for w in world.workers:
+            eng = getattr(w.backend, "engine", None)
+            if eng is not None:
+                report = warmup_engine(
+                    eng, ShapeBucketer.from_config(world.cfg))
+                print(f"serve: warmup {report}", file=sys.stderr)
+                break
     server.serve_forever()
     if server.restart_requested:
         # /sdapi/v1/server-restart relaunches the node, as the reference's

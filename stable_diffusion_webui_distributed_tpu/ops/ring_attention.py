@@ -20,6 +20,9 @@ import jax.numpy as jnp
 from jax import lax
 
 
+_RING_CHUNK_DEFAULT = 1024
+
+
 def _ring_chunk() -> int:
     """Upper bound on the key-block chunk folded per inner step
     (SDTPU_RING_CHUNK, default 1024): the per-device score buffer is
@@ -28,7 +31,19 @@ def _ring_chunk() -> int:
     ring step; chunked folding keeps it flat."""
     import os
 
-    return max(128, int(os.environ.get("SDTPU_RING_CHUNK", "1024")))
+    raw = os.environ.get("SDTPU_RING_CHUNK", str(_RING_CHUNK_DEFAULT))
+    try:
+        val = int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"SDTPU_RING_CHUNK={raw!r} is not an integer; "
+            f"using default {_RING_CHUNK_DEFAULT}",
+            stacklevel=2,
+        )
+        val = _RING_CHUNK_DEFAULT
+    return max(128, val)
 
 
 def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
@@ -46,9 +61,14 @@ def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
 
     # fresh accumulators must be marked device-varying over every mesh axis
     # the inputs vary over (the ring axis, plus dp on combined dp+sp
-    # meshes) or the fori_loop carry types disagree under shard_map
+    # meshes) or the fori_loop carry types disagree under shard_map; older
+    # jax has no varying-mesh-axes type system, so pcast degrades to identity
+    _pcast = getattr(lax, "pcast", None)
+
     def varying(x):
-        return lax.pcast(x, vary_axes or axis_name, to="varying")
+        if _pcast is None:
+            return x
+        return _pcast(x, vary_axes or axis_name, to="varying")
 
     m0 = varying(jnp.full((b, h, t_loc, 1), -jnp.inf, jnp.float32))
     l0 = varying(jnp.zeros((b, h, t_loc, 1), jnp.float32))
@@ -56,18 +76,28 @@ def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
 
     s_loc = k.shape[1]
     chunk = min(_ring_chunk(), s_loc)
-    # non-divisor request: round DOWN to the largest divisor so the HBM
-    # bound holds at every resolution (a silent dense fallback would
-    # reintroduce the full (t_loc, s_loc) score buffer exactly at the
-    # odd-shaped hires scales this exists for)
-    while s_loc % chunk:
-        chunk -= 1
-    n_chunks = s_loc // chunk
+    # non-divisor request: pad the local K/V block up to the next chunk
+    # multiple and mask the tail (scores -> -inf, so exp -> 0 and the
+    # padded keys contribute nothing to l or acc). This keeps the HBM
+    # bound of the chunked fold at every resolution without degrading the
+    # chunk size toward 1 when s_loc is near-prime.
+    n_chunks = -(-s_loc // chunk)
+    pad = n_chunks * chunk - s_loc
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (n_chunks, chunk) validity mask for key positions; only the final
+    # chunk can contain padding, but carrying it through the scan keeps
+    # the fold uniform
+    key_valid = (jnp.arange(n_chunks * chunk) < s_loc).reshape(
+        n_chunks, chunk)
 
     def fold(carry, kv):
         m, l, acc = carry
-        k_c, v_c = kv                               # (b, chunk, h, d)
+        k_c, v_c, valid_c = kv                      # (b, chunk, h, d)
         s = jnp.einsum("bthd,bshd->bhts", qf, k_c.astype(jnp.float32))
+        if pad:
+            s = jnp.where(valid_c[None, None, None, :], s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -79,13 +109,13 @@ def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):
     def step(_, carry):
         m, l, acc, k_blk, v_blk = carry
         if n_chunks == 1:
-            (m, l, acc), _ = fold((m, l, acc), (k_blk, v_blk))
+            (m, l, acc), _ = fold((m, l, acc), (k_blk, v_blk, key_valid[0]))
         else:
             kc = k_blk.reshape(b, n_chunks, chunk, h, d).transpose(
                 1, 0, 2, 3, 4)
             vc = v_blk.reshape(b, n_chunks, chunk, h, d).transpose(
                 1, 0, 2, 3, 4)
-            (m, l, acc), _ = lax.scan(fold, (m, l, acc), (kc, vc))
+            (m, l, acc), _ = lax.scan(fold, (m, l, acc), (kc, vc, key_valid))
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return m, l, acc, k_next, v_next

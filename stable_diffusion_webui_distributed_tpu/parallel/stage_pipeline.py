@@ -134,7 +134,12 @@ def pipelined_txt2img(base, refiner, payload, *, group_size: Optional[int] = Non
             "txt2img", None, None, (), end_step=switch, sync=False)
         if base.state.flag.interrupted:
             # like _split_denoise: an interrupt during the base half skips
-            # the refiner; the partial latents decode as-is
+            # the refiner; the partial latents decode as-is. Drain the
+            # in-flight (earlier-index) refined groups FIRST so the gallery
+            # stays in global-index order — the interrupted group is the
+            # newest and must decode last.
+            while in_flight:
+                flush_one()
             pending.extend(base._queue_decoded(lat, pos, n, width, height))
             break
         # hop to mesh B (async ICI copy; arguments may still be futures)

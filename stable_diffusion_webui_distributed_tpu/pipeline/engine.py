@@ -186,11 +186,22 @@ class Engine:
     # -- compiled stage factories ------------------------------------------
 
     def _cached(self, key: Tuple, build: Callable[[], Callable]) -> Callable:
+        from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+            METRICS,
+        )
+
         with self._cache_lock:
             fn = self._cache.get(key)
             if fn is None:
+                # each build is a fresh jitted executable for this exact
+                # shape key — i.e. one XLA compile at first dispatch; the
+                # serving layer asserts on this counter (compile count,
+                # bucket hit rate) instead of wall-clock
+                METRICS.record_compile(key[0])
                 fn = build()
                 self._cache[key] = fn
+            else:
+                METRICS.record_cache_hit(key[0])
         return fn
 
     def _has_batch_bucket(self, sampler: str, steps: int, width: int,
@@ -418,10 +429,14 @@ class Engine:
         sizes the sigma ladder's endpoints; the controller picks the actual
         steps). Interrupt is polled between attempts, so latency is one
         attempt (3 UNet evals). ControlNet guidance windows are gated
-        host-side per attempt: the current log-sigma progress maps to a
-        step fraction and each unit's weight is zeroed outside its window
-        (weights are traced data, so crossing a boundary never recompiles
-        — webui's step-fraction gating at accepted-step granularity)."""
+        host-side per attempt: the current sigma is located on the built
+        sigma ladder (searchsorted) and converted to the SAME
+        ``(step + 0.5) / steps`` fraction the fixed-grid in-graph gate
+        uses, then each unit's weight is zeroed outside its window
+        (weights are traced data, so crossing a boundary never
+        recompiles). Gating granularity is per accepted attempt, so
+        boundaries land within one attempt of the fixed-grid step they
+        correspond to — not exactly on it."""
         spec = kd.resolve_sampler(payload.sampler_name)
         sigmas = kd.build_sigmas(spec, self.schedule, steps)
         end = steps if end_step is None else min(end_step, steps)
@@ -450,21 +465,26 @@ class Engine:
         # in-graph gate sees total_steps=1 (frozen fraction 0.5), so each
         # unit's window is widened to (0, 1) in-graph and its WEIGHT is
         # zeroed host-side while the trajectory sits outside the window.
-        # Weight is traced data — toggling it never recompiles. Progress
-        # is measured in log-sigma (the quantity the adaptive solver
-        # integrates), matching the fixed-grid path's step fraction at the
-        # ladder's own spacing (ref CN window fields, control_net.py:20-79).
-        import math as _math
+        # Weight is traced data — toggling it never recompiles. The current
+        # sigma is mapped onto the BUILT sigma ladder (searchsorted), so
+        # the fraction agrees with the fixed-grid gate's
+        # (step + 0.5)/steps at the ladder's own spacing regardless of the
+        # schedule's log-sigma curvature (ref CN window fields,
+        # control_net.py:20-79).
+        import numpy as _np
 
-        t_lo = -_math.log(sigma_max)
-        t_hi = -_math.log(sigma_min)
-        span = max(t_hi - t_lo, 1e-9)
+        # ascending view of the (decreasing) ladder for searchsorted
+        _ladder_asc = _np.asarray(sigmas, dtype=_np.float64)[::-1].copy()
+        _n_lad = len(sigmas) - 1          # number of steps on the ladder
         windows = [(g_start, g_end) for (_p, _h, _w, g_start, g_end)
                    in controls]
         wide = tuple((p, h, w, 0.0, 1.0) for (p, h, w, _s, _e) in controls)
 
         def controls_at(s_val: float):
-            frac = min(1.0, max(0.0, (s_val - t_lo) / span))
+            # step index i with sigmas[i] >= s_val > sigmas[i+1]
+            j = int(_np.searchsorted(_ladder_asc, s_val, side="left"))
+            idx = min(max(_n_lad - j, 0), max(_n_lad - 1, 0))
+            frac = (idx + 0.5) / max(_n_lad, 1)
             # zero with a PYTHON float: a jnp scalar here would flip the
             # arg's weak_type at the window boundary and retrace the
             # 3-UNet-eval attempt executable mid-generation

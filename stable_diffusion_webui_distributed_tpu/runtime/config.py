@@ -109,6 +109,24 @@ class ConfigModel(BaseModel):
     model_dir: str = "models"
     default_model: str = ""
     mesh_axes: Dict[str, int] = Field(default_factory=dict)  # e.g. {"dp": 4, "tp": 2}
+    # -- serving-layer knobs (serving/ package) ---------------------------
+    # Shape-bucket ladder: comma list of WxH resolutions requests are
+    # padded UP to before execution, so the engine compiles at most one
+    # chunk executable per (bucket, batch) instead of one per unique
+    # request shape. Images are center-cropped back to the requested size.
+    # Env SDTPU_BUCKET_LADDER overrides; malformed values warn and fall
+    # back to "512x512,640x640,768x768,1024x1024".
+    bucket_ladder: str = ""
+    # Batch ladder: comma list of device batch sizes the coalescer pads
+    # merged batches up to (pad-and-drop). Env SDTPU_BATCH_LADDER
+    # overrides; default "1,2,4,8".
+    batch_ladder: str = ""
+    # Coalesce window (seconds): how long the first request of a
+    # compatible group waits for concurrent requests to merge into its
+    # device batch. 0 disables waiting (requests still merge while the
+    # engine is busy with a previous batch). Env SDTPU_COALESCE_WINDOW
+    # overrides; default 0.05.
+    coalesce_window: Optional[float] = None
 
 
 def default_config_path() -> str:

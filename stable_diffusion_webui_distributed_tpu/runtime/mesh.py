@@ -22,11 +22,17 @@ import numpy as np
 AXIS_ORDER = ("dp", "tp", "sp")
 
 
-def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+def enable_compilation_cache(
+        cache_dir: Optional[str] = None) -> Optional[str]:
     """Persist XLA executables across process restarts (first SDXL compile
     costs ~minutes on TPU; a restarted node re-serves in seconds). The
     reference's workers pay webui's model-load on every restart with no
-    equivalent escape hatch."""
+    equivalent escape hatch.
+
+    Returns the active cache directory (None when enabling failed) so the
+    serving warmup (serving/warmup.py) can report where its pre-built
+    executables landed — warmup + this cache is what turns a restarted
+    server's first request from compile cost into dispatch cost."""
     import os
 
     import jax
@@ -37,8 +43,9 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return cache_dir
     except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+        return None
 
 
 def init_multihost(coordinator: Optional[str] = None,

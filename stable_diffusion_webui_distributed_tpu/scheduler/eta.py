@@ -79,12 +79,22 @@ def predict_eta(
     batch_size: Optional[int] = None,
     steps: Optional[int] = None,
     _include_hr: bool = True,
+    queue_wait: float = 0.0,
+    padding_overhead: float = 1.0,
 ) -> float:
     """Seconds to complete ``payload`` on a backend calibrated as ``cal``.
 
     ``payload`` needs: steps, batch_size, width, height, sampler_name,
     enable_hr (+ hr_scale / hr_second_pass_steps when enabled) — i.e. a
     :class:`GenerationPayload` or anything duck-typed like one.
+
+    When the backend fronts a serving dispatcher, ``padding_overhead``
+    (>= 1, the bucket-px / requested-px factor from shape bucketing —
+    padded pixels are denoised and decoded like real ones) scales the
+    compute estimate, and ``queue_wait`` (seconds spent in the coalesce
+    queue, typically ``ServingDispatcher.eta_overhead()``'s observed
+    average) is added on top — wait is latency, not compute, so the MPE
+    feedback never rescales it.
     """
     if not cal.benchmarked:
         raise ValueError("backend not benchmarked; run the benchmark first")
@@ -107,9 +117,11 @@ def predict_eta(
         # positive table entry = faster than Euler a -> smaller eta
         eta -= eta * (delta / 100.0) if delta > 0 else -eta * abs(delta) / 100.0
 
+    eta *= max(1.0, padding_overhead)
+
     if cal.eta_percent_error:
         eta -= eta * (cal.mpe() / 100.0)
-    return eta
+    return eta + max(0.0, queue_wait)
 
 
 def _eta_hires(cal, payload, bench, batch_size) -> float:

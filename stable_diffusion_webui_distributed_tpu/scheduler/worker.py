@@ -166,9 +166,14 @@ class WorkerNode:
     # -- ETA ----------------------------------------------------------------
 
     def eta(self, payload, batch_size: Optional[int] = None,
-            steps: Optional[int] = None) -> float:
+            steps: Optional[int] = None, queue_wait: float = 0.0,
+            padding_overhead: float = 1.0) -> float:
+        # queue_wait/padding_overhead: serving-dispatcher additions for
+        # backends behind a coalescing front end (scheduler/eta.py)
         return eta_mod.predict_eta(self.cal, payload, self.benchmark_payload,
-                                   batch_size=batch_size, steps=steps)
+                                   batch_size=batch_size, steps=steps,
+                                   queue_wait=queue_wait,
+                                   padding_overhead=padding_overhead)
 
     # -- request lifecycle --------------------------------------------------
 

@@ -317,7 +317,20 @@ class World:
                 if j.worker.pixel_cap <= 0 or px <= j.worker.pixel_cap]
         if not fits:
             return None
-        best = max(fits, key=lambda w: w.cal.avg_ipm or 0.0)
+        # apply the same stall-deferral gate as optimize_jobs phase 1:
+        # a backend that would hold the gallery past job_timeout (vs the
+        # fastest at this batch size) is skipped — unless every fitting
+        # backend stalls, in which case a slow whole-request run still
+        # beats splitting (splitting would change the adaptive trajectory
+        # and therefore the pixels). Disabled/unbenchmarked workers were
+        # already filtered by get_workers/make_jobs.
+        unstalled = [w for w in fits
+                     if self.job_stall(w, payload, batch_size=total)
+                     < self.job_timeout]
+        pool = unstalled or fits
+        # deterministic tie-break on equal avg_ipm: lowest label wins
+        best = sorted(pool,
+                      key=lambda w: (-(w.cal.avg_ipm or 0.0), w.label))[0]
         job = Job(best, total)
         job.start_index = 0
         return [job]

@@ -70,6 +70,17 @@ class ApiServer:
         self._benchmarking = threading.Lock()
         self.restart_requested = False
         self._styles_cache: Tuple = ((None, None), {})
+        # continuous-batching front end for bare-Engine sources: shape
+        # bucketing + request coalescing (serving/dispatcher.py). World
+        # sources keep their fleet scheduler (SDTPU_SERVING=0 disables).
+        self.dispatcher = None
+        if not hasattr(source, "execute") \
+                and hasattr(source, "generate_range") \
+                and os.environ.get("SDTPU_SERVING", "") != "0":
+            from stable_diffusion_webui_distributed_tpu.serving.dispatcher \
+                import ServingDispatcher
+
+            self.dispatcher = ServingDispatcher(source)
 
     # -- request execution --------------------------------------------------
 
@@ -147,9 +158,17 @@ class ApiServer:
             raise ApiError(422, str(e))
 
     def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        from stable_diffusion_webui_distributed_tpu.pipeline.xyz import is_xyz
+
         payload = GenerationPayload(**body)
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
+        if self.dispatcher is not None and not is_xyz(payload):
+            # continuous-batching path: the dispatcher owns serialization
+            # (its exec lock) so concurrent compatible requests can merge
+            # during the coalesce window instead of queuing on _busy
+            result = self.dispatcher.submit(payload, job="txt2img")
+            return self._generation_response(result)
         with self._busy:
             result = self._run_scripted(payload)
         return self._generation_response(result)
@@ -160,6 +179,9 @@ class ApiServer:
             raise ApiError(422, "img2img requires init_images")
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
+        if self.dispatcher is not None:
+            result = self.dispatcher.submit(payload, job="img2img")
+            return self._generation_response(result)
         with self._busy:
             result = self._run_scripted(payload)
         return self._generation_response(result)
@@ -254,6 +276,18 @@ class ApiServer:
         if hasattr(self.source, "interrupt_all"):
             self.source.interrupt_all()
         return {}
+
+    def handle_cancel(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-request cancel (vs /interrupt's engine-wide latch): drops
+        ONE coalesced requester's images at split time; co-batched
+        requests are unaffected. Clients pass ``request_id`` in the
+        generation payload to make their request addressable."""
+        rid = str(body.get("request_id", "") or "")
+        if not rid:
+            raise ApiError(422, "request_id required")
+        cancelled = (self.dispatcher is not None
+                     and self.dispatcher.cancel(rid))
+        return {"cancelled": cancelled}
 
     def handle_sd_models(self) -> Any:
         if self.registry is not None:
@@ -383,10 +417,22 @@ class ApiServer:
                 "thin_client_mode": getattr(
                     self.source, "thin_client_mode", False),
             }
+        serving = None
+        if self.dispatcher is not None:
+            from stable_diffusion_webui_distributed_tpu.serving.metrics \
+                import METRICS
+
+            serving = METRICS.summary()
+            serving["coalesce_window_s"] = self.dispatcher.window
+            serving["bucket_ladder"] = [
+                f"{w}x{h}" for w, h in self.dispatcher.bucketer.shapes]
+            serving["batch_ladder"] = list(self.dispatcher.bucketer.batches)
+            serving["eta_overhead"] = self.dispatcher.eta_overhead()
         return {
             "model": self.options.get("sd_model_checkpoint", ""),
             "workers": workers,
             "settings": settings,
+            "serving": serving,
             "progress": {
                 "job": p.job,
                 "sampling_step": p.sampling_step,
@@ -656,6 +702,7 @@ class ApiServer:
             ("POST", "/sdapi/v1/options"): self.handle_options_post,
             ("GET", "/sdapi/v1/progress"): self.handle_progress,
             ("POST", "/sdapi/v1/interrupt"): self.handle_interrupt,
+            ("POST", "/internal/cancel"): self.handle_cancel,
             ("GET", "/sdapi/v1/memory"): self._memory,
             ("GET", "/sdapi/v1/sd-models"): self.handle_sd_models,
             ("GET", "/sdapi/v1/embeddings"): self.handle_embeddings,

@@ -1,0 +1,18 @@
+"""Serving layer: shape bucketing, continuous batching, AOT warmup.
+
+Sits between ``server/api.py`` and ``pipeline/engine.py``; see the
+submodule docstrings.  This package init stays import-light (metrics and
+the bucketer only) because ``pipeline/engine.py`` imports
+:mod:`.metrics` — the dispatcher/warmup modules, which depend on engine
+internals at call time, are imported by their full paths.
+"""
+
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+    METRICS,
+    DispatchMetrics,
+)
+
+__all__ = ["ShapeBucketer", "METRICS", "DispatchMetrics"]

@@ -1,0 +1,164 @@
+"""Shape bucketing: bound the engine's compiled-stage cache.
+
+Every novel ``(width, height, batch)`` tuple costs a fresh XLA compile of
+the denoise chunk executable (``Engine._chunk_fn`` keys on exact shapes).
+Under open traffic that is one compile per unique request shape — the
+dominant serving-latency tax on TPU. The bucketer pads incoming requests
+UP to a small configured ladder of shapes so the cache converges to at
+most ``len(shapes) * len(batches)`` chunk executables; the serving layer
+center-crops the finished images back to the requested size, so user
+output keeps its requested dimensions.
+
+Knobs (env wins over :class:`~..runtime.config.ConfigModel` fields):
+
+- ``SDTPU_BUCKET_LADDER`` / ``ConfigModel.bucket_ladder`` — comma list of
+  ``WxH`` shapes, e.g. ``"512x512,640x640,768x768,1024x1024"``.
+- ``SDTPU_BATCH_LADDER`` / ``ConfigModel.batch_ladder`` — comma list of
+  batch sizes, e.g. ``"1,2,4,8"``.
+
+Malformed values warn and fall back to the defaults (never raise — a bad
+knob must not take the server down).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_SHAPE_LADDER: Tuple[Tuple[int, int], ...] = (
+    (512, 512), (640, 640), (768, 768), (1024, 1024))
+DEFAULT_BATCH_LADDER: Tuple[int, ...] = (1, 2, 4, 8)
+
+
+def _parse_shapes(raw: str) -> Optional[List[Tuple[int, int]]]:
+    try:
+        shapes = []
+        for part in raw.split(","):
+            w, h = part.strip().lower().split("x")
+            w, h = int(w), int(h)
+            if w <= 0 or h <= 0:
+                raise ValueError(part)
+            shapes.append((w, h))
+        return shapes or None
+    except (ValueError, AttributeError):
+        return None
+
+
+def _parse_batches(raw: str) -> Optional[List[int]]:
+    try:
+        batches = [int(p.strip()) for p in raw.split(",") if p.strip()]
+        if not batches or any(b <= 0 for b in batches):
+            return None
+        return batches
+    except (ValueError, AttributeError):
+        return None
+
+
+class ShapeBucketer:
+    """Maps raw request shapes onto the configured bucket ladder."""
+
+    def __init__(self,
+                 shapes: Optional[Sequence[Tuple[int, int]]] = None,
+                 batches: Optional[Sequence[int]] = None) -> None:
+        if shapes is None:
+            raw = os.environ.get("SDTPU_BUCKET_LADDER", "")
+            if raw:
+                shapes = _parse_shapes(raw)
+                if shapes is None:
+                    warnings.warn(
+                        f"SDTPU_BUCKET_LADDER={raw!r} is not a WxH comma "
+                        "list; using default ladder", stacklevel=2)
+        if batches is None:
+            raw = os.environ.get("SDTPU_BATCH_LADDER", "")
+            if raw:
+                batches = _parse_batches(raw)
+                if batches is None:
+                    warnings.warn(
+                        f"SDTPU_BATCH_LADDER={raw!r} is not an int comma "
+                        "list; using default ladder", stacklevel=2)
+        # sorted by area so "smallest fitting bucket" is a linear scan
+        self.shapes: List[Tuple[int, int]] = sorted(
+            set(tuple(s) for s in (shapes or DEFAULT_SHAPE_LADDER)),
+            key=lambda s: (s[0] * s[1], s))
+        self.batches: List[int] = sorted(
+            set(int(b) for b in (batches or DEFAULT_BATCH_LADDER)))
+
+    @classmethod
+    def from_config(cls, cfg) -> "ShapeBucketer":
+        """Build from :class:`ConfigModel` string fields (env still wins,
+        handled inside ``__init__`` when the parse yields nothing)."""
+        shapes = batches = None
+        raw_s = os.environ.get("SDTPU_BUCKET_LADDER") \
+            or getattr(cfg, "bucket_ladder", "")
+        raw_b = os.environ.get("SDTPU_BATCH_LADDER") \
+            or getattr(cfg, "batch_ladder", "")
+        if raw_s:
+            shapes = _parse_shapes(raw_s)
+            if shapes is None:
+                warnings.warn(f"bucket_ladder={raw_s!r} unparseable; "
+                              "using default ladder", stacklevel=2)
+        if raw_b:
+            batches = _parse_batches(raw_b)
+            if batches is None:
+                warnings.warn(f"batch_ladder={raw_b!r} unparseable; "
+                              "using default ladder", stacklevel=2)
+        return cls(shapes=shapes, batches=batches)
+
+    # -- lookups ----------------------------------------------------------
+
+    def bucket_shape(self, width: int,
+                     height: int) -> Optional[Tuple[int, int]]:
+        """Smallest-area ladder entry covering ``(width, height)``; None
+        when nothing on the ladder fits (caller runs the raw shape)."""
+        for bw, bh in self.shapes:
+            if bw >= width and bh >= height:
+                return (bw, bh)
+        return None
+
+    def bucket_batch(self, n: int) -> int:
+        """Smallest ladder batch >= n; n itself when the ladder tops out."""
+        for b in self.batches:
+            if b >= n:
+                return b
+        return n
+
+    def padding_ratio(self, width: int, height: int) -> float:
+        """Bucket pixels / requested pixels (1.0 = exact hit or no fit)."""
+        b = self.bucket_shape(width, height)
+        if b is None:
+            return 1.0
+        return (b[0] * b[1]) / float(max(1, width * height))
+
+    # -- padding / unpadding ----------------------------------------------
+
+    def bucket_payload(self, payload):
+        """Return ``(execution_payload, bucketed: bool)``.
+
+        The execution payload is a copy with ``width``/``height`` padded
+        up to the bucket and ``group_size`` snapped to the batch ladder;
+        the caller keeps the original payload for user-visible metadata.
+        ``bucketed`` is False on an exact shape hit (copy still returned
+        so the group_size snap applies uniformly)."""
+        run = payload.model_copy()
+        bucket = self.bucket_shape(payload.width, payload.height)
+        bucketed = False
+        if bucket is not None:
+            run.width, run.height = bucket
+            bucketed = bucket != (payload.width, payload.height)
+        group = max(1, run.group_size or run.batch_size)
+        run.group_size = self.bucket_batch(group)
+        return run, bucketed
+
+    @staticmethod
+    def crop(img: np.ndarray, width: int, height: int) -> np.ndarray:
+        """Center-crop a (H, W, C) uint8 array back to the requested
+        size (no-op when the image is already that size)."""
+        ih, iw = img.shape[:2]
+        if (iw, ih) == (width, height):
+            return img
+        y0 = max(0, (ih - height) // 2)
+        x0 = max(0, (iw - width) // 2)
+        return img[y0:y0 + height, x0:x0 + width]

@@ -1,0 +1,400 @@
+"""Continuous-batching dispatcher: coalesce compatible requests into one
+device batch.
+
+The HTTP layer (``server/api.py``) runs one thread per request; without
+this module a single engine serializes them whole-request-at-a-time.  The
+dispatcher instead gives every request a ticket and groups compatible
+concurrent tickets — same sampler / steps / cfg / negative prompt /
+clip-skip and the same shape BUCKET (see :mod:`.bucketer`) — into one
+merged denoise loop, then splits images, seeds and infotext back per
+requester.  The first ticket of a group becomes the *leader*: it sleeps
+one coalesce window (``SDTPU_COALESCE_WINDOW`` /
+``ConfigModel.coalesce_window``, seconds) so followers can join, runs the
+merged batch under the engine-execution lock, and wakes the followers
+with their slice.
+
+Seed-exactness: every stochastic draw in the engine is keyed by
+``(request seed + image index)`` and never by batch position
+(``runtime/rng.py``), and per-image conditioning rides as batched context
+rows — so each requester's seeds, subseeds and infotext are byte-identical
+to a serial run of the same payload through this dispatcher.  (Pixel
+bytes match too whenever the merged prompts tokenize to the same context
+chunk count; a longer neighbor prompt pads every context in the batch,
+which is the same rule the fleet scheduler pins via
+``payload.context_chunks``.)
+
+Per-request cancellation: ``cancel(request_id)`` marks one ticket; the
+merged device batch keeps running (removing rows would need a recompile)
+but the cancelled requester's images are dropped at split time and no
+other requester is affected.  The global interrupt flag keeps its
+engine-wide semantics.
+
+Requests that cannot merge (img2img, hires, ControlNet, LoRA tags,
+per-image prompts, adaptive samplers — the DPM adaptive controller
+consumes ONE error norm over the whole batch, so merging would change
+pixels) run solo under the same execution lock, still shape-bucketed when
+possible.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+import warnings
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+DEFAULT_COALESCE_WINDOW = 0.05
+
+
+def _coalesce_window(cfg=None) -> float:
+    raw = os.environ.get("SDTPU_COALESCE_WINDOW", "")
+    if not raw and cfg is not None:
+        val = getattr(cfg, "coalesce_window", None)
+        if val is not None:
+            return max(0.0, float(val))
+    if raw:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            warnings.warn(
+                f"SDTPU_COALESCE_WINDOW={raw!r} is not a float; using "
+                f"default {DEFAULT_COALESCE_WINDOW}", stacklevel=2)
+    return DEFAULT_COALESCE_WINDOW
+
+
+class Ticket:
+    """One queued request: original payload + bucketed execution copy."""
+
+    def __init__(self, payload, run, job: str, bucketed: bool,
+                 request_id: str) -> None:
+        self.payload = payload          # user-visible metadata source
+        self.run = run                  # execution payload (bucket dims)
+        self.job = job
+        self.bucketed = bucketed
+        self.request_id = request_id
+        self.enqueued = time.monotonic()
+        self.done = threading.Event()
+        self.cancelled = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Group:
+    def __init__(self, key) -> None:
+        self.key = key
+        self.tickets: List[Ticket] = []
+        self.images = 0
+        self.closed = False
+
+
+class ServingDispatcher:
+    """Leader/follower coalescer in front of a single :class:`Engine`."""
+
+    def __init__(self, engine, bucketer: Optional[ShapeBucketer] = None,
+                 window: Optional[float] = None, config=None) -> None:
+        self.engine = engine
+        self.bucketer = bucketer or (
+            ShapeBucketer.from_config(config) if config is not None
+            else ShapeBucketer())
+        self.window = _coalesce_window(config) if window is None \
+            else max(0.0, float(window))
+        self.max_batch = max(self.bucketer.batches)
+        self._lock = threading.Lock()
+        self._exec_lock = threading.Lock()
+        self._groups: Dict[tuple, _Group] = {}
+        self._tickets: Dict[str, Ticket] = {}
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, payload, job: str = "txt2img"):
+        """Execute ``payload`` (blocking) and return its GenerationResult.
+
+        Called concurrently from HTTP handler threads; compatible callers
+        arriving within one coalesce window share a device batch."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            apply_scripts, fix_seed,
+        )
+
+        payload = apply_scripts(payload.model_copy())
+        payload.seed = fix_seed(payload.seed)
+        payload.subseed = fix_seed(payload.subseed)
+
+        bypass = bool(payload.init_images or payload.enable_hr)
+        if bypass:
+            run, bucketed = payload.model_copy(), False
+            METRICS.record_request(False, bypassed=True)
+        else:
+            run, bucketed = self.bucketer.bucket_payload(payload)
+            METRICS.record_request(
+                bucketed,
+                padding_ratio=self.bucketer.padding_ratio(
+                    payload.width, payload.height))
+
+        rid = str(getattr(payload, "request_id", "") or uuid.uuid4().hex)
+        ticket = Ticket(payload, run, job, bucketed, rid)
+        with self._lock:
+            self._tickets[rid] = ticket
+        try:
+            if self._coalescable(run):
+                self._run_grouped(ticket)
+            else:
+                self._run_solo(ticket)
+            if ticket.error is not None:
+                raise ticket.error
+            return ticket.result
+        finally:
+            with self._lock:
+                self._tickets.pop(rid, None)
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel ONE queued/running request; its images are dropped at
+        split time and co-batched requests are untouched."""
+        with self._lock:
+            t = self._tickets.get(str(request_id))
+        if t is None:
+            return False
+        t.cancelled.set()
+        return True
+
+    def eta_overhead(self, payload=None) -> Dict[str, float]:
+        """Serving-layer additions for :func:`scheduler.eta.predict_eta`:
+        expected queue wait (observed average, floored at half the
+        coalesce window) and the padding-overhead factor for this
+        payload's bucket."""
+        wait = METRICS.avg_queue_wait() or (self.window / 2.0)
+        if payload is not None:
+            pad = self.bucketer.padding_ratio(payload.width, payload.height)
+        else:
+            pad = METRICS.avg_padding_ratio()
+        return {"queue_wait": wait, "padding_overhead": pad}
+
+    # -- grouping ----------------------------------------------------------
+
+    def _coalescable(self, p) -> bool:
+        from stable_diffusion_webui_distributed_tpu.samplers import (
+            kdiffusion as kd,
+        )
+
+        if p.init_images or p.enable_hr or p.all_prompts:
+            return False
+        if p.refiner_checkpoint and p.refiner_switch_at < 1.0:
+            return False
+        if "<lora:" in (p.prompt or ""):
+            return False
+        if kd.resolve_sampler(p.sampler_name).adaptive:
+            return False
+        if self.engine._parse_controlnet_units(p):
+            return False
+        if self.engine.family.inpaint:
+            return False
+        return p.total_images <= self.max_batch
+
+    def _group_key(self, run) -> tuple:
+        return ("txt2img", run.sampler_name, int(run.steps),
+                int(run.width), int(run.height), float(run.cfg_scale),
+                run.negative_prompt or "", int(run.clip_skip or 0))
+
+    def _run_grouped(self, ticket: Ticket) -> None:
+        key = self._group_key(ticket.run)
+        n = ticket.run.total_images
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None or g.closed or g.images + n > self.max_batch:
+                g = _Group(key)
+                self._groups[key] = g
+                leader = True
+            else:
+                leader = False
+            g.tickets.append(ticket)
+            g.images += n
+        if not leader:
+            ticket.done.wait()
+            return
+        if self.window > 0:
+            time.sleep(self.window)
+        with self._exec_lock:
+            # close AFTER taking the engine: followers kept joining while
+            # a previous batch held the device (continuous batching)
+            with self._lock:
+                g.closed = True
+                if self._groups.get(key) is g:
+                    self._groups.pop(key)
+            start = time.monotonic()
+            for t in g.tickets:
+                METRICS.record_queue_wait(start - t.enqueued)
+            try:
+                self._execute_group(g)
+            except BaseException as e:  # noqa: BLE001 — delivered per ticket
+                for t in g.tickets:
+                    if t.error is None and t.result is None:
+                        t.error = e
+            finally:
+                for t in g.tickets:
+                    t.done.set()
+
+    def _run_solo(self, ticket: Ticket) -> None:
+        with self._exec_lock:
+            start = time.monotonic()
+            METRICS.record_queue_wait(start - ticket.enqueued)
+            METRICS.record_dispatch(1)
+            try:
+                self.engine.state.begin_request()
+                if ticket.cancelled.is_set():
+                    ticket.result = self._empty_result(ticket)
+                    return
+                result = self.engine.generate_range(
+                    ticket.run, 0, None, ticket.job)
+                if ticket.bucketed:
+                    result = self._restore_solo(result, ticket)
+                ticket.result = result
+            except BaseException as e:  # noqa: BLE001
+                ticket.error = e
+            finally:
+                ticket.done.set()
+
+    # -- merged execution --------------------------------------------------
+
+    def _execute_group(self, g: _Group) -> None:
+        import jax.numpy as jnp
+
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            GenerationResult,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime import rng
+        from stable_diffusion_webui_distributed_tpu.samplers import (
+            kdiffusion as kd,
+        )
+
+        engine = self.engine
+        live = [t for t in g.tickets if not t.cancelled.is_set()]
+        for t in g.tickets:
+            if t not in live:
+                t.result = self._empty_result(t)
+        if not live:
+            return
+        METRICS.record_dispatch(len(live))
+
+        rp = live[0].run.model_copy()
+        width, height = rp.width, rp.height
+        h, w = engine._latent_hw(width, height)
+        C = engine.family.vae.latent_channels
+        spec = kd.resolve_sampler(rp.sampler_name)
+        sigmas = kd.build_sigmas(spec, engine.schedule, rp.steps)
+
+        engine.state.begin_request()
+        engine._adaptive_incomplete = False
+        engine._apply_prompt_loras(rp)  # tagless: restores pristine params
+
+        # context length pinned to the group max so every merged request
+        # pads its conditioning identically (same contract the fleet pins
+        # via payload.context_chunks)
+        chunks = max(engine.request_context_chunks(p)
+                     for p in (t.run for t in live))
+        counts, noise_parts, key_parts = [], [], []
+        ctx_rows, pooled_rows = [], []
+        ctx_u = pooled_u = None
+        for t in live:
+            p = t.run.model_copy()
+            p.context_chunks = chunks
+            n_p = p.total_images
+            counts.append(n_p)
+            noise_parts.append(rng.batch_noise(
+                p.seed, p.subseed, p.subseed_strength, 0, n_p, (h, w, C),
+                seed_resize=engine._seed_resize_latent(p),
+                pin_index=p.same_seed))
+            key_parts.append(engine._image_keys(p, 0, n_p))
+            (cu, cc), (pu, pc) = engine.encode_prompts(p)
+            ctx_rows.append(jnp.broadcast_to(cc, (n_p,) + cc.shape[1:]))
+            pooled_rows.append(jnp.broadcast_to(pc, (n_p,) + pc.shape[1:]))
+            if ctx_u is None:
+                ctx_u, pooled_u = cu, pu  # equal negatives across the key
+
+        b_raw = sum(counts)
+        b_run = self.bucketer.bucket_batch(b_raw)
+        noise = jnp.concatenate(noise_parts, axis=0)
+        keys = jnp.concatenate(key_parts, axis=0)
+        ctx_c = jnp.concatenate(ctx_rows, axis=0)
+        pooled_c = jnp.concatenate(pooled_rows, axis=0)
+        if b_run > b_raw:
+            # pad-and-drop up to the batch bucket: the extra rows repeat
+            # the last image and are discarded after decode
+            pad = b_run - b_raw
+
+            def _pad(a):
+                return jnp.concatenate(
+                    [a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
+
+            noise, keys = _pad(noise), _pad(keys)
+            ctx_c, pooled_c = _pad(ctx_c), _pad(pooled_c)
+
+        x = engine._place_batch(noise.astype(jnp.float32) * sigmas[0])
+        latents = engine._denoise_range(
+            rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
+            width, height, 0, rp.steps, "txt2img", None, None, ())
+        entries = engine._queue_decoded(latents, 0, b_raw, width, height)
+        imgs = np.concatenate(
+            [np.asarray(e[0])[:e[2]] for e in entries], axis=0)
+
+        off = 0
+        for t, n_p in zip(live, counts):
+            rows = imgs[off:off + n_p]
+            off += n_p
+            if t.cancelled.is_set():
+                t.result = self._empty_result(t)
+                continue
+            out = GenerationResult(parameters=t.payload.model_dump())
+            ow, oh = t.payload.width, t.payload.height
+            if t.bucketed:
+                rows = np.stack(
+                    [self.bucketer.crop(im, ow, oh) for im in rows])
+            engine._append_images(out, t.payload, rows, 0, n_p, ow, oh)
+            t.result = out
+        engine.state.finish()
+
+    # -- result fix-up -----------------------------------------------------
+
+    def _empty_result(self, ticket: Ticket):
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            GenerationResult,
+        )
+
+        params = ticket.payload.model_dump()
+        params["cancelled"] = True
+        return GenerationResult(parameters=params)
+
+    def _restore_solo(self, result, ticket: Ticket):
+        """Crop a bucketed solo run back to the requested size and rebuild
+        infotext from the ORIGINAL payload so user-visible metadata shows
+        the requested dimensions."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            array_to_b64png, b64png_to_array, build_infotext,
+        )
+
+        orig = ticket.payload
+        bw, bh = ticket.run.width, ticket.run.height
+        for i, b64 in enumerate(result.images):
+            arr = b64png_to_array(b64)
+            if arr.shape[:2] != (bh, bw):
+                continue  # hires/second-pass output: not bucket-sized
+            result.images[i] = array_to_b64png(
+                self.bucketer.crop(arr, orig.width, orig.height))
+            suffix = ""
+            if i < len(result.infotexts) and \
+                    result.infotexts[i].endswith(", DPM adaptive: incomplete"):
+                suffix = ", DPM adaptive: incomplete"
+            prompt_i = result.prompts[i] if i < len(result.prompts) \
+                else orig.prompt
+            result.infotexts[i] = build_infotext(
+                orig, int(result.seeds[i]), int(result.subseeds[i]),
+                self.engine.model_name, orig.width, orig.height,
+                prompt_override=prompt_i) + suffix
+        return result

@@ -1,0 +1,145 @@
+"""Dispatch metrics for the serving layer.
+
+A single process-wide :data:`METRICS` object counts the events that decide
+serving latency on an XLA backend: how many compiled stages were BUILT
+(each build is one XLA compile on first dispatch — minutes on TPU), how
+often a request's shape landed on an already-compiled bucket, how many
+requests each device dispatch carried (the coalesce factor), and how long
+requests waited in the coalesce queue. Everything here is host-side
+counting — safe to assert in CPU tests, unlike wall-clock.
+
+``Engine._cached`` reports every stage build/hit; the serving dispatcher
+reports requests, dispatches and queue waits; ``handle_internal_status``
+exposes :meth:`DispatchMetrics.summary` under ``"serving"``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+
+class DispatchMetrics:
+    """Thread-safe counters; every mutator is O(1) under one lock."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.clear()
+
+    def clear(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            #: stage-kind ("chunk", "decode_u8", "encode", ...) -> builds
+            self.compiles: Dict[str, int] = defaultdict(int)
+            #: stage-kind -> cache hits (stage already built)
+            self.cache_hits: Dict[str, int] = defaultdict(int)
+            self.requests = 0
+            #: request shape already equal to its bucket
+            self.bucket_hits = 0
+            #: request shape padded up to a bucket
+            self.bucket_misses = 0
+            #: request bypassed bucketing (hires/img2img/no ladder fit)
+            self.bucket_bypasses = 0
+            #: device batches executed by the dispatcher
+            self.dispatches = 0
+            #: dispatches that merged >= 2 requests
+            self.coalesced_dispatches = 0
+            #: sum over dispatches of requests merged (factor numerator)
+            self.coalesced_requests = 0
+            self.queue_wait_total = 0.0
+            self.queue_wait_count = 0
+            #: sum of (bucket px / requested px) per bucketed request
+            self.padding_ratio_total = 0.0
+            self.padding_ratio_count = 0
+
+    # -- engine-side ------------------------------------------------------
+
+    def record_compile(self, kind: str) -> None:
+        with self._lock:
+            self.compiles[str(kind)] += 1
+
+    def record_cache_hit(self, kind: str) -> None:
+        with self._lock:
+            self.cache_hits[str(kind)] += 1
+
+    # -- dispatcher-side --------------------------------------------------
+
+    def record_request(self, bucketed: bool, bypassed: bool = False,
+                       padding_ratio: float = 1.0) -> None:
+        with self._lock:
+            self.requests += 1
+            if bypassed:
+                self.bucket_bypasses += 1
+                return
+            if bucketed:
+                self.bucket_misses += 1
+            else:
+                self.bucket_hits += 1
+            self.padding_ratio_total += float(padding_ratio)
+            self.padding_ratio_count += 1
+
+    def record_dispatch(self, n_requests: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.coalesced_requests += int(n_requests)
+            if n_requests >= 2:
+                self.coalesced_dispatches += 1
+
+    def record_queue_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.queue_wait_total += float(seconds)
+            self.queue_wait_count += 1
+
+    # -- readers ----------------------------------------------------------
+
+    def compile_count(self, kind: str = "chunk") -> int:
+        with self._lock:
+            return self.compiles.get(kind, 0)
+
+    def coalesce_factor(self) -> float:
+        """Mean requests per device dispatch (1.0 = no coalescing yet)."""
+        with self._lock:
+            if not self.dispatches:
+                return 0.0
+            return self.coalesced_requests / self.dispatches
+
+    def avg_queue_wait(self) -> float:
+        with self._lock:
+            if not self.queue_wait_count:
+                return 0.0
+            return self.queue_wait_total / self.queue_wait_count
+
+    def avg_padding_ratio(self) -> float:
+        """Mean bucket-px / requested-px over bucketed requests (>= 1)."""
+        with self._lock:
+            if not self.padding_ratio_count:
+                return 1.0
+            return self.padding_ratio_total / self.padding_ratio_count
+
+    def summary(self) -> Dict:
+        with self._lock:
+            total_buckets = self.bucket_hits + self.bucket_misses
+            return {
+                "compiles": dict(self.compiles),
+                "cache_hits": dict(self.cache_hits),
+                "requests": self.requests,
+                "bucket_hits": self.bucket_hits,
+                "bucket_misses": self.bucket_misses,
+                "bucket_bypasses": self.bucket_bypasses,
+                "bucket_hit_rate": (self.bucket_hits / total_buckets
+                                    if total_buckets else None),
+                "dispatches": self.dispatches,
+                "coalesced_dispatches": self.coalesced_dispatches,
+                "coalesce_factor": (self.coalesced_requests / self.dispatches
+                                    if self.dispatches else None),
+                "avg_queue_wait_s": (self.queue_wait_total
+                                     / self.queue_wait_count
+                                     if self.queue_wait_count else None),
+                "avg_padding_ratio": (self.padding_ratio_total
+                                      / self.padding_ratio_count
+                                      if self.padding_ratio_count else None),
+            }
+
+
+#: Process-wide metrics instance (mirrors ``trace.STATS``).
+METRICS = DispatchMetrics()

@@ -1,0 +1,87 @@
+"""AOT warmup: pre-build the bucket ladder's executables at server start.
+
+The chunk executable is keyed on exact ``(sampler, steps, width, height,
+batch)`` — so with shape bucketing in front, the full set of executables
+a server will ever dispatch is known AT STARTUP: the bucket ladder times
+the batch ladder at the configured serving defaults.  Warmup runs one
+tiny generation per bucket so every stage (text encode, chunk loop, VAE
+decode) is built — and, with the persistent XLA cache enabled
+(``runtime/mesh.py:enable_compilation_cache``), compiled artifacts land
+on disk, so even a RESTARTED server re-serves its first request at
+dispatch cost rather than compile cost.
+
+Knobs: ``SDTPU_WARMUP`` (0 disables, default on when invoked),
+``SDTPU_WARMUP_STEPS`` / ``SDTPU_WARMUP_SAMPLER`` pick the (steps,
+sampler) point to pre-build — warmup only pays off for the step counts
+traffic actually uses, since steps are part of the compile key.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Dict, Optional
+
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(f"{name}={raw!r} is not an integer; using default "
+                      f"{default}", stacklevel=2)
+        return default
+
+
+def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
+                  steps: Optional[int] = None,
+                  sampler: Optional[str] = None,
+                  cache_dir: Optional[str] = None) -> Dict:
+    """Pre-lower every (shape, batch) bucket's pipeline; returns a report
+    of how many stage builds the sweep triggered and its wall time."""
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+        enable_compilation_cache,
+    )
+
+    if os.environ.get("SDTPU_WARMUP", "") == "0":
+        return {"skipped": True, "reason": "SDTPU_WARMUP=0"}
+
+    active_cache = enable_compilation_cache(cache_dir)
+    bucketer = bucketer or ShapeBucketer()
+    steps = steps if steps is not None else _env_int("SDTPU_WARMUP_STEPS", 20)
+    sampler = sampler or os.environ.get("SDTPU_WARMUP_SAMPLER", "Euler a")
+
+    before = dict(METRICS.summary()["compiles"])
+    t0 = time.monotonic()
+    warmed = []
+    for bw, bh in bucketer.shapes:
+        for nb in bucketer.batches:
+            payload = GenerationPayload(
+                prompt="", steps=steps, width=bw, height=bh,
+                batch_size=nb, sampler_name=sampler, seed=0)
+            engine.state.begin_request()
+            engine.generate_range(payload, 0, None, "warmup")
+            warmed.append((bw, bh, nb))
+    after = METRICS.summary()["compiles"]
+    built = {k: after.get(k, 0) - before.get(k, 0)
+             for k in after if after.get(k, 0) != before.get(k, 0)}
+    return {
+        "skipped": False,
+        "buckets": warmed,
+        "steps": steps,
+        "sampler": sampler,
+        "stage_builds": built,
+        "xla_cache_dir": active_cache,
+        "wall_s": round(time.monotonic() - t0, 2),
+    }

@@ -393,3 +393,32 @@ class TestRingChunking:
         np.testing.assert_allclose(chunked, dense, rtol=2e-5, atol=2e-5)
         np.testing.assert_allclose(
             dense, np.asarray(reference(q, k, v)), rtol=2e-4, atol=2e-4)
+
+    def test_non_divisor_chunk_pads_masked_tail(self, monkeypatch):
+        """A chunk size that does not divide the per-device block pads K/V
+        with masked rows (scores -> -inf) instead of silently rounding the
+        chunk down — the result must still match the dense fold."""
+        from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
+            ring_attention,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+            build_mesh,
+        )
+
+        mesh = build_mesh("sp=4")
+        q, k, v = qkv(1, 4 * 128, 2, 16)   # t_loc = 128 per device
+        monkeypatch.setenv("SDTPU_RING_CHUNK", "48")  # 3 chunks, 16 pad rows
+        chunked = np.asarray(ring_attention(q, k, v, mesh))
+        np.testing.assert_allclose(
+            chunked, np.asarray(reference(q, k, v)), rtol=2e-4, atol=2e-4)
+
+    def test_chunk_env_warn_and_default(self, monkeypatch):
+        import importlib
+
+        # the ops package re-exports the ring_attention FUNCTION under the
+        # module's name, so fetch the module itself
+        ra = importlib.import_module(
+            "stable_diffusion_webui_distributed_tpu.ops.ring_attention")
+        monkeypatch.setenv("SDTPU_RING_CHUNK", "not-an-int")
+        with pytest.warns(UserWarning, match="SDTPU_RING_CHUNK"):
+            assert ra._ring_chunk() == ra._RING_CHUNK_DEFAULT

@@ -801,6 +801,29 @@ class TestAdaptiveNoSplit:
         jobs = w.plan(payload(batch_size=4, sampler_name="Euler a"))
         assert len(jobs) == 2
 
+    def test_label_tie_break_deterministic(self):
+        # equal avg_ipm: lowest label wins, every time — plan output must
+        # not depend on worker registration order or dict iteration
+        w = World(ConfigModel())
+        w.add_worker(node("zeta", 10.0, master=True))
+        w.add_worker(node("alpha", 10.0))
+        for _ in range(3):
+            jobs = w.plan(payload(batch_size=4, sampler_name="DPM adaptive"))
+            assert [j.worker.label for j in jobs] == ["alpha"]
+
+    def test_all_fitting_backends_stalled_still_no_split(self):
+        # the only backend that FITS the request stalls badly vs the
+        # (capped) fastest; a slow whole-request run still beats splitting,
+        # which would change the adaptive trajectory and the pixels
+        w = World(ConfigModel())
+        w.add_worker(node("fast-capped", 30.0, master=True,
+                          pixel_cap=2 * 512 * 512))
+        w.add_worker(node("slow-roomy", 1.0))
+        jobs = w.plan(payload(batch_size=4, sampler_name="DPM adaptive"))
+        assert len(jobs) == 1
+        assert jobs[0].worker.label == "slow-roomy"
+        assert jobs[0].batch_size == 4
+
     def test_execute_merges_single_job(self):
         w = World(ConfigModel())
         w.add_worker(node("m", 10.0, master=True))

@@ -1,0 +1,309 @@
+"""Serving layer: shape bucketer, dispatch metrics, continuous batching.
+
+The acceptance scenario from the serving design: 8 concurrent requests
+across 4 raw shapes must land on 2 bucket executables (<= 2 chunk
+compiles), merge into coalesced device batches, and return seeds /
+infotext / image bytes identical to serial execution of the same
+payloads.  All assertions are host-side counts — no wall-clock.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload, b64png_to_array,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+    EtaCalibration, predict_eta,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    DEFAULT_BATCH_LADDER, DEFAULT_SHAPE_LADDER, ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+    METRICS, DispatchMetrics,
+)
+from test_pipeline import init_params
+
+
+def payload(**kw):
+    defaults = dict(prompt="a cow", steps=4, width=32, height=32,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+class TestBucketer:
+    def test_smallest_fitting_bucket(self):
+        b = ShapeBucketer(shapes=DEFAULT_SHAPE_LADDER,
+                          batches=DEFAULT_BATCH_LADDER)
+        assert b.bucket_shape(500, 500) == (512, 512)
+        assert b.bucket_shape(512, 512) == (512, 512)
+        assert b.bucket_shape(513, 512) == (640, 640)
+        assert b.bucket_shape(1025, 64) is None  # nothing fits -> raw
+
+    def test_batch_ladder(self):
+        b = ShapeBucketer(shapes=[(64, 64)], batches=[1, 2, 4, 8])
+        assert b.bucket_batch(1) == 1
+        assert b.bucket_batch(3) == 4
+        assert b.bucket_batch(8) == 8
+        assert b.bucket_batch(9) == 9  # ladder tops out: run raw
+
+    def test_padding_ratio(self):
+        b = ShapeBucketer(shapes=[(512, 512)], batches=[1])
+        assert b.padding_ratio(512, 512) == pytest.approx(1.0)
+        assert b.padding_ratio(256, 256) == pytest.approx(4.0)
+        assert b.padding_ratio(4096, 4096) == pytest.approx(1.0)  # no fit
+
+    def test_payload_pad_and_crop_round_trip(self):
+        b = ShapeBucketer(shapes=[(32, 32)], batches=[4])
+        p = payload(width=24, height=20)
+        run, bucketed = b.bucket_payload(p)
+        assert bucketed and (run.width, run.height) == (32, 32)
+        assert run.group_size == 4
+        assert (p.width, p.height) == (24, 20)  # original untouched
+        img = np.arange(32 * 32 * 3, dtype=np.uint8).reshape(32, 32, 3)
+        back = ShapeBucketer.crop(img, p.width, p.height)
+        assert back.shape == (20, 24, 3)
+        # center crop: offsets (32-20)//2 = 6 rows, (32-24)//2 = 4 cols
+        np.testing.assert_array_equal(back, img[6:26, 4:28])
+        assert ShapeBucketer.crop(img, 32, 32) is img  # exact hit: no-op
+
+    def test_exact_hit_not_bucketed(self):
+        b = ShapeBucketer(shapes=[(32, 32)], batches=[1])
+        run, bucketed = b.bucket_payload(payload(width=32, height=32))
+        assert not bucketed and (run.width, run.height) == (32, 32)
+
+    def test_env_ladder_parse(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_BUCKET_LADDER", "64x64, 128x96")
+        monkeypatch.setenv("SDTPU_BATCH_LADDER", "2, 4")
+        b = ShapeBucketer()
+        assert b.shapes == [(64, 64), (128, 96)]
+        assert b.batches == [2, 4]
+
+    def test_env_ladder_warn_and_default(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_BUCKET_LADDER", "not-a-ladder")
+        monkeypatch.setenv("SDTPU_BATCH_LADDER", "4,-1")
+        with pytest.warns(UserWarning, match="SDTPU_BUCKET_LADDER"):
+            b = ShapeBucketer()
+        assert set(b.shapes) == set(DEFAULT_SHAPE_LADDER)
+        assert set(b.batches) == set(DEFAULT_BATCH_LADDER)
+
+    def test_from_config(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_BUCKET_LADDER", raising=False)
+        monkeypatch.delenv("SDTPU_BATCH_LADDER", raising=False)
+
+        class Cfg:
+            bucket_ladder = "96x96"
+            batch_ladder = "1,2"
+
+        b = ShapeBucketer.from_config(Cfg())
+        assert b.shapes == [(96, 96)] and b.batches == [1, 2]
+        # env wins over config fields
+        monkeypatch.setenv("SDTPU_BUCKET_LADDER", "48x48")
+        assert ShapeBucketer.from_config(Cfg()).shapes == [(48, 48)]
+
+
+class TestMetrics:
+    def test_counters_and_summary(self):
+        m = DispatchMetrics()
+        m.record_compile("chunk")
+        m.record_compile("chunk")
+        m.record_cache_hit("chunk")
+        m.record_request(bucketed=True, padding_ratio=2.0)
+        m.record_request(bucketed=False, padding_ratio=1.0)
+        m.record_request(bucketed=False, bypassed=True)
+        m.record_dispatch(4)
+        m.record_dispatch(1)
+        m.record_queue_wait(0.2)
+        m.record_queue_wait(0.4)
+        s = m.summary()
+        assert m.compile_count("chunk") == 2
+        assert s["cache_hits"] == {"chunk": 1}
+        assert s["requests"] == 3 and s["bucket_bypasses"] == 1
+        assert s["bucket_hit_rate"] == pytest.approx(0.5)
+        assert s["dispatches"] == 2 and s["coalesced_dispatches"] == 1
+        assert m.coalesce_factor() == pytest.approx(2.5)
+        assert m.avg_queue_wait() == pytest.approx(0.3)
+        assert m.avg_padding_ratio() == pytest.approx(1.5)
+        m.clear()
+        assert m.summary()["requests"] == 0
+        assert m.coalesce_factor() == 0.0
+
+
+class TestEtaOverheads:
+    def test_padding_scales_and_wait_adds(self):
+        cal = EtaCalibration(avg_ipm=6.0)
+        p = payload(batch_size=2, steps=20, width=512, height=512)
+        base = predict_eta(cal, p)  # 20 s at the benchmark point
+        assert predict_eta(cal, p, padding_overhead=2.0) == \
+            pytest.approx(2.0 * base)
+        assert predict_eta(cal, p, queue_wait=5.0) == \
+            pytest.approx(base + 5.0)
+        # wait is latency, not compute: a sub-1 padding factor never
+        # shrinks the estimate and negative wait never subtracts
+        assert predict_eta(cal, p, padding_overhead=0.5,
+                           queue_wait=-3.0) == pytest.approx(base)
+
+    def test_dispatcher_eta_overhead(self):
+        METRICS.clear()
+        disp = ServingDispatcher(
+            None, bucketer=ShapeBucketer(shapes=[(64, 64)], batches=[1]),
+            window=0.2)
+        over = disp.eta_overhead(payload(width=32, height=32))
+        assert over["padding_overhead"] == pytest.approx(4.0)
+        # no observed waits yet: floor at half the coalesce window
+        assert over["queue_wait"] == pytest.approx(0.1)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState())
+
+
+@pytest.fixture(scope="module")
+def bucketer():
+    # batches=[4]: every group partition pads to the same compiled batch,
+    # so the compile count is deterministic under thread scheduling
+    return ShapeBucketer(shapes=[(32, 32), (48, 48)], batches=[4])
+
+
+class TestContinuousBatching:
+    # 8 requests over 4 raw shapes that map onto 2 buckets; prompts vary
+    # per shape so merged conditioning really is per-request
+    SHAPES = [(32, 32), (24, 32), (48, 48), (40, 40)]
+
+    def _payloads(self):
+        out = []
+        for i, (w, h) in enumerate(self.SHAPES):
+            for k in range(2):
+                out.append(payload(width=w, height=h, seed=100 + i * 10 + k,
+                                   prompt=f"cow {i}"))
+        return out
+
+    def test_acceptance_coalesce_and_byte_exactness(self, engine, bucketer):
+        serial = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        coalesced = ServingDispatcher(engine, bucketer=bucketer, window=0.6)
+
+        METRICS.clear()
+        baseline = [serial.submit(p) for p in self._payloads()]
+        assert METRICS.compile_count("chunk") <= 2  # one per shape bucket
+        assert METRICS.summary()["dispatches"] == 8
+
+        METRICS.clear()
+        results = [None] * 8
+        errors = []
+
+        def run(i, p):
+            try:
+                results[i] = coalesced.submit(p)
+            except Exception as e:  # noqa: BLE001 — surfaced by assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i, p))
+                   for i, p in enumerate(self._payloads())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        s = METRICS.summary()
+        # the whole point: 4 raw shapes -> 2 executables, and the serial
+        # phase already built both, so the concurrent phase compiles NOTHING
+        assert s["compiles"].get("chunk", 0) == 0
+        assert s["coalesced_dispatches"] >= 1
+        assert s["coalesce_factor"] >= 2.0
+        assert s["requests"] == 8 and s["bucket_bypasses"] == 0
+
+        for got, want in zip(results, baseline):
+            assert got.seeds == want.seeds
+            assert got.infotexts == want.infotexts
+            assert got.images == want.images  # pixel bytes, not just shape
+
+    def test_infotext_reports_requested_size(self, engine, bucketer):
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        r = disp.submit(payload(width=24, height=32, seed=5))
+        assert len(r.images) == 1
+        assert b64png_to_array(r.images[0]).shape == (32, 24, 3)
+        assert "Size: 24x32" in r.infotexts[0]
+        assert r.seeds == [5]
+
+    def test_cancel_drops_only_one_requester(self, engine, bucketer):
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.6)
+        solo = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        keep = payload(width=32, height=32, seed=11,
+                       request_id="req-keep")
+        drop = payload(width=32, height=32, seed=12,
+                       request_id="req-drop")
+        results = {}
+
+        def run(name, p):
+            results[name] = disp.submit(p)
+
+        threads = [threading.Thread(target=run, args=("keep", keep)),
+                   threading.Thread(target=run, args=("drop", drop))]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)  # inside the coalesce window
+        assert disp.cancel("req-drop")
+        assert not disp.cancel("no-such-request")
+        for t in threads:
+            t.join()
+
+        cancelled = results["drop"]
+        assert cancelled.images == []
+        assert cancelled.parameters.get("cancelled") is True
+        # the co-batched survivor is byte-identical to running alone
+        alone = solo.submit(payload(width=32, height=32, seed=11))
+        assert results["keep"].seeds == alone.seeds
+        assert results["keep"].images == alone.images
+        assert results["keep"].infotexts == alone.infotexts
+
+    def test_solo_bucketed_run_restored(self, engine):
+        # batch above the ladder top -> not coalescable -> solo path,
+        # still shape-bucketed and cropped + infotext-rebuilt afterwards
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(payload(width=24, height=32, seed=21, batch_size=2))
+        assert len(r.images) == 2
+        for b64 in r.images:
+            assert b64png_to_array(b64).shape == (32, 24, 3)
+        assert all("Size: 24x32" in t for t in r.infotexts)
+        assert r.seeds == [21, 22]
+
+    def test_warmup_prebuilds_ladder(self, engine):
+        from stable_diffusion_webui_distributed_tpu.serving.warmup import (
+            warmup_engine,
+        )
+
+        b = ShapeBucketer(shapes=[(32, 32)], batches=[1])
+        report = warmup_engine(engine, b, steps=4, sampler="Euler a")
+        assert report["skipped"] is False
+        assert report["buckets"] == [(32, 32, 1)]
+        assert report["steps"] == 4 and report["sampler"] == "Euler a"
+        assert isinstance(report["stage_builds"], dict)
+        # a second sweep over the same ladder builds nothing new
+        again = warmup_engine(engine, b, steps=4, sampler="Euler a")
+        assert again["stage_builds"] == {}
+
+    def test_warmup_env_disable(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.serving.warmup import (
+            warmup_engine,
+        )
+
+        monkeypatch.setenv("SDTPU_WARMUP", "0")
+        report = warmup_engine(None)  # engine untouched when disabled
+        assert report["skipped"] is True
