@@ -1,0 +1,72 @@
+"""sdtpu-lint: AST static analysis for trace purity, recompile hazards,
+and lock discipline.
+
+Run over the repo:   python -m stable_diffusion_webui_distributed_tpu.analysis
+Tier-1 gate:         tests/test_lint.py (zero findings vs the committed
+                     allowlist); tools/lint_report.py emits the JSON summary.
+Rule reference:      ANALYSIS.md at the repo root.
+
+Pure ``ast``/``tokenize`` — importable and runnable with no JAX device and
+without importing any of the code under analysis.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from . import allowlist as allowlist_mod
+from . import envrules, locks, purity, recompile
+from .core import RULES, Finding, ModuleInfo, walk_package
+
+__all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis"]
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]  # unsuppressed (includes AL001/AL002)
+    suppressed: List[Finding]
+    modules: int = 0
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def analyze_modules(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(purity.check(modules))
+    findings.extend(recompile.check(modules))
+    findings.extend(envrules.check(modules))
+    findings.extend(locks.check(modules))
+    # rule passes may re-walk nested statements; dedupe identical findings
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.symbol, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def run_analysis(root: str,
+                 paths: Optional[Sequence[str]] = None,
+                 allowlist_path: Optional[str] = None,
+                 use_allowlist: bool = True,
+                 today: Optional[datetime.date] = None) -> AnalysisResult:
+    modules = walk_package(root, paths)
+    findings = analyze_modules(modules)
+    suppressed: List[Finding] = []
+    if use_allowlist:
+        entries, list_path = allowlist_mod.load(allowlist_path)
+        findings, suppressed = allowlist_mod.apply(findings, entries,
+                                                   list_path, today=today)
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return AnalysisResult(findings=findings, suppressed=suppressed,
+                          modules=len(modules), counts=counts)

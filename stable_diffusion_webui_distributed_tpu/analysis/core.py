@@ -1,0 +1,267 @@
+"""sdtpu-lint core: file walking, AST indexing, and shared resolution helpers.
+
+Everything here is pure-AST (``ast`` + ``tokenize`` only): the analyzer must
+run inside tier-1 on a CPU-only box with no JAX device and no imports of the
+code under analysis. Rule modules (purity / recompile / envrules / locks)
+consume the ``ModuleInfo`` index built here and emit ``Finding`` records.
+
+Conventions recognized in source comments (see ANALYSIS.md):
+
+- ``# guarded-by: <lockname>`` on a ``self.<attr> = ...`` line (or the line
+  above it) declares that attribute protected by ``self.<lockname>``.
+- ``# sdtpu-lint: traced`` on a ``def`` line (or the line above) marks a
+  function as traced-by-JAX even though the jit/scan call site lives in
+  another module (e.g. sampler step functions scanned by the engine).
+- ``# sdtpu-lint: jitted(static=4)`` on a factory ``def`` marks its return
+  value as a jitted callable with the given static argument positions, so
+  call sites through a local alias are checked for recompile hazards.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+PACKAGE = "stable_diffusion_webui_distributed_tpu"
+
+#: Rule identifiers (documented in ANALYSIS.md).
+RULES = {
+    "TP001": "host nondeterminism inside a traced function",
+    "TP002": "Python-level branch on a tracer value",
+    "TP003": "mutation of closed-over Python state inside a traced function",
+    "RC001": "request/env-derived value in a static jit argument",
+    "RC002": "traced function closes over a request/env-derived scalar",
+    "EV001": "raw os.environ read outside runtime/config.py",
+    "LK001": "guarded attribute accessed without holding its lock",
+    "LK002": "guarded-by annotation names an unknown lock",
+    "LK003": "lock-acquisition-order inversion",
+    "AL001": "allowlist entry expired",
+    "AL002": "allowlist entry matched no finding",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    symbol: str  # dotted qualname of the enclosing scope, or "<module>"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.symbol}] {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+@dataclass
+class FuncInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    qualname: str
+    cls: Optional[str]  # immediately-enclosing class name, if any
+    parent_qual: str  # qualname of the enclosing scope ("" for module level)
+
+
+@dataclass
+class ModuleInfo:
+    path: str  # repo-relative posix path
+    tree: ast.Module
+    source: str
+    comments: Dict[int, str] = field(default_factory=dict)  # line -> text
+    aliases: Dict[str, str] = field(default_factory=dict)  # name -> dotted
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)  # qualname -> info
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+
+    # -- comment conventions -------------------------------------------------
+
+    def marker(self, line: int, prefix: str) -> Optional[str]:
+        """Return the comment payload for ``prefix`` on ``line`` or on a
+        standalone comment line directly above (a trailing comment on the
+        previous statement's line does NOT attach here)."""
+        text = self.comments.get(line, "")
+        if prefix in text:
+            return text.split(prefix, 1)[1].strip()
+        text = self.comments.get(line - 1, "")
+        if prefix in text:
+            lines = self.source.splitlines()
+            if 0 < line - 1 <= len(lines) and \
+                    lines[line - 2].lstrip().startswith("#"):
+                return text.split(prefix, 1)[1].strip()
+        return None
+
+    # -- name resolution -----------------------------------------------------
+
+    def dotted(self, node: ast.AST) -> Optional[Tuple[str, bool]]:
+        """Flatten Name/Attribute chains to a canonical dotted path using the
+        module's import aliases. Returns (path, resolved) where ``resolved``
+        is True when the head name is a known import binding."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = parts[0]
+        if head in self.aliases:
+            return ".".join([self.aliases[head]] + parts[1:]), True
+        return ".".join(parts), False
+
+    def call_name(self, call: ast.Call) -> Tuple[str, bool]:
+        got = self.dotted(call.func)
+        return got if got is not None else ("", False)
+
+
+def _collect_comments(source: str) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string.lstrip("#").strip()
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map every import binding (module-level or nested) to its canonical
+    dotted origin: ``import numpy as np`` -> np: numpy; ``from jax import
+    random as jrandom`` -> jrandom: jax.random."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                target = a.name if a.asname else a.name.split(".")[0]
+                out[bound] = target
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _index_scopes(mod: ModuleInfo) -> None:
+    def visit(node: ast.AST, scope: List[str], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(scope + [child.name])
+                mod.funcs[qual] = FuncInfo(child, qual, cls, ".".join(scope))
+                visit(child, scope + [child.name], None)
+            elif isinstance(child, ast.ClassDef):
+                qual = ".".join(scope + [child.name])
+                mod.classes[qual] = child
+                visit(child, scope + [child.name], child.name)
+            else:
+                visit(child, scope, cls)
+
+    visit(mod.tree, [], None)
+
+
+def load_module(abs_path: str, rel_path: str) -> Optional[ModuleInfo]:
+    try:
+        with open(abs_path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=rel_path)
+    except (OSError, SyntaxError):
+        return None
+    mod = ModuleInfo(path=rel_path.replace(os.sep, "/"), tree=tree,
+                     source=source, comments=_collect_comments(source),
+                     aliases=_collect_aliases(tree))
+    _index_scopes(mod)
+    return mod
+
+
+def walk_package(root: str, paths: Optional[Iterable[str]] = None
+                 ) -> List[ModuleInfo]:
+    """Load every .py file under ``root`` (or the explicit ``paths``, which
+    may be files or directories, absolute or root-relative)."""
+    files: List[Tuple[str, str]] = []
+    if paths:
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(ap):
+                for dirpath, _dirs, names in os.walk(ap):
+                    for n in sorted(names):
+                        if n.endswith(".py"):
+                            fp = os.path.join(dirpath, n)
+                            files.append((fp, os.path.relpath(fp, root)))
+            elif ap.endswith(".py"):
+                files.append((ap, os.path.relpath(ap, root)))
+    else:
+        pkg = os.path.join(root, PACKAGE)
+        for dirpath, _dirs, names in os.walk(pkg):
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    fp = os.path.join(dirpath, n)
+                    files.append((fp, os.path.relpath(fp, root)))
+    mods = []
+    for abs_path, rel in files:
+        mod = load_module(abs_path, rel)
+        if mod is not None:
+            mods.append(mod)
+    return mods
+
+
+def func_locals(fn: ast.AST) -> set:
+    """Parameter and locally-bound names of a function body (no recursion
+    into nested defs — their scopes are separate)."""
+    names = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                names.add(child.name)
+                continue  # separate scope
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Name) and isinstance(child.ctx,
+                                                          (ast.Store, ast.Del)):
+                names.add(child.id)
+            elif isinstance(child, (ast.Global, ast.Nonlocal)):
+                pass  # declared names are NOT locals
+            scan(child)
+
+    body = getattr(fn, "body", None)
+    if isinstance(body, list):
+        for st in body:
+            scan(st)
+    elif body is not None:  # Lambda
+        scan(fn)
+    return names
+
+
+def declared_nonlocal(fn: ast.AST) -> set:
+    """Names declared ``global``/``nonlocal`` directly in this function body
+    (not in nested defs)."""
+    out = set()
+
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, (ast.Global, ast.Nonlocal)):
+                out.update(child.names)
+            scan(child)
+
+    for st in getattr(fn, "body", []) or []:
+        scan(st)
+    return out

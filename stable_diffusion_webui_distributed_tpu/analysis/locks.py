@@ -1,0 +1,305 @@
+"""Lock-discipline rules (LK001/LK002/LK003).
+
+Convention: a ``# guarded-by: <lockname>`` comment on a ``self.<attr> = ...``
+line in ``__init__`` (or the line directly above it) declares that attribute
+protected by ``self.<lockname>``. The analyzer then verifies, lexically and
+per class, that every ``self.<attr>`` access outside ``__init__`` happens
+inside a ``with self.<lockname>:`` block (LK001), that the named lock is a
+real ``threading.Lock/RLock/Condition`` attribute of the class (LK002), and
+that no two locks are ever acquired in opposite orders anywhere in the
+package (LK003 — the deadlock precondition).
+
+Scope and honesty about limits (documented in ANALYSIS.md): guarding is
+checked *intra-class* — ``self.attr`` in the declaring class's methods.
+Cross-object accesses (``worker.state`` from the scheduler) are out of
+lexical reach; classes expose locked accessors for those paths instead.
+``__init__`` is exempt (construction is single-threaded), as are nested
+``def``s spawned as threads — they start with no locks held, which is
+exactly how the checker treats them.
+
+Lock-order edges come from three places: lexically nested ``with`` blocks;
+method calls made while holding a lock, closed transitively over same-class
+``self.method()`` calls; and cross-class calls resolved through a small
+attribute->class hint table (``self.engine`` is an Engine, the module
+singletons METRICS/STATE are DispatchMetrics/GenerationState). A cycle in
+the resulting digraph is reported once per cycle as LK003.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleInfo
+
+#: attribute/variable name -> class name, for cross-class lock-order edges.
+CLASS_HINTS = {
+    "engine": "Engine",
+    "state": "GenerationState",
+    "metrics": "DispatchMetrics",
+    "METRICS": "DispatchMetrics",
+    "STATE": "GenerationState",
+    "registry": "ModelRegistry",
+    "dispatcher": "ServingDispatcher",
+    "bucketer": "ShapeBucketer",
+}
+
+LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+class ClassLocks:
+    def __init__(self, name: str, mod: ModuleInfo, node: ast.ClassDef):
+        self.name = name
+        self.mod = mod
+        self.node = node
+        self.locks: Set[str] = set()  # attr names holding threading locks
+        self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
+        self.methods: Dict[str, ast.AST] = {}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _collect_classes(modules: List[ModuleInfo]) -> Dict[str, ClassLocks]:
+    out: Dict[str, ClassLocks] = {}
+    for mod in modules:
+        for qual, cls in mod.classes.items():
+            info = ClassLocks(cls.name, mod, cls)
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[item.name] = item
+            # find lock attributes + guarded-by annotations anywhere in the
+            # class body (usually __init__)
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                else:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if isinstance(node.value, ast.Call):
+                        name, _res = mod.call_name(node.value)
+                        if name.split(".")[-1] in LOCK_TYPES:
+                            info.locks.add(attr)
+                    g = mod.marker(node.lineno, "guarded-by:")
+                    if g:
+                        info.guarded[attr] = (g.split()[0], node.lineno)
+            if info.locks or info.guarded:
+                # last definition wins on duplicate class names; the package
+                # has none, and fixtures are analyzed in isolation
+                out[info.name] = info
+    return out
+
+
+def _with_locks(item: ast.withitem, cls: ClassLocks) -> Optional[str]:
+    attr = _self_attr(item.context_expr)
+    if attr is not None and attr in cls.locks:
+        return attr
+    return None
+
+
+# -- per-method traversal ----------------------------------------------------
+
+class _MethodScan:
+    """One pass over a method body: LK001 guarded-access checks, direct
+    lock acquisitions, and (held-lock -> call / held-lock -> lock) edges."""
+
+    def __init__(self, cls: ClassLocks, method_name: str):
+        self.cls = cls
+        self.method = method_name
+        self.findings: List[Finding] = []
+        self.acquired: Set[str] = set()  # locks this method may take
+        # (held_lock, callee) where callee is ("self", meth) or (Class, meth)
+        self.calls_under: Set[Tuple[str, Tuple[str, str]]] = set()
+        self.edges: Set[Tuple[str, str]] = set()  # lock -> lock, same class
+        self.local_hints: Dict[str, str] = {}  # var -> class name
+
+    def run(self, node: ast.AST) -> None:
+        self._body(getattr(node, "body", []), frozenset())
+
+    def _body(self, stmts: List[ast.stmt], held: frozenset) -> None:
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _stmt(self, st: ast.stmt, held: frozenset) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later (thread target / callback): no locks
+            # are held when it starts
+            self._body(st.body, frozenset())
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            newly = []
+            for item in st.items:
+                self._expr(item.context_expr, held)
+                lock = _with_locks(item, self.cls)
+                if lock is not None:
+                    newly.append(lock)
+                    self.acquired.add(lock)
+                    for h in held:
+                        self.edges.add((h, lock))
+            self._body(st.body, held | frozenset(newly))
+            return
+        if isinstance(st, ast.Try):
+            self._body(st.body, held)
+            for h in st.handlers:
+                self._body(h.body, held)
+            self._body(st.orelse, held)
+            self._body(st.finalbody, held)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, held)
+            self._body(st.body, held)
+            self._body(st.orelse, held)
+            return
+        if isinstance(st, ast.For):
+            self._expr(st.iter, held)
+            self._body(st.body, held)
+            self._body(st.orelse, held)
+            return
+        # track `engine = self.engine` style aliases for lock-order hints
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name):
+            src = _self_attr(st.value)
+            if src is not None and src in CLASS_HINTS:
+                self.local_hints[st.targets[0].id] = CLASS_HINTS[src]
+        self._expr(st, held)
+
+    def _expr(self, node: ast.AST, held: frozenset) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            attr = _self_attr(sub) if isinstance(sub, ast.Attribute) else None
+            if attr is not None and attr in self.cls.guarded:
+                lock, _ln = self.cls.guarded[attr]
+                if lock not in held:
+                    self.findings.append(Finding(
+                        "LK001", self.cls.mod.path, sub.lineno,
+                        f"{self.cls.name}.{self.method}",
+                        f"access to '{attr}' (guarded-by {lock}) without "
+                        f"holding self.{lock}"))
+            if isinstance(sub, ast.Call):
+                self._call(sub, held)
+
+    def _call(self, call: ast.Call, held: frozenset) -> None:
+        if not held:
+            return
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        base = fn.value
+        callee: Optional[Tuple[str, str]] = None
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                callee = ("self", fn.attr)
+            elif base.id in self.local_hints:
+                callee = (self.local_hints[base.id], fn.attr)
+            elif base.id in CLASS_HINTS:
+                callee = (CLASS_HINTS[base.id], fn.attr)
+        else:
+            attr = _self_attr(base)
+            if attr is not None and attr in CLASS_HINTS:
+                callee = (CLASS_HINTS[attr], fn.attr)
+        if callee is not None:
+            for h in held:
+                self.calls_under.add((h, callee))
+
+
+def check(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = _collect_classes(modules)
+
+    # LK002: guarded-by names an attribute that is not a lock of the class
+    for cls in classes.values():
+        for attr, (lock, line) in cls.guarded.items():
+            if lock not in cls.locks:
+                findings.append(Finding(
+                    "LK002", cls.mod.path, line, f"{cls.name}.{attr}",
+                    f"guarded-by names '{lock}', which is not a "
+                    f"threading lock attribute of {cls.name}"))
+
+    # per-method scans
+    scans: Dict[Tuple[str, str], _MethodScan] = {}
+    for cls in classes.values():
+        for mname, mnode in cls.methods.items():
+            scan = _MethodScan(cls, mname)
+            scan.run(mnode)
+            scans[(cls.name, mname)] = scan
+            if mname != "__init__":
+                findings.extend(scan.findings)
+
+    # transitive lock-acquisition sets per method (fixpoint)
+    acquired: Dict[Tuple[str, str], Set[str]] = {
+        key: {f"{key[0]}.{lk}" for lk in scan.acquired}
+        for key, scan in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, scan in scans.items():
+            for _h, (tgt_cls, tgt_meth) in scan.calls_under:
+                tgt = (key[0] if tgt_cls == "self" else tgt_cls, tgt_meth)
+                extra = acquired.get(tgt, set())
+                if not extra <= acquired[key]:
+                    acquired[key] |= extra
+                    changed = True
+
+    # lock-order edges: nested withs + calls made while holding a lock
+    edges: Dict[str, Set[str]] = {}
+    edge_src: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, mod: ModuleInfo, line: int, sym: str):
+        if a == b:
+            return
+        edges.setdefault(a, set()).add(b)
+        edge_src.setdefault((a, b), (mod.path, line, sym))
+
+    for key, scan in scans.items():
+        cls = scan.cls
+        for (a, b) in scan.edges:
+            add_edge(f"{cls.name}.{a}", f"{cls.name}.{b}", cls.mod,
+                     cls.node.lineno, f"{cls.name}.{key[1]}")
+        for h, (tgt_cls, tgt_meth) in scan.calls_under:
+            tgt = (key[0] if tgt_cls == "self" else tgt_cls, tgt_meth)
+            for lk in acquired.get(tgt, set()):
+                add_edge(f"{cls.name}.{h}", lk, cls.mod, cls.node.lineno,
+                         f"{cls.name}.{key[1]} -> {tgt[0]}.{tgt[1]}")
+
+    # LK003: cycles in the lock digraph
+    seen_cycles: Set[frozenset] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str],
+            visited: Set[str]) -> None:
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(edges.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                cyc_key = frozenset(cyc)
+                if cyc_key not in seen_cycles:
+                    seen_cycles.add(cyc_key)
+                    path, line, sym = edge_src.get(
+                        (node, nxt), ("<unknown>", 0, "<unknown>"))
+                    findings.append(Finding(
+                        "LK003", path, line, sym,
+                        "lock-order inversion: " + " -> ".join(cyc) +
+                        " (acquire these locks in one global order)"))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: Set[str] = set()
+    for node in sorted(edges):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+
+    return findings

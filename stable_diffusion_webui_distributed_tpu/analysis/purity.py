@@ -1,0 +1,369 @@
+"""Trace-purity rules (TP001/TP002/TP003).
+
+A function is *traced* when JAX executes it once at trace time and replays
+the captured computation thereafter: anything the Python body does besides
+building jaxprs — reading a wall clock, drawing from host RNG, mutating
+closed-over state, branching on tracer values — either bakes a stale value
+into every replay or crashes with a ConcretizationError on the device. The
+serving layer's byte-identical-under-coalescing guarantee (PR 1) rests on
+traced code being pure; these rules machine-check it.
+
+Traced roots are found three ways:
+
+1. Direct: a function passed to (or decorated with) ``jax.jit`` / ``pjit``
+   / ``pmap`` / ``vmap`` / ``shard_map`` / ``lax.scan`` / ``lax.cond`` /
+   ``lax.while_loop`` / ``lax.fori_loop`` / ``lax.switch`` / ``checkpoint``.
+   For these we know which parameters are tracers (minus static_argnums /
+   static_argnames), so the branch rule TP002 applies.
+2. Marked: ``# sdtpu-lint: traced`` on the def — for functions whose trace
+   entry point is in another module (sampler step closures the engine
+   scans). TP001/TP003 only.
+3. ``nn.Module`` methods (class bases ending in ``Module``): their
+   ``__call__`` trees run under the engine's jit. TP001/TP003 only —
+   module hyperparameters are legitimately branched on at trace time.
+
+Reachability then closes the set over intra-module calls (bare names,
+``self.method``), since helpers called from a traced body are traced too.
+``jax.random`` is deliberately NOT banned: keyed functional RNG is the
+sanctioned randomness (runtime/rng.py derives the keys); only *host*
+nondeterminism is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FuncInfo, ModuleInfo, declared_nonlocal, func_locals
+
+#: Canonical names whose call sites make their function-valued args traced.
+TRACE_FNS = {
+    "jax.jit", "jax.pjit", "jax.pmap", "jax.vmap",
+    "jax.experimental.pjit.pjit",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.checkpoint", "jax.remat",
+}
+
+#: Host-nondeterminism call prefixes (canonical dotted names).
+BANNED_PREFIXES = ("numpy.random.", "random.", "secrets.")
+BANNED_EXACT = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+    "uuid.uuid4", "uuid.uuid1", "os.urandom",
+}
+
+#: Attribute/introspection uses of a tracer that are trace-time constants
+#: and therefore fine to branch on.
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+SHAPE_CALLS = {"len", "isinstance", "getattr", "hasattr", "callable", "type"}
+
+
+class TracedFn:
+    def __init__(self, info: FuncInfo, mod: ModuleInfo,
+                 tracer_params: Optional[Set[str]], why: str):
+        self.info = info
+        self.mod = mod
+        # None => unknown signature mapping (marked/nn.Module/reachable):
+        # TP001/TP003 only. A set => TP002 applies to those params.
+        self.tracer_params = tracer_params
+        self.why = why
+
+
+def _static_positions(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return []
+    return [a.arg for a in (args.posonlyargs + args.args)]
+
+
+def _tracer_params(fn: ast.AST, statics: Tuple[Set[int], Set[str]],
+                   drop_first: bool = False) -> Set[str]:
+    params = _params_of(fn)
+    if drop_first and params:
+        params = params[1:]
+    nums, names = statics
+    out = set()
+    for i, p in enumerate(params):
+        if i in nums or p in names:
+            continue
+        out.add(p)
+    return out
+
+
+def _resolve_func(mod: ModuleInfo, node: ast.AST, scope: FuncInfo
+                  ) -> Optional[FuncInfo]:
+    """Resolve a function-valued expression to a FuncInfo: a bare name
+    (nested def in the enclosing scope, else module-level def) or
+    ``self.method`` of the enclosing class."""
+    if isinstance(node, ast.Name):
+        for qual in (f"{scope.qualname}.{node.id}", node.id):
+            if qual in mod.funcs:
+                return mod.funcs[qual]
+        if scope.cls and f"{scope.cls}.{node.id}" in mod.funcs:
+            return mod.funcs[f"{scope.cls}.{node.id}"]
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self" and scope.cls:
+        qual = f"{scope.cls}.{node.attr}"
+        return mod.funcs.get(qual)
+    return None
+
+
+def _is_module_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else \
+            base.id if isinstance(base, ast.Name) else ""
+        if name.endswith("Module"):
+            return True
+    return False
+
+
+def find_traced(mod: ModuleInfo) -> Dict[str, TracedFn]:
+    traced: Dict[str, TracedFn] = {}
+
+    def add(info: FuncInfo, tracer_params: Optional[Set[str]], why: str):
+        prev = traced.get(info.qualname)
+        # keep the entry with the most knowledge (known tracer params wins)
+        if prev is not None and prev.tracer_params is not None:
+            return
+        traced[info.qualname] = TracedFn(info, mod, tracer_params, why)
+
+    # 1a. decorators
+    for qual, info in mod.funcs.items():
+        node = info.node
+        for dec in getattr(node, "decorator_list", []):
+            call = dec if isinstance(dec, ast.Call) else None
+            target = call.func if call else dec
+            name, _res = mod.dotted(target) or ("", False)
+            if name in TRACE_FNS:
+                statics = _static_positions(call) if call else (set(), set())
+                add(info, _tracer_params(node, statics), f"@{name}")
+            elif name.endswith("partial") and call and call.args:
+                inner, _ = mod.dotted(call.args[0]) or ("", False)
+                if inner in TRACE_FNS:
+                    statics = _static_positions(call)
+                    add(info, _tracer_params(node, statics),
+                        f"@partial({inner})")
+
+        # 2. explicit marker
+        if mod.marker(getattr(node, "lineno", 0), "sdtpu-lint:") is not None:
+            payload = mod.marker(node.lineno, "sdtpu-lint:") or ""
+            if payload.split("(")[0].strip() == "traced":
+                add(info, None, "marked traced")
+
+    # 1b. call sites: jit(f, ...), lax.scan(step, ...), shard_map(f, ...)
+    for qual, scope in list(mod.funcs.items()):
+        for call in ast.walk(scope.node):
+            if not isinstance(call, ast.Call):
+                continue
+            name, _res = mod.call_name(call)
+            if name not in TRACE_FNS:
+                continue
+            statics = _static_positions(call)
+            cond_like = name.endswith((".cond", ".switch"))
+            fn_args = list(call.args) + \
+                [kw.value for kw in call.keywords
+                 if kw.arg in ("f", "fun", "body_fun", "cond_fun", "body")]
+            for idx, arg in enumerate(fn_args):
+                if isinstance(arg, ast.Lambda):
+                    continue  # no body statements worth checking
+                target = _resolve_func(mod, arg, scope)
+                if target is None:
+                    continue
+                drop = False
+                if cond_like and idx == 0:
+                    continue  # the predicate operand, not a branch fn
+                use_statics = statics if name.endswith(("jit", "pjit")) \
+                    else (set(), set())
+                is_method = target.cls is not None and \
+                    _params_of(target.node)[:1] == ["self"]
+                add(target,
+                    _tracer_params(target.node, use_statics,
+                                   drop_first=is_method or drop),
+                    f"passed to {name}")
+    # also module-level trace calls (outside any def; don't re-descend into
+    # function bodies — those were handled with their proper scope above)
+    scope_mod = FuncInfo(mod.tree, "<module>", None, "")
+
+    def _walk_toplevel(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from _walk_toplevel(child)
+
+    for call in _walk_toplevel(mod.tree):
+        if isinstance(call, ast.Call):
+            name, _res = mod.call_name(call)
+            if name in TRACE_FNS and call.args:
+                target = _resolve_func(mod, call.args[0], scope_mod)
+                if target is not None:
+                    add(target, _tracer_params(target.node,
+                                               _static_positions(call)),
+                        f"passed to {name}")
+
+    # 3. nn.Module methods
+    for cls_qual, cls in mod.classes.items():
+        if not _is_module_class(cls):
+            continue
+        for qual, info in mod.funcs.items():
+            if info.cls == cls.name and info.parent_qual == cls_qual \
+                    and not info.node.name.startswith("__init"):
+                add(info, None, "nn.Module method")
+
+    # 4. reachability over intra-module calls
+    frontier = list(traced.values())
+    while frontier:
+        tf = frontier.pop()
+        for call in ast.walk(tf.info.node):
+            if not isinstance(call, ast.Call):
+                continue
+            target = _resolve_func(mod, call.func, tf.info)
+            if target is None or target.qualname in traced:
+                continue
+            new = TracedFn(target, mod, None,
+                           f"called from traced {tf.info.qualname}")
+            traced[target.qualname] = new
+            frontier.append(new)
+    return traced
+
+
+# -- TP001 -------------------------------------------------------------------
+
+def _check_host_calls(tf: TracedFn) -> List[Finding]:
+    out = []
+    for node in ast.walk(tf.info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name, resolved = tf.mod.call_name(node)
+        if not resolved:
+            continue
+        banned = name in BANNED_EXACT or \
+            any(name.startswith(p) for p in BANNED_PREFIXES)
+        if banned:
+            out.append(Finding(
+                "TP001", tf.mod.path, node.lineno, tf.info.qualname,
+                f"host-nondeterministic call {name}() inside traced "
+                f"function ({tf.why}); key randomness through "
+                f"runtime/rng.py + jax.random instead"))
+    return out
+
+
+# -- TP002 -------------------------------------------------------------------
+
+def _tracer_uses(node: ast.AST, tracers: Set[str],
+                 mod: ModuleInfo) -> List[ast.Name]:
+    """Names in a branch test that would force tracer concretization.
+    Shape/dtype introspection and None-checks are trace-time constants."""
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and \
+                all(isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators):
+            return []
+    if isinstance(node, ast.Attribute):
+        if node.attr in SHAPE_ATTRS:
+            return []
+        return _tracer_uses(node.value, tracers, mod)
+    if isinstance(node, ast.Call):
+        name, _res = mod.call_name(node)
+        if name.split(".")[-1] in SHAPE_CALLS:
+            return []
+        out: List[ast.Name] = []
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            out.extend(_tracer_uses(a, tracers, mod))
+        return out
+    if isinstance(node, ast.Name):
+        return [node] if node.id in tracers else []
+    out = []
+    for child in ast.iter_child_nodes(node):
+        out.extend(_tracer_uses(child, tracers, mod))
+    return out
+
+
+def _check_branches(tf: TracedFn) -> List[Finding]:
+    if not tf.tracer_params:
+        return []
+    out = []
+    for node in ast.walk(tf.info.node):
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+        elif isinstance(node, ast.IfExp):
+            test = node.test
+        elif isinstance(node, ast.Assert):
+            test = node.test
+        else:
+            continue
+        for name in _tracer_uses(test, tf.tracer_params, tf.mod):
+            out.append(Finding(
+                "TP002", tf.mod.path, name.lineno, tf.info.qualname,
+                f"Python branch on tracer '{name.id}' ({tf.why}); use "
+                f"lax.cond/jnp.where, or mark the argument static"))
+    return out
+
+
+# -- TP003 -------------------------------------------------------------------
+
+def _check_mutation(tf: TracedFn) -> List[Finding]:
+    fn = tf.info.node
+    local = func_locals(fn)
+    declared = declared_nonlocal(fn)
+    out = []
+
+    def base_name(t: ast.AST) -> Optional[ast.Name]:
+        while isinstance(t, (ast.Attribute, ast.Subscript)):
+            t = t.value
+        return t if isinstance(t, ast.Name) else None
+
+    def flag(node: ast.AST, what: str) -> None:
+        out.append(Finding(
+            "TP003", tf.mod.path, node.lineno, tf.info.qualname,
+            f"mutation of closed-over state ({what}) inside traced function "
+            f"({tf.why}); traced bodies run once at trace time — return the "
+            f"value instead"))
+
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if t.id in declared:
+                    flag(t, f"nonlocal/global '{t.id}'")
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                base = base_name(t)
+                if base is not None and base.id not in local \
+                        and base.id not in ("self", "cls"):
+                    flag(t, f"'{base.id}' is not local here")
+    return out
+
+
+def check(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        for tf in find_traced(mod).values():
+            findings.extend(_check_host_calls(tf))
+            findings.extend(_check_branches(tf))
+            findings.extend(_check_mutation(tf))
+    return findings

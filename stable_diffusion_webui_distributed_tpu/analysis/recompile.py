@@ -1,0 +1,289 @@
+"""Recompile-hazard rules (RC001/RC002).
+
+Every distinct value of a static jit argument — and every distinct value a
+traced function closes over at trace time — is a new entry in XLA's compile
+cache. When those values derive from request payloads, the compile-cache key
+space is attacker-sized: one request per unique (width, height, steps, ...)
+combination recompiles the pipeline (minutes on TPU) instead of dispatching
+(milliseconds). The serving layer bounds this with the ShapeBucketer ladder:
+request-derived values may only become static AFTER quantization onto the
+ladder (``bucket_shape`` / ``bucket_batch`` / ``bucket_payload``) or an
+explicit constant clamp (``min``/``max`` against a literal), both of which
+bound the key space by construction.
+
+Taint sources (per function, intra-procedural, forward single pass):
+
+- attribute reads off a parameter named ``payload`` / ``request`` / ``req``
+- ``os.environ`` / ``os.getenv`` reads and the sanctioned ``env_*`` helpers
+  from runtime/config.py (env values are per-process constants, but a knob
+  that silently multiplies compiled executables still deserves a ladder)
+
+Sinks:
+
+- RC001: a tainted expression at a static position of a call to a known
+  jitted callable — one bound from ``jax.jit(f, static_argnums=...)`` in
+  the same scope, or obtained from a factory marked
+  ``# sdtpu-lint: jitted(static=N[,M...])``.
+- RC002: a function passed to jit/scan in this scope whose free variables
+  include a tainted name (a closed-over trace-time constant).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FuncInfo, ModuleInfo, func_locals
+from .purity import TRACE_FNS, _resolve_func, _static_positions
+
+PAYLOAD_PARAMS = {"payload", "request", "req"}
+ENV_HELPERS = {"read_env", "env_str", "env_flag", "env_int", "env_float",
+               "env_parsed"}
+
+
+def _is_env_read(mod: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name, _res = mod.call_name(node)
+        if name in ("os.getenv",) or name.split(".")[-1] in ENV_HELPERS:
+            return True
+        # os.environ.get(...)
+        if name.startswith("os.environ"):
+            return True
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        got = mod.dotted(node if isinstance(node, ast.Attribute)
+                         else node.value)
+        if got is not None and got[0].startswith("os.environ") and got[1]:
+            return True
+    return False
+
+
+def _sanitized(mod: ModuleInfo, node: ast.Call) -> bool:
+    """Bucketer quantization or a constant clamp bounds the value domain."""
+    name, _res = mod.call_name(node)
+    tail = name.split(".")[-1]
+    if "bucket" in tail or tail == "crop":
+        return True
+    if tail in ("min", "max"):
+        return any(isinstance(a, ast.Constant) for a in node.args)
+    return False
+
+
+def _taint_of(mod: ModuleInfo, expr: ast.AST, tainted: Set[str],
+              payload_params: Set[str]) -> Optional[str]:
+    """Why ``expr`` is tainted (a description), or None if clean."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _sanitized(mod, node):
+            return None  # quantized somewhere in the expression
+    for node in ast.walk(expr):
+        if _is_env_read(mod, node):
+            return "environment read"
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in payload_params:
+            return f"{node.value.id}.{node.attr}"
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in tainted:
+            return f"'{node.id}'"
+    return None
+
+
+class _JitBinding:
+    def __init__(self, statics: Set[int], static_names: Set[str], why: str):
+        self.statics = statics
+        self.static_names = static_names
+        self.why = why
+
+
+def _jitted_marker(mod: ModuleInfo, info: FuncInfo) -> Optional[Set[int]]:
+    payload = mod.marker(getattr(info.node, "lineno", 0), "sdtpu-lint:")
+    if not payload or not payload.startswith("jitted"):
+        return None
+    inside = payload[payload.find("(") + 1:payload.rfind(")")]
+    out: Set[int] = set()
+    for part in inside.replace("static=", "").split(","):
+        part = part.strip()
+        if part.isdigit():
+            out.add(int(part))
+    return out
+
+
+def _scope_seed(mod: ModuleInfo, info: FuncInfo,
+                memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]],
+                ) -> Tuple[Set[str], Dict[str, _JitBinding]]:
+    """(tainted names, jit bindings) a nested def inherits by closure.
+
+    A closure reads the enclosing scope's variables, so ``skip`` assigned
+    from ``payload.clip_skip`` in the enclosing method is just as tainted
+    inside the nested helper that finally calls the jitted encoder. The
+    seed is the enclosing function's *final* forward-pass state — an
+    over-approximation of what is live at the nested def, biased toward
+    reporting (names cleanly reassigned later in the parent are rare).
+    """
+    parent = mod.funcs.get(info.parent_qual)
+    if parent is None or not isinstance(
+            parent.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set(), {}
+    if parent.qualname not in memo:
+        tainted, bindings = _forward_pass(
+            mod, parent, *_scope_seed(mod, parent, memo), findings=None)
+        memo[parent.qualname] = (tainted, bindings)
+    tainted, bindings = memo[parent.qualname]
+    # names the child rebinds locally are its own, not the closure's
+    shadowed = func_locals(info.node)
+    return ({t for t in tainted if t not in shadowed},
+            {k: v for k, v in bindings.items() if k not in shadowed})
+
+
+def _forward_pass(mod: ModuleInfo, info: FuncInfo,
+                  seed_tainted: Set[str],
+                  seed_bindings: Dict[str, _JitBinding],
+                  findings: Optional[List[Finding]],
+                  ) -> Tuple[Set[str], Dict[str, _JitBinding]]:
+    fn = info.node
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    payload_params = {p for p in params if p in PAYLOAD_PARAMS}
+    tainted: Set[str] = set(seed_tainted)
+    bindings: Dict[str, _JitBinding] = dict(seed_bindings)
+
+    def note_assign(target: ast.AST, value: ast.AST) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        # binding of a jitted callable?
+        if isinstance(value, ast.Call):
+            name, _res = mod.call_name(value)
+            if name.endswith(("jit", "pjit")) and name in TRACE_FNS:
+                nums, names = _static_positions(value)
+                bindings[target.id] = _JitBinding(nums, names, name)
+                return
+            factory = _resolve_func(mod, value.func, info)
+            if factory is not None:
+                statics = _jitted_marker(mod, factory)
+                if statics is not None:
+                    bindings[target.id] = _JitBinding(
+                        statics, set(), f"{factory.qualname} (marked jitted)")
+                    return
+        why = _taint_of(mod, value, tainted, payload_params)
+        if why is not None:
+            tainted.add(target.id)
+        else:
+            tainted.discard(target.id)  # clean reassignment clears taint
+
+    def visit(stmts: List[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate scope; RC002 handles closures
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    note_assign(t, st.value)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                note_assign(st.target, st.value)
+            elif isinstance(st, ast.AugAssign):
+                why = _taint_of(mod, st.value, tainted, payload_params)
+                if why is not None and isinstance(st.target, ast.Name):
+                    tainted.add(st.target.id)
+            # RC001: calls to known-jitted callables with tainted statics
+            for node in ast.walk(st):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                bind = None
+                if isinstance(node.func, ast.Name):
+                    bind = bindings.get(node.func.id)
+                if bind is None:
+                    continue
+                for i, arg in enumerate(node.args):
+                    if i not in bind.statics:
+                        continue
+                    why = _taint_of(mod, arg, tainted, payload_params)
+                    if why is not None and findings is not None:
+                        findings.append(Finding(
+                            "RC001", mod.path, node.lineno, info.qualname,
+                            f"static argument {i} of jitted callable "
+                            f"({bind.why}) derives from {why}: every "
+                            f"distinct value recompiles — quantize through "
+                            f"the ShapeBucketer ladder or clamp to a "
+                            f"constant range first"))
+                for kw in node.keywords:
+                    if kw.arg in bind.static_names:
+                        why = _taint_of(mod, kw.value, tainted,
+                                        payload_params)
+                        if why is not None and findings is not None:
+                            findings.append(Finding(
+                                "RC001", mod.path, node.lineno,
+                                info.qualname,
+                                f"static argument '{kw.arg}' of jitted "
+                                f"callable ({bind.why}) derives from {why}"))
+            # recurse into compound statements, same scope
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(st, block, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    visit(sub)
+            for h in getattr(st, "handlers", []) or []:
+                visit(h.body)
+
+    visit(fn.body)
+    return tainted, bindings
+
+
+def _check_function(mod: ModuleInfo, info: FuncInfo,
+                    memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]],
+                    ) -> List[Finding]:
+    fn = info.node
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    findings: List[Finding] = []
+    tainted, _bindings = _forward_pass(
+        mod, info, *_scope_seed(mod, info, memo), findings=findings)
+
+    # RC002: functions handed to trace combinators that close over taint
+    if tainted:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name, _res = mod.call_name(node)
+            if name not in TRACE_FNS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                target = _resolve_func(mod, arg, info)
+                if target is None or target.parent_qual != info.qualname:
+                    continue
+                # free names used by VALUE (a use that is only ever
+                # .shape/.dtype/.ndim introspection is a trace-time shape
+                # constant — the bucketing rules govern those, not RC002)
+                free: Set[str] = set()
+
+                def _free_value_uses(n: ast.AST) -> None:
+                    if isinstance(n, ast.Attribute) and \
+                            isinstance(n.value, ast.Name) and \
+                            n.attr in ("shape", "ndim", "dtype", "size"):
+                        return
+                    if isinstance(n, ast.Name) and \
+                            isinstance(n.ctx, ast.Load):
+                        free.add(n.id)
+                    for child in ast.iter_child_nodes(n):
+                        _free_value_uses(child)
+
+                _free_value_uses(target.node)
+                free -= func_locals(target.node)
+                hot = sorted(free & tainted)
+                if hot:
+                    findings.append(Finding(
+                        "RC002", mod.path,
+                        getattr(target.node, "lineno", node.lineno),
+                        info.qualname,
+                        f"function '{target.node.name}' passed to {name} "
+                        f"closes over request/env-derived {hot}: each "
+                        f"distinct value is a new trace — pass it as a "
+                        f"(bucketed) argument instead"))
+    return findings
+
+
+def check(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]] = {}
+        for info in mod.funcs.values():
+            findings.extend(_check_function(mod, info, memo))
+    return findings

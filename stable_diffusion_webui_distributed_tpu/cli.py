@@ -79,7 +79,7 @@ def _build_world(args, require_local: bool = True):
                                if master_cal else None),
             pixel_cap=master_cal.pixel_cap if master_cal else 0,
         )
-        world.workers.insert(0, node)  # master leads the gallery
+        world.add_worker(node, front=True)  # master leads the gallery
     elif engine is None and require_local and not world.workers:
         print("no checkpoints found and no remote workers configured; "
               f"put a .safetensors under '{registry.model_dir}' or add "
@@ -209,7 +209,7 @@ def cmd_status(args) -> int:
     world, registry = _build_world(args, require_local=False)
     print(f"config: {world.config_path or config_mod.default_config_path()}")
     print(f"models: {', '.join(registry.available()) or '(none)'}")
-    for w in world.workers:
+    for w in world.workers_snapshot():
         speed = (f"{w.cal.avg_ipm:.2f} ipm" if w.cal.benchmarked
                  else "not benchmarked")
         print(f"  {w.label:20s} {w.state.name:12s} {speed}"
@@ -303,7 +303,7 @@ def cmd_serve(args) -> int:
     # engine before accepting traffic, so the first request of every
     # bucket pays dispatch cost, not compile cost (SDTPU_WARMUP=0 skips;
     # the persistent XLA cache makes later restarts near-free too).
-    if os.environ.get("SDTPU_WARMUP", "") not in ("", "0"):
+    if config_mod.env_flag("SDTPU_WARMUP"):
         from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
             ShapeBucketer,
         )

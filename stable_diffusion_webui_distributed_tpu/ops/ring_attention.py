@@ -29,21 +29,9 @@ def _ring_chunk() -> int:
     (b, h, t_loc, chunk) instead of (b, h, t_loc, t_loc) — at the hires
     65k-token scale a full local score matrix would be GBs of HBM per
     ring step; chunked folding keeps it flat."""
-    import os
+    from stable_diffusion_webui_distributed_tpu.runtime.config import env_int
 
-    raw = os.environ.get("SDTPU_RING_CHUNK", str(_RING_CHUNK_DEFAULT))
-    try:
-        val = int(raw)
-    except ValueError:
-        import warnings
-
-        warnings.warn(
-            f"SDTPU_RING_CHUNK={raw!r} is not an integer; "
-            f"using default {_RING_CHUNK_DEFAULT}",
-            stacklevel=2,
-        )
-        val = _RING_CHUNK_DEFAULT
-    return max(128, val)
+    return max(128, env_int("SDTPU_RING_CHUNK", _RING_CHUNK_DEFAULT))
 
 
 def _ring_body(q, k, v, axis_name: str, scale: float, vary_axes=None):

@@ -168,7 +168,7 @@ class Engine:
             vae_cfg = _dc.replace(vae_cfg, force_decoder_f32=False)
         self.vae = VAE(vae_cfg, dtype=cd)
 
-        self._cache: Dict[Tuple, Callable] = {}
+        self._cache: Dict[Tuple, Callable] = {}  # guarded-by: _cache_lock
         self._cache_lock = threading.Lock()
         # blank hybrid-conditioning latents per (batch, size); VAE-derived,
         # so set_vae clears it
@@ -214,6 +214,7 @@ class Engine:
                 and k[3] == width and k[4] == height and k[5] == batch
                 for k in self._cache)
 
+    # sdtpu-lint: jitted(static=4)
     def _encode_fn(self) -> Callable:
         """(te_params, te2_params, ids, weights, clip_skip static) ->
         (context (1, chunks*77, D), pooled). Params are jit ARGUMENTS, never
@@ -788,7 +789,15 @@ class Engine:
             return (jnp.asarray(mask), jnp.asarray(val_l),
                     jnp.asarray(val_g))
 
-        skip = int(payload.clip_skip or 0)
+        # clamp to webui's 1..12 range (0 = model default) AND the model's
+        # usable depth (skip must leave at least one layer): clip_skip is a
+        # static argument of the jitted encoder, so an unbounded request
+        # value would mint one XLA executable per distinct int — and one
+        # past the encoder depth asserts inside the trace
+        depth = self.family.text_encoder.num_layers
+        if self.family.text_encoder_2 is not None:
+            depth = min(depth, self.family.text_encoder_2.num_layers)
+        skip = min(12, depth - 1, max(0, int(payload.clip_skip or 0)))
         enc = self._encode_fn()
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
@@ -1281,10 +1290,11 @@ class Engine:
                 # upscale to target -> re-encode (webui's non-latent path);
                 # rows are DISTINCT images, so bound VAE scratch by slicing
                 # each stage under the decode pixel budget
-                import os as _os
+                from stable_diffusion_webui_distributed_tpu.runtime \
+                    .config import env_int
 
-                budget = int(_os.environ.get(
-                    "SDTPU_DECODE_PIXELS", str(self._DECODE_PIXEL_BUDGET)))
+                budget = env_int("SDTPU_DECODE_PIXELS",
+                                 self._DECODE_PIXEL_BUDGET)
                 per_lo = max(1, budget // max(1, payload.width
                                               * payload.height))
                 per_hi = max(1, budget // max(1, tw * th))
@@ -1442,7 +1452,9 @@ class Engine:
         shares ONE compiled executable; a batch small enough to fit in a
         single slice keys on its actual row count (that key IS the only
         one, so there is nothing to reuse)."""
-        import os as _os
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            env_int,
+        )
 
         # snapshot-and-clear the adaptive incompletion latch HERE, at the
         # only point that knows which images a denoise produced — a sticky
@@ -1450,8 +1462,7 @@ class Engine:
         # same request once the depth-1 decode pipeline interleaves flushes
         incomplete = getattr(self, "_adaptive_incomplete", False)
         self._adaptive_incomplete = False
-        budget = int(_os.environ.get("SDTPU_DECODE_PIXELS",
-                                     str(self._DECODE_PIXEL_BUDGET)))
+        budget = env_int("SDTPU_DECODE_PIXELS", self._DECODE_PIXEL_BUDGET)
         per = max(1, budget // max(1, width * height))
         entries = []
         for s in range(0, min(n, latents.shape[0]), per):

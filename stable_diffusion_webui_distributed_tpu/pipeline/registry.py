@@ -42,7 +42,10 @@ class ModelRegistry:
         # too, not just bench.py — the README documents it as a policy
         # knob; the sweep-measured default is 10 (PERF.md)
         if chunk_size is None:
-            chunk_size = int(os.environ.get("SDTPU_CHUNK", "10"))
+            from stable_diffusion_webui_distributed_tpu.runtime.config \
+                import env_int
+
+            chunk_size = env_int("SDTPU_CHUNK", 10)
         self.chunk_size = chunk_size
         self.state = state
         self.mesh = mesh

@@ -29,6 +29,61 @@ def _logger():
 
     return get_logger()
 
+# -- environment knobs ------------------------------------------------------
+#
+# Every SDTPU_* environment read in the package goes through these helpers:
+# one warn-and-default policy instead of per-module try/except copies, and
+# one place the static analyzer (analysis/envrules.py, rule EV001) sanctions
+# for raw ``os.environ`` access. A malformed value never crashes startup —
+# it warns once and falls back, matching the config loader's quarantine
+# philosophy above.
+
+
+def read_env(name: str, default: str = "") -> str:
+    """The package's only sanctioned raw environment read (EV001)."""
+    return os.environ.get(name, default)
+
+
+def env_str(name: str, default: str = "") -> str:
+    val = read_env(name, "").strip()
+    return val if val else default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """'' -> default; '0'/'false'/'off'/'no' -> False; anything else -> True."""
+    raw = read_env(name, "").strip().lower()
+    if raw == "":
+        return default
+    return raw not in ("0", "false", "off", "no")
+
+
+def env_parsed(name: str, parse, default, what: str = "value"):
+    """Warn-and-default parse of an env var: unset -> default, unparseable
+    -> UserWarning + default. ``parse`` gets the raw string and may raise
+    ValueError/TypeError to reject it. ``warnings`` (not the logger) is the
+    channel: a bad knob is an operator-facing config mistake, and it must
+    surface even before logging is configured."""
+    raw = read_env(name, "")
+    if raw.strip() == "":
+        return default
+    try:
+        return parse(raw)
+    except (ValueError, TypeError) as e:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not a valid {what} ({e}); "
+                      f"using default {default!r}", stacklevel=3)
+        return default
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    return env_parsed(name, lambda raw: int(raw.strip()), default, "int")
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    return env_parsed(name, lambda raw: float(raw.strip()), default, "float")
+
+
 #: Benchmark protocol constants (reference: shared.py:63-64).
 WARMUP_SAMPLES = 2
 RECORDED_SAMPLES = 3
@@ -130,7 +185,7 @@ class ConfigModel(BaseModel):
 
 
 def default_config_path() -> str:
-    return os.environ.get("SDTPU_CONFIG", "distributed-config.json")
+    return env_str("SDTPU_CONFIG", "distributed-config.json")
 
 
 def load_config(path: Optional[str] = None) -> ConfigModel:

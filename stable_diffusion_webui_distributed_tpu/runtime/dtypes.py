@@ -46,25 +46,26 @@ class Policy:
     unet_int8_conv: bool = False
 
 
+def _env_choice(name: str, default: str, choices) -> str:
+    from stable_diffusion_webui_distributed_tpu.runtime.config import env_parsed
+
+    def parse(raw: str) -> str:
+        value = raw.strip().lower()
+        if value not in choices:
+            raise ValueError(f"want one of {tuple(choices)}")
+        return value
+
+    return env_parsed(name, parse, default, "choice")
+
+
 def _default_attention() -> str:
-    import os
-
-    value = os.environ.get("SDTPU_ATTENTION", "xla").strip().lower()
-    if value not in ("xla", "flash"):
-        import warnings
-
-        warnings.warn(
-            f"SDTPU_ATTENTION={value!r} is not one of ('xla', 'flash'); "
-            "using 'xla'", stacklevel=2)
-        return "xla"
-    return value
+    return _env_choice("SDTPU_ATTENTION", "xla", ("xla", "flash"))
 
 
 def _env_flag(name: str) -> bool:
-    import os
+    from stable_diffusion_webui_distributed_tpu.runtime.config import env_flag
 
-    return os.environ.get(name, "").strip().lower() not in (
-        "", "0", "false", "off", "no")
+    return env_flag(name, False)
 
 
 def _default_param_dtype() -> jnp.dtype:
@@ -79,33 +80,17 @@ def _default_param_dtype() -> jnp.dtype:
     Default is bf16: measured on silicon (round-3 sweep, PERF.md) it
     wins config #1 27.2 ipm vs 22.4 ipm for f32 storage (+21%).
     """
-    import os
-
-    value = os.environ.get("SDTPU_PARAM_DTYPE", "bf16").strip().lower()
+    value = _env_choice("SDTPU_PARAM_DTYPE", "bf16",
+                        ("bf16", "bfloat16", "f32", "float32", "fp32"))
     if value in ("bf16", "bfloat16"):
         return jnp.dtype(jnp.bfloat16)
-    if value not in ("f32", "float32", "fp32"):
-        import warnings
-
-        warnings.warn(
-            f"SDTPU_PARAM_DTYPE={value!r} is not one of ('bf16', 'f32'); "
-            "using 'f32'", stacklevel=2)
     return jnp.dtype(jnp.float32)
 
 
 def _default_decode_bf16() -> bool:
-    import os
-
-    value = os.environ.get("SDTPU_DECODE_DTYPE", "f32").strip().lower()
-    if value in ("bf16", "bfloat16"):
-        return True
-    if value not in ("f32", "float32", "fp32"):
-        import warnings
-
-        warnings.warn(
-            f"SDTPU_DECODE_DTYPE={value!r} is not one of ('bf16', 'f32'); "
-            "using 'f32'", stacklevel=2)
-    return False
+    value = _env_choice("SDTPU_DECODE_DTYPE", "f32",
+                        ("bf16", "bfloat16", "f32", "float32", "fp32"))
+    return value in ("bf16", "bfloat16")
 
 
 #: Default policy for real TPU runs.

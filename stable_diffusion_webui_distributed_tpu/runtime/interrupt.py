@@ -72,8 +72,8 @@ class GenerationState:
 
     def __init__(self) -> None:
         self.flag = InterruptFlag()
-        self.progress = Progress()
-        self._listeners: List[Callable[[Progress], None]] = []
+        self.progress = Progress()  # guarded-by: _lock
+        self._listeners: List[Callable[[Progress], None]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def begin(self, job: str, steps: int) -> None:

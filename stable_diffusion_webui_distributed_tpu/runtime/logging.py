@@ -122,7 +122,10 @@ def configure(
         logger.addHandler(console)
 
         if log_dir is None:
-            log_dir = os.environ.get("SDTPU_LOG_DIR", ".")
+            from stable_diffusion_webui_distributed_tpu.runtime.config \
+                import env_str
+
+            log_dir = env_str("SDTPU_LOG_DIR", ".")
         try:
             file_handler = logging.handlers.RotatingFileHandler(
                 os.path.join(log_dir, "distributed.log"),
@@ -146,5 +149,9 @@ def configure(
 def get_logger() -> logging.Logger:
     """Return the framework logger, configuring defaults on first use."""
     if not _configured:
-        configure(debug=os.environ.get("SDTPU_DEBUG", "") not in ("", "0"))
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            env_flag,
+        )
+
+        configure(debug=env_flag("SDTPU_DEBUG"))
     return logging.getLogger(LOGGER_NAME)

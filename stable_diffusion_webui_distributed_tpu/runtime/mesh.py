@@ -37,7 +37,9 @@ def enable_compilation_cache(
 
     import jax
 
-    cache_dir = cache_dir or os.environ.get(
+    from stable_diffusion_webui_distributed_tpu.runtime.config import env_str
+
+    cache_dir = cache_dir or env_str(
         "SDTPU_XLA_CACHE", os.path.expanduser("~/.cache/sdtpu-xla"))
     try:
         os.makedirs(cache_dir, exist_ok=True)
@@ -61,18 +63,20 @@ def init_multihost(coordinator: Optional[str] = None,
     Environment fallbacks: SDTPU_COORDINATOR, SDTPU_NUM_PROCESSES,
     SDTPU_PROCESS_ID (or the cloud auto-detection jax.distributed ships).
     """
-    import os
-
     import jax
 
-    coordinator = coordinator or os.environ.get("SDTPU_COORDINATOR")
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_int, env_str,
+    )
+
+    coordinator = coordinator or env_str("SDTPU_COORDINATOR") or None
     if not coordinator:
         return False
     kwargs = {"coordinator_address": coordinator}
     num_processes = num_processes if num_processes is not None else \
-        os.environ.get("SDTPU_NUM_PROCESSES")
+        env_int("SDTPU_NUM_PROCESSES")
     process_id = process_id if process_id is not None else \
-        os.environ.get("SDTPU_PROCESS_ID")
+        env_int("SDTPU_PROCESS_ID")
     if num_processes is not None:
         kwargs["num_processes"] = int(num_processes)
     if process_id is not None:

@@ -101,9 +101,13 @@ class WorkerNode:
             eta_percent_error=list(eta_percent_error or []),
         )
         self.benchmark_payload = benchmark_payload or BenchmarkPayload()
-        self.state = State.IDLE
-        self.loaded_model: Optional[str] = None
-        self.loaded_vae: Optional[str] = None
+        # the state machine and model-sync cache are read by HTTP config
+        # handlers, ping sweeps, and request threads concurrently; every
+        # access outside __init__ must hold _lock (verified by sdtpu-lint
+        # rule LK001)
+        self.state = State.IDLE  # guarded-by: _lock
+        self.loaded_model: Optional[str] = None  # guarded-by: _lock
+        self.loaded_vae: Optional[str] = None  # guarded-by: _lock
         # script titles this backend supports (reference queries
         # /script-info per worker at ping time, world.py:744-763); None =
         # unknown (send everything)
@@ -161,7 +165,14 @@ class WorkerNode:
 
     @property
     def available(self) -> bool:
-        return self.state not in (State.UNAVAILABLE, State.DISABLED)
+        with self._lock:
+            return self.state not in (State.UNAVAILABLE, State.DISABLED)
+
+    def current_state(self) -> State:
+        """Locked state read for cross-thread callers (the scheduler's
+        sweep/fan-out loops must not read ``state`` bare)."""
+        with self._lock:
+            return self.state
 
     # -- ETA ----------------------------------------------------------------
 
@@ -186,7 +197,8 @@ class WorkerNode:
         # wait out a prior request still in flight (reference busy-wait,
         # worker.py:301-315)
         deadline = time.monotonic() + 30.0
-        while self.state == State.WORKING and time.monotonic() < deadline:
+        while self.current_state() == State.WORKING \
+                and time.monotonic() < deadline:
             time.sleep(0.1)
         self.set_state(State.WORKING)
 
@@ -337,14 +349,16 @@ class WorkerNode:
         """Sync the loaded checkpoint (reference worker.py:646-688)."""
         if self.model_override:
             model = self.model_override
-        if self.loaded_model == model and self.loaded_vae == vae:
-            return True
+        with self._lock:
+            if self.loaded_model == model and self.loaded_vae == vae:
+                return True
         try:
             t0 = time.monotonic()
             self.backend.load_options(model, vae)
             get_logger().info("worker '%s' loaded model '%s' in %.1fs",
                               self.label, model, time.monotonic() - t0)
-            self.loaded_model, self.loaded_vae = model, vae
+            with self._lock:
+                self.loaded_model, self.loaded_vae = model, vae
             return True
         except Exception as e:  # noqa: BLE001
             get_logger().error("model sync to '%s' failed: %s", self.label, e)

@@ -82,7 +82,20 @@ class World:
                  config_path: Optional[str] = None):
         self.cfg = cfg or config_mod.ConfigModel()
         self.config_path = config_path
-        self.workers: List[WorkerNode] = []
+        # registry membership only; per-worker mutable state has its own
+        # lock on WorkerNode. HTTP handlers add/remove workers while ping
+        # sweeps and request planning iterate the list
+        self._registry_lock = threading.Lock()
+        self.workers: List[WorkerNode] = []  # guarded-by: _registry_lock
+        # serializes the make_jobs/optimize_jobs planning phase: the five
+        # reference phases communicate through self.jobs, so two concurrent
+        # execute() calls planning at once would interleave their job lists
+        # (one request fanning out another's share). Execution itself —
+        # fan-out threads + join — overlaps freely; only planning is brief
+        # and serialized. Not a guarded-by annotation: self.jobs is read by
+        # the phase helpers (realtime_jobs, job_stall, ...) whose callers
+        # hold the lock for them, which is outside the lexical convention.
+        self._plan_lock = threading.Lock()
         self.jobs: List[Job] = []
         self.job_timeout: float = self.cfg.job_timeout
         self.complement_production: bool = self.cfg.complement_production
@@ -99,12 +112,29 @@ class World:
 
     # -- registry -----------------------------------------------------------
 
-    def add_worker(self, node: WorkerNode) -> WorkerNode:
-        self.workers.append(node)
+    def add_worker(self, node: WorkerNode, *,
+                   front: bool = False) -> WorkerNode:
+        with self._registry_lock:
+            if front:
+                self.workers.insert(0, node)
+            else:
+                self.workers.append(node)
         return node
 
+    def workers_snapshot(self) -> List[WorkerNode]:
+        """Public point-in-time registry copy for cross-object readers
+        (API handlers, CLI status) — see :meth:`_workers_snapshot`."""
+        return self._workers_snapshot()
+
+    def _workers_snapshot(self) -> List[WorkerNode]:
+        """Registry membership at a point in time. Iterating the live list
+        races the HTTP add/remove routes; every reader below works on a
+        snapshot instead (workers themselves are thread-safe)."""
+        with self._registry_lock:
+            return list(self.workers)
+
     def get_worker(self, label: str) -> Optional[WorkerNode]:
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.label == label:
                 return w
         return None
@@ -114,7 +144,7 @@ class World:
         UNAVAILABLE/DISABLED, invalid speeds, and the master in thin-client
         mode — the world elastically shrinks per request."""
         out = []
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.cal.avg_ipm is not None and w.cal.avg_ipm <= 0:
                 get_logger().warning(
                     "invalid benchmarked speed for '%s'; re-benchmark", w.label)
@@ -126,7 +156,7 @@ class World:
         return out
 
     def master(self) -> Optional[WorkerNode]:
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.master:
                 return w
         return None
@@ -345,24 +375,26 @@ class World:
         DPM adaptive requests bypass optimize_jobs entirely and run whole
         on one backend (see _plan_no_split).
         """
-        self.make_jobs(payload)
-        if not self.jobs:
-            raise RuntimeError("no benchmarked, reachable backends")
         from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import (
             resolve_sampler,
         )
-        if resolve_sampler(payload.sampler_name).adaptive:
-            no_split = self._plan_no_split(payload)
-            if no_split is not None:
-                self.jobs = no_split
-                return self.jobs
-            get_logger().warning(
-                "DPM adaptive request (%d images) exceeds every single "
-                "backend's pixel cap; splitting across workers — the PID "
-                "controller's batch-global error norm makes split output "
-                "differ from a whole-batch run (PARITY.md contract "
-                "exception)", payload.total_images)
-        jobs = self.optimize_jobs(payload)
+
+        with self._plan_lock:
+            self.make_jobs(payload)
+            if not self.jobs:
+                raise RuntimeError("no benchmarked, reachable backends")
+            if resolve_sampler(payload.sampler_name).adaptive:
+                no_split = self._plan_no_split(payload)
+                if no_split is not None:
+                    self.jobs = no_split
+                    return self.jobs
+                get_logger().warning(
+                    "DPM adaptive request (%d images) exceeds every single "
+                    "backend's pixel cap; splitting across workers — the "
+                    "PID controller's batch-global error norm makes split "
+                    "output differ from a whole-batch run (PARITY.md "
+                    "contract exception)", payload.total_images)
+            jobs = self.optimize_jobs(payload)
         if payload.total_images > 0 and not any(
                 j.batch_size > 0 for j in jobs):
             raise RuntimeError(
@@ -410,7 +442,7 @@ class World:
             # masters have no tokenizer; their fleets fall back to
             # per-slice padding (documented in payload.py).
             engine = next(
-                (w.backend.engine for w in self.workers
+                (w.backend.engine for w in self._workers_snapshot()
                  if hasattr(w.backend, "engine")), None)
             if engine is not None:
                 payload.context_chunks = \
@@ -605,7 +637,7 @@ class World:
             else:
                 w.set_state(State.UNAVAILABLE)
 
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.state == State.DISABLED and not indiscriminate:
                 continue
             t = threading.Thread(target=probe, args=(w,), daemon=True)
@@ -617,7 +649,7 @@ class World:
 
     def interrupt_all(self) -> None:
         """Fan-out interrupt (world.py:173-179)."""
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.state == State.WORKING:
                 threading.Thread(target=w.interrupt, daemon=True).start()
 
@@ -631,7 +663,7 @@ class World:
         def run(w: WorkerNode):
             results[w.label] = w.restart()
 
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.master or w.state == State.DISABLED:
                 continue
             t = threading.Thread(target=run, args=(w,), daemon=True)
@@ -796,7 +828,8 @@ class World:
             return False
         if w.master:
             raise ValueError("cannot remove the master worker")
-        self.workers.remove(w)
+        with self._registry_lock:
+            self.workers.remove(w)
         self.save_config()
         return True
 
@@ -884,7 +917,7 @@ class World:
         """Checkpoint-change fan-out (world.py:784-811): push the new model
         to every non-master backend without an override, in threads."""
         threads = []
-        for w in self.workers:
+        for w in self._workers_snapshot():
             if w.master or not w.available:
                 continue
             t = threading.Thread(target=w.load_options, args=(model, vae),
@@ -902,13 +935,14 @@ class World:
         A master entry persisted earlier survives even when this World was
         built without a local engine (status/ping runs) — otherwise those
         commands would erase the master's calibration."""
+        workers = self._workers_snapshot()
         worker_entries = []
-        if not any(w.master for w in self.workers):
+        if not any(w.master for w in workers):
             for entry in self.cfg.workers:
                 for label, wm in entry.items():
                     if wm.master:
                         worker_entries.append({label: wm})
-        for w in self.workers:
+        for w in workers:
             model = config_mod.WorkerModel(
                 avg_ipm=w.cal.avg_ipm,
                 master=w.master,

@@ -23,6 +23,7 @@ from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
     GenerationResult,
 )
+from stable_diffusion_webui_distributed_tpu.runtime import config as config_mod
 from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
 from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import SAMPLERS
@@ -76,7 +77,7 @@ class ApiServer:
         self.dispatcher = None
         if not hasattr(source, "execute") \
                 and hasattr(source, "generate_range") \
-                and os.environ.get("SDTPU_SERVING", "") != "0":
+                and config_mod.env_flag("SDTPU_SERVING", True):
             from stable_diffusion_webui_distributed_tpu.serving.dispatcher \
                 import ServingDispatcher
 
@@ -404,7 +405,7 @@ class ApiServer:
 
         workers = []
         if hasattr(self.source, "workers"):
-            for w in self.source.workers:
+            for w in _fleet_workers(self.source):
                 workers.append(_worker_dict(w))
         p = self.state.progress
         settings = None
@@ -473,7 +474,7 @@ class ApiServer:
             # under _busy: save_config must not interleave with the
             # end-of-generation save (both write the same .tmp file)
             with self._busy:
-                for w in self.source.workers:
+                for w in _fleet_workers(self.source):
                     if w.cal.eta_percent_error:
                         w.cal.eta_percent_error.clear()
                         cleared.append(w.label)
@@ -502,7 +503,7 @@ class ApiServer:
         ui.py:90-214)."""
         if not hasattr(self.source, "workers"):
             return []
-        return [_worker_dict(w) for w in self.source.workers]
+        return [_worker_dict(w) for w in _fleet_workers(self.source)]
 
     def handle_workers_post(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Worker CRUD (reference Worker Config tab, ui.py:90-214):
@@ -839,19 +840,30 @@ class ApiError(Exception):
         self.detail = detail
 
 
+def _fleet_workers(source) -> list:
+    """Point-in-time worker list: the World's locked snapshot when it has
+    one (HTTP add/remove mutates the registry concurrently with these
+    handlers), else a plain copy for bare test doubles."""
+    snap = getattr(source, "workers_snapshot", None)
+    if callable(snap):
+        return snap()
+    return list(getattr(source, "workers", []))
+
+
 def _worker_dict(w) -> Dict[str, Any]:
     """One worker's control-surface row: state/speed plus the editable
     fields the panel prefills (endpoint fields only for HTTP remotes;
     password is write-only and never serialized back out)."""
+    state = w.current_state() if hasattr(w, "current_state") else w.state
     d = {
         "label": w.label,
-        "state": w.state.name,
+        "state": state.name,
         "avg_ipm": w.cal.avg_ipm,
         "master": w.master,
         "pixel_cap": w.pixel_cap,
         "model_override": w.model_override,
         "pin_validated": w.pin_validated,
-        "disabled": w.state.name == "DISABLED",
+        "disabled": state.name == "DISABLED",
     }
     backend = w.backend
     if hasattr(backend, "address"):

@@ -22,11 +22,14 @@ knob must not take the server down).
 
 from __future__ import annotations
 
-import os
 import warnings
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_parsed, env_str,
+)
 
 DEFAULT_SHAPE_LADDER: Tuple[Tuple[int, int], ...] = (
     (512, 512), (640, 640), (768, 768), (1024, 1024))
@@ -57,6 +60,20 @@ def _parse_batches(raw: str) -> Optional[List[int]]:
         return None
 
 
+def _shapes_strict(raw: str) -> List[Tuple[int, int]]:
+    shapes = _parse_shapes(raw)
+    if shapes is None:
+        raise ValueError("want a WxH comma list")
+    return shapes
+
+
+def _batches_strict(raw: str) -> List[int]:
+    batches = _parse_batches(raw)
+    if batches is None:
+        raise ValueError("want positive ints, comma-separated")
+    return batches
+
+
 class ShapeBucketer:
     """Maps raw request shapes onto the configured bucket ladder."""
 
@@ -64,21 +81,11 @@ class ShapeBucketer:
                  shapes: Optional[Sequence[Tuple[int, int]]] = None,
                  batches: Optional[Sequence[int]] = None) -> None:
         if shapes is None:
-            raw = os.environ.get("SDTPU_BUCKET_LADDER", "")
-            if raw:
-                shapes = _parse_shapes(raw)
-                if shapes is None:
-                    warnings.warn(
-                        f"SDTPU_BUCKET_LADDER={raw!r} is not a WxH comma "
-                        "list; using default ladder", stacklevel=2)
+            shapes = env_parsed("SDTPU_BUCKET_LADDER", _shapes_strict,
+                                None, "WxH comma list")
         if batches is None:
-            raw = os.environ.get("SDTPU_BATCH_LADDER", "")
-            if raw:
-                batches = _parse_batches(raw)
-                if batches is None:
-                    warnings.warn(
-                        f"SDTPU_BATCH_LADDER={raw!r} is not an int comma "
-                        "list; using default ladder", stacklevel=2)
+            batches = env_parsed("SDTPU_BATCH_LADDER", _batches_strict,
+                                 None, "int comma list")
         # sorted by area so "smallest fitting bucket" is a linear scan
         self.shapes: List[Tuple[int, int]] = sorted(
             set(tuple(s) for s in (shapes or DEFAULT_SHAPE_LADDER)),
@@ -91,9 +98,9 @@ class ShapeBucketer:
         """Build from :class:`ConfigModel` string fields (env still wins,
         handled inside ``__init__`` when the parse yields nothing)."""
         shapes = batches = None
-        raw_s = os.environ.get("SDTPU_BUCKET_LADDER") \
+        raw_s = env_str("SDTPU_BUCKET_LADDER") \
             or getattr(cfg, "bucket_ladder", "")
-        raw_b = os.environ.get("SDTPU_BATCH_LADDER") \
+        raw_b = env_str("SDTPU_BATCH_LADDER") \
             or getattr(cfg, "batch_ladder", "")
         if raw_s:
             shapes = _parse_shapes(raw_s)

@@ -38,11 +38,9 @@ possible.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import uuid
-import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -56,19 +54,16 @@ DEFAULT_COALESCE_WINDOW = 0.05
 
 
 def _coalesce_window(cfg=None) -> float:
-    raw = os.environ.get("SDTPU_COALESCE_WINDOW", "")
-    if not raw and cfg is not None:
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_float, env_str,
+    )
+
+    if not env_str("SDTPU_COALESCE_WINDOW") and cfg is not None:
         val = getattr(cfg, "coalesce_window", None)
         if val is not None:
             return max(0.0, float(val))
-    if raw:
-        try:
-            return max(0.0, float(raw))
-        except ValueError:
-            warnings.warn(
-                f"SDTPU_COALESCE_WINDOW={raw!r} is not a float; using "
-                f"default {DEFAULT_COALESCE_WINDOW}", stacklevel=2)
-    return DEFAULT_COALESCE_WINDOW
+    val = env_float("SDTPU_COALESCE_WINDOW", DEFAULT_COALESCE_WINDOW)
+    return max(0.0, val)
 
 
 class Ticket:
@@ -108,10 +103,14 @@ class ServingDispatcher:
         self.window = _coalesce_window(config) if window is None \
             else max(0.0, float(window))
         self.max_batch = max(self.bucketer.batches)
+        # _lock guards the grouping tables; _exec_lock serializes engine
+        # execution. Order discipline: _exec_lock may be taken first and
+        # _lock nested inside it, never the reverse (sdtpu-lint LK003
+        # watches the acquisition graph)
         self._lock = threading.Lock()
         self._exec_lock = threading.Lock()
-        self._groups: Dict[tuple, _Group] = {}
-        self._tickets: Dict[str, Ticket] = {}
+        self._groups: Dict[tuple, _Group] = {}  # guarded-by: _lock
+        self._tickets: Dict[str, Ticket] = {}  # guarded-by: _lock
 
     # -- public API --------------------------------------------------------
 

@@ -28,29 +28,31 @@ class DispatchMetrics:
         self.clear()
 
     def clear(self) -> None:
-        with getattr(self, "_lock", threading.Lock()):
+        # __init__ creates _lock before the first clear(); external resets
+        # (tests, status handlers) serialize against every mutator
+        with self._lock:
             #: stage-kind ("chunk", "decode_u8", "encode", ...) -> builds
-            self.compiles: Dict[str, int] = defaultdict(int)
+            self.compiles: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
             #: stage-kind -> cache hits (stage already built)
-            self.cache_hits: Dict[str, int] = defaultdict(int)
-            self.requests = 0
+            self.cache_hits: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+            self.requests = 0  # guarded-by: _lock
             #: request shape already equal to its bucket
-            self.bucket_hits = 0
+            self.bucket_hits = 0  # guarded-by: _lock
             #: request shape padded up to a bucket
-            self.bucket_misses = 0
+            self.bucket_misses = 0  # guarded-by: _lock
             #: request bypassed bucketing (hires/img2img/no ladder fit)
-            self.bucket_bypasses = 0
+            self.bucket_bypasses = 0  # guarded-by: _lock
             #: device batches executed by the dispatcher
-            self.dispatches = 0
+            self.dispatches = 0  # guarded-by: _lock
             #: dispatches that merged >= 2 requests
-            self.coalesced_dispatches = 0
+            self.coalesced_dispatches = 0  # guarded-by: _lock
             #: sum over dispatches of requests merged (factor numerator)
-            self.coalesced_requests = 0
-            self.queue_wait_total = 0.0
-            self.queue_wait_count = 0
+            self.coalesced_requests = 0  # guarded-by: _lock
+            self.queue_wait_total = 0.0  # guarded-by: _lock
+            self.queue_wait_count = 0  # guarded-by: _lock
             #: sum of (bucket px / requested px) per bucketed request
-            self.padding_ratio_total = 0.0
-            self.padding_ratio_count = 0
+            self.padding_ratio_total = 0.0  # guarded-by: _lock
+            self.padding_ratio_count = 0  # guarded-by: _lock
 
     # -- engine-side ------------------------------------------------------
 

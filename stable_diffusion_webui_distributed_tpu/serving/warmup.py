@@ -18,27 +18,16 @@ traffic actually uses, since steps are part of the compile key.
 
 from __future__ import annotations
 
-import os
 import time
-import warnings
 from typing import Dict, Optional
 
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_int, env_str,
+)
 from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
     ShapeBucketer,
 )
 from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        warnings.warn(f"{name}={raw!r} is not an integer; using default "
-                      f"{default}", stacklevel=2)
-        return default
 
 
 def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
@@ -54,13 +43,13 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
         enable_compilation_cache,
     )
 
-    if os.environ.get("SDTPU_WARMUP", "") == "0":
+    if env_str("SDTPU_WARMUP") == "0":
         return {"skipped": True, "reason": "SDTPU_WARMUP=0"}
 
     active_cache = enable_compilation_cache(cache_dir)
     bucketer = bucketer or ShapeBucketer()
-    steps = steps if steps is not None else _env_int("SDTPU_WARMUP_STEPS", 20)
-    sampler = sampler or os.environ.get("SDTPU_WARMUP_SAMPLER", "Euler a")
+    steps = steps if steps is not None else env_int("SDTPU_WARMUP_STEPS", 20)
+    sampler = sampler or env_str("SDTPU_WARMUP_SAMPLER", "Euler a")
 
     before = dict(METRICS.summary()["compiles"])
     t0 = time.monotonic()

@@ -1,0 +1,14 @@
+"""Known-bad environment-read fixture (EV001).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import os
+
+
+def read_knob():
+    return os.environ.get("SDTPU_KNOB", "")  # EV001
+
+
+def read_flag():
+    return os.getenv("SDTPU_FLAG")  # EV001

@@ -1,0 +1,36 @@
+"""Known-bad lock-discipline fixture (LK001/LK002/LK003).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+        self.phantom = 0  # guarded-by: _missing
+
+    def bump(self):
+        self.total += 1  # LK001: no lock held
+
+    def read(self):
+        with self._lock:
+            return self.total  # fine
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:  # LK003: opposite order to ab()
+                pass

@@ -1,0 +1,39 @@
+"""Known-bad trace-purity fixture (TP001/TP002/TP003).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def wall_clock_leak(x):
+    t = time.time()  # TP001: frozen at trace time
+    return x * t
+
+
+@jax.jit
+def host_rng_leak(x):
+    return x + random.random()  # TP001: one sample baked into the trace
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x > 0:  # TP002: concretizes the tracer
+        return x
+    return -x
+
+
+def make_accumulator():
+    history = {}
+
+    @jax.jit
+    def accumulate(x):
+        history["last"] = x  # TP003: runs once at trace time
+        return jnp.sum(x)
+
+    return accumulate

@@ -1,0 +1,37 @@
+"""Known-bad recompile-hazard fixture (RC001/RC002).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def render(payload):
+    def denoise(latent, steps):
+        return latent * steps
+
+    fn = jax.jit(denoise, static_argnums=(1,))
+    steps = payload.steps
+    out = fn(jnp.zeros(4), steps)  # RC001: unbounded static from payload
+    width = payload.width
+
+    def scaled(x):  # RC002: closes over request-derived 'width'
+        return x * width
+
+    return jax.jit(scaled)(out)
+
+
+# sdtpu-lint: jitted(static=1)
+def make_encoder():
+    return jax.jit(lambda v, skip: v * skip, static_argnums=(1,))
+
+
+def handler(request):
+    enc = make_encoder()
+    skip = request.clip_skip
+
+    def encode_one():
+        return enc(jnp.zeros(2), skip)  # RC001: via closure inheritance
+
+    return encode_one

@@ -1,0 +1,233 @@
+"""Tier-1 gate and unit tests for sdtpu-lint (the analysis/ package).
+
+Everything here is pure AST work — no JAX device, no imports of the code
+under analysis — so the whole file stays in the fast tier.
+
+Three layers:
+
+- the repo gate: the package must analyze clean against the committed
+  allowlist (this is the test that fails when someone reintroduces a raw
+  ``os.environ`` read, an unguarded shared attribute, or a payload-derived
+  static jit argument);
+- fixture tests pinning exact rule IDs and line numbers for every rule
+  family, plus a clean fixture asserting the exemptions hold;
+- allowlist mechanics: suppression, expiry (AL001), unused entries (AL002).
+"""
+
+import datetime
+import json
+import textwrap
+
+import os
+
+from stable_diffusion_webui_distributed_tpu.analysis import (
+    RULES,
+    analyze_modules,
+    run_analysis,
+)
+from stable_diffusion_webui_distributed_tpu.analysis import (
+    allowlist as allowlist_mod,
+)
+from stable_diffusion_webui_distributed_tpu.analysis.core import load_module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def _fixture_findings(name):
+    rel = f"tests/lint_fixtures/{name}"
+    mod = load_module(os.path.join(REPO, rel), rel)
+    return analyze_modules([mod])
+
+
+def _rule_lines(findings):
+    return {(f.rule, f.line) for f in findings}
+
+
+# -- the repo gate -----------------------------------------------------------
+
+class TestRepoGate:
+    def test_package_is_clean(self):
+        result = run_analysis(REPO)
+        rendered = "\n".join(f.render() for f in result.findings)
+        assert result.clean, f"sdtpu-lint findings:\n{rendered}"
+
+    def test_analyzes_the_whole_package(self):
+        result = run_analysis(REPO)
+        # the package has ~60 modules; a collapse to a handful means the
+        # walker broke and the clean gate above is vacuous
+        assert result.modules >= 50
+
+    def test_cli_exit_codes(self):
+        from stable_diffusion_webui_distributed_tpu.analysis.__main__ import (
+            main,
+        )
+
+        assert main(["--rules"]) == 0
+        assert main([]) == 0  # repo clean vs committed allowlist
+        assert main(["--no-allowlist", "tests/lint_fixtures/env_bad.py"]) == 1
+
+    def test_every_rule_has_a_description(self):
+        for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
+                     "EV001", "LK001", "LK002", "LK003", "AL001", "AL002"):
+            assert rule in RULES and RULES[rule]
+
+
+# -- fixture families: exact rule IDs and line numbers -----------------------
+
+class TestFixtures:
+    def test_purity_family(self):
+        found = _rule_lines(_fixture_findings("purity_bad.py"))
+        assert found == {
+            ("TP001", 15),  # time.time() in @jax.jit
+            ("TP001", 21),  # random.random() in @jax.jit
+            ("TP002", 26),  # if x > 0 on a tracer
+            ("TP003", 36),  # closed-over dict mutation
+        }
+
+    def test_recompile_family(self):
+        found = _rule_lines(_fixture_findings("recompile_bad.py"))
+        assert found == {
+            ("RC001", 16),  # payload.steps as static_argnums arg
+            ("RC002", 19),  # closure over payload.width handed to jit
+            ("RC001", 35),  # marked factory + closure-inherited taint
+        }
+
+    def test_env_family(self):
+        found = _rule_lines(_fixture_findings("env_bad.py"))
+        assert found == {("EV001", 10), ("EV001", 14)}
+
+    def test_locks_family(self):
+        found = _rule_lines(_fixture_findings("locks_bad.py"))
+        assert found == {
+            ("LK002", 13),  # guarded-by names an unknown lock
+            ("LK001", 16),  # unguarded self.total += 1
+            ("LK003", 23),  # a->b in ab() vs b->a in ba()
+        }
+
+    def test_clean_fixture_has_zero_findings(self):
+        findings = _fixture_findings("clean.py")
+        rendered = "\n".join(f.render() for f in findings)
+        assert not findings, f"false positives on clean idioms:\n{rendered}"
+
+
+# -- regression injections ---------------------------------------------------
+# The acceptance cases: seed a copy of "good" code with one bad edit and the
+# analyzer must catch it. These guard against the rules rotting into no-ops.
+
+def _analyze_source(tmp_path, source, name="injected.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    mod = load_module(str(p), name)
+    return analyze_modules([mod])
+
+
+class TestRegressionInjections:
+    def test_injected_nondeterminism_in_traced_fn(self, tmp_path):
+        findings = _analyze_source(tmp_path, """\
+            import time
+
+            import jax
+
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+            """)
+        assert {f.rule for f in findings} == {"TP001"}
+
+    def test_injected_unguarded_shared_write(self, tmp_path):
+        findings = _analyze_source(tmp_path, """\
+            import threading
+
+
+            class Metrics:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.requests = 0  # guarded-by: _lock
+
+                def record(self):
+                    self.requests += 1
+            """)
+        assert {f.rule for f in findings} == {"LK001"}
+
+    def test_injected_nonladder_static_arg(self, tmp_path):
+        findings = _analyze_source(tmp_path, """\
+            import jax
+
+
+            def serve(payload):
+                fn = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+                return fn(payload.latent, payload.steps)
+            """)
+        assert {f.rule for f in findings} == {"RC001"}
+
+    def test_bucketed_static_arg_is_clean(self, tmp_path):
+        findings = _analyze_source(tmp_path, """\
+            import jax
+
+
+            def serve(payload, bucketer):
+                fn = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+                return fn(payload.latent, bucketer.bucket_batch(payload.steps))
+            """)
+        assert not findings
+
+
+# -- allowlist mechanics -----------------------------------------------------
+
+def _write_allowlist(tmp_path, entries):
+    p = tmp_path / "allowlist.json"
+    p.write_text(json.dumps(entries))
+    return str(p)
+
+
+ENV_BAD = "tests/lint_fixtures/env_bad.py"
+
+
+class TestAllowlist:
+    def test_entry_suppresses_matching_finding(self, tmp_path):
+        path = _write_allowlist(tmp_path, [{
+            "rule": "EV001", "path": ENV_BAD, "symbol": "read_knob",
+            "reason": "fixture exercise"}])
+        result = run_analysis(REPO, paths=[ENV_BAD], allowlist_path=path)
+        assert len(result.suppressed) == 1
+        assert {(f.rule, f.symbol) for f in result.findings} == {
+            ("EV001", "read_flag")}
+
+    def test_expired_entry_resurfaces_finding_and_reports_al001(
+            self, tmp_path):
+        path = _write_allowlist(tmp_path, [{
+            "rule": "EV001", "path": ENV_BAD, "symbol": "read_knob",
+            "reason": "dated debt", "expires": "2026-01-01"}])
+        result = run_analysis(REPO, paths=[ENV_BAD], allowlist_path=path,
+                              today=datetime.date(2026, 6, 1))
+        rules = sorted(f.rule for f in result.findings)
+        assert rules == ["AL001", "EV001", "EV001"]
+        assert not result.suppressed
+
+    def test_entry_still_live_before_expiry(self, tmp_path):
+        path = _write_allowlist(tmp_path, [{
+            "rule": "EV001", "path": ENV_BAD, "symbol": "read_knob",
+            "reason": "dated debt", "expires": "2026-01-01"}])
+        result = run_analysis(REPO, paths=[ENV_BAD], allowlist_path=path,
+                              today=datetime.date(2025, 6, 1))
+        assert sorted(f.rule for f in result.findings) == ["EV001"]
+        assert len(result.suppressed) == 1
+
+    def test_unused_entry_reports_al002(self, tmp_path):
+        path = _write_allowlist(tmp_path, [{
+            "rule": "TP001", "path": "nowhere.py", "symbol": "ghost",
+            "reason": "stale"}])
+        result = run_analysis(REPO, paths=[ENV_BAD], allowlist_path=path)
+        assert "AL002" in {f.rule for f in result.findings}
+
+    def test_unparseable_expiry_fails_safe(self):
+        e = allowlist_mod.Entry(rule="EV001", path="p", symbol="s",
+                                reason="r", expires="not-a-date")
+        assert e.expired(datetime.date(2020, 1, 1))
+
+    def test_committed_allowlist_loads_and_is_a_list(self):
+        entries, path = allowlist_mod.load()
+        assert path.endswith("allowlist.json")
+        assert isinstance(entries, list)

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Machine-readable sdtpu-lint summary for CI and session handoffs.
+
+Wraps ``python -m stable_diffusion_webui_distributed_tpu.analysis --json``
+with the roll-ups a dashboard wants: per-rule counts, per-file counts, the
+allowlist ledger (live/expired/unused), and a single ``clean`` boolean.
+
+    python tools/lint_report.py                 # JSON to stdout
+    python tools/lint_report.py -o lint.json    # ... or to a file
+    python tools/lint_report.py --no-allowlist  # raw findings, no ledger
+
+Exit code mirrors the lint gate: 0 clean, 1 findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from stable_diffusion_webui_distributed_tpu.analysis import (  # noqa: E402
+    RULES, run_analysis,
+)
+from stable_diffusion_webui_distributed_tpu.analysis import (  # noqa: E402
+    allowlist as allowlist_mod,
+)
+
+
+def build_report(paths=None, allowlist_path=None, use_allowlist=True):
+    result = run_analysis(REPO, paths=paths, allowlist_path=allowlist_path,
+                          use_allowlist=use_allowlist)
+    by_file = {}
+    for f in result.findings:
+        by_file[f.path] = by_file.get(f.path, 0) + 1
+    report = {
+        "clean": result.clean,
+        "modules_analyzed": result.modules,
+        "finding_count": len(result.findings),
+        "suppressed_count": len(result.suppressed),
+        "counts_by_rule": dict(sorted(result.counts.items())),
+        "counts_by_file": dict(sorted(by_file.items())),
+        "rules": dict(sorted(RULES.items())),
+        "findings": [f.as_dict() for f in result.findings],
+        "suppressed": [f.as_dict() for f in result.suppressed],
+    }
+    if use_allowlist:
+        entries, list_path = allowlist_mod.load(allowlist_path)
+        today = datetime.date.today()
+        report["allowlist"] = {
+            "path": os.path.relpath(list_path, REPO).replace(os.sep, "/"),
+            "entries": len(entries),
+            "expired": sum(1 for e in entries if e.expired(today)),
+        }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the package)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write JSON here instead of stdout")
+    ap.add_argument("--allowlist", default=None)
+    ap.add_argument("--no-allowlist", action="store_true")
+    args = ap.parse_args(argv)
+
+    report = build_report(paths=args.paths or None,
+                          allowlist_path=args.allowlist,
+                          use_allowlist=not args.no_allowlist)
+    text = json.dumps(report, indent=2) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.output} "
+              f"({report['finding_count']} finding(s))", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
