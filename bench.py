@@ -568,6 +568,175 @@ def run_config(n, tiny):
     return out
 
 
+def _psnr_b64(imgs_a, imgs_b):
+    """Mean PSNR (dB) across paired base64-PNG image lists."""
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        b64png_to_array,
+    )
+
+    vals = []
+    for a64, b64 in zip(imgs_a, imgs_b):
+        a = b64png_to_array(a64).astype(np.float64)
+        b = b64png_to_array(b64).astype(np.float64)
+        mse = float(np.mean((a - b) ** 2))
+        vals.append(99.0 if mse == 0 else 10.0 * np.log10(255.0**2 / mse))
+    return sum(vals) / max(1, len(vals))
+
+
+def _random_params(family):
+    """Flax-init (random) params for the quality cell: the zero-init bench
+    weights produce identical images on ANY compute path, so PSNR against
+    them is degenerate (99 dB). Tiny-only — never used for perf cells."""
+    import jax
+    import jax.numpy as jnp
+
+    from stable_diffusion_webui_distributed_tpu.models.clip import (
+        CLIPTextModel,
+    )
+    from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+    from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+
+    k = jax.random.key(0)
+    ids = jnp.zeros((1, 77), jnp.int32)
+    ucfg = family.unet
+    args = [jnp.zeros((2, 8, 8, ucfg.in_channels)), jnp.ones((2,)),
+            jnp.zeros((2, 77, ucfg.cross_attention_dim))]
+    if ucfg.addition_embed_dim:
+        args.append(jnp.zeros((2, ucfg.projection_input_dim)))
+    return {
+        "text_encoder": CLIPTextModel(family.text_encoder).init(
+            k, ids)["params"],
+        "text_encoder_2": (CLIPTextModel(family.text_encoder_2).init(
+            k, ids)["params"] if family.text_encoder_2 else None),
+        "unet": UNet(ucfg).init(k, *args)["params"],
+        "vae": VAE(family.vae).init(k, jnp.zeros((1, 16, 16, 3)),
+                                    jax.random.key(1))["params"],
+    }
+
+
+def _deepcache_quality(cadence):
+    """Tiny-model PSNR vs uncached with RANDOM weights (see
+    _random_params) at the same cadence + mid-ladder cutoff the perf
+    cells use."""
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+        GenerationState,
+    )
+    from stable_diffusion_webui_distributed_tpu.samplers import (
+        kdiffusion as kd,
+    )
+
+    engine = Engine(C.TINY, _random_params(C.TINY), chunk_size=4,
+                    state=GenerationState())
+    p = GenerationPayload(prompt="a herd of cows", steps=8, width=32,
+                          height=32, batch_size=2, seed=42)
+    spec = kd.resolve_sampler(p.sampler_name)
+    cutoff = float(kd.build_sigmas(spec, engine.schedule,
+                                   p.steps)[p.steps // 2])
+    base = engine.txt2img(p)
+    fast_p = p.model_copy()
+    fast_p.override_settings = {"deepcache": cadence, "cfg_cutoff": cutoff}
+    fast = engine.txt2img(fast_p)
+    return {
+        "family": C.TINY.name,
+        "steps": p.steps,
+        "cadence": cadence,
+        "cfg_cutoff_sigma": round(cutoff, 4),
+        "psnr_db_vs_uncached": round(_psnr_b64(base.images, fast.images), 2),
+    }
+
+
+def run_deepcache(tiny):
+    """Step-cache cells (ISSUE 3): configs #1/#2 run uncached, then with
+    deepcache cadence 3 + CFG cutoff at the mid-ladder sigma. The headline
+    numbers are platform-independent — UNet FLOPs/image comes from XLA
+    cost_analysis priced over the ACTUALLY dispatched chunk schedule
+    (DispatchMetrics/pipeline/stepcache.py), compile counts are host-side,
+    and PSNR compares tiny-model outputs — so CPU tiny mode produces the
+    same accounting a chip run would. Also writes BENCH_deepcache.json."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.samplers import (
+        kdiffusion as kd,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    cadence = 3
+    cells = []
+    for n in (1, 2):
+        metric, engine, payload, _segments, _rel = _build_config(n, tiny)
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas = kd.build_sigmas(spec, engine.schedule, payload.steps)
+        # cutoff at the mid-ladder sigma: the CFG branch stops mattering in
+        # the low-sigma half (arXiv:2304.11267's trick)
+        cutoff = float(sigmas[payload.steps // 2])
+
+        METRICS.clear()
+        base = engine.txt2img(payload)
+        s_base = METRICS.summary()
+
+        fast_p = payload.model_copy()
+        fast_p.override_settings = {**payload.override_settings,
+                                    "deepcache": cadence,
+                                    "cfg_cutoff": cutoff}
+        METRICS.clear()
+        fast = engine.txt2img(fast_p)
+        s_fast = METRICS.summary()
+
+        f_base = s_base["unet_flops_per_image"]
+        f_fast = s_fast["unet_flops_per_image"]
+        cut = (1.0 - f_fast / f_base) if f_base and f_fast else None
+        cells.append({
+            "config": n,
+            "metric": metric,
+            "unet_flops_per_image_base": f_base,
+            "unet_flops_per_image_cached": f_fast,
+            "flops_cut_pct": round(cut * 100.0, 1) if cut is not None
+            else None,
+            "psnr_db_vs_uncached": round(_psnr_b64(base.images,
+                                                   fast.images), 2),
+            "chunk_executables_base": s_base["compiles"].get("chunk", 0),
+            "chunk_executables_cached": s_fast["compiles"].get("chunk", 0),
+            "cadence": cadence,
+            "cfg_cutoff_sigma": round(cutoff, 4),
+            "images": len(fast.images),
+        })
+        print(f"bench: deepcache config {n}: flops/image "
+              f"{f_base:.3e} -> {f_fast:.3e} "
+              f"({cells[-1]['flops_cut_pct']}% cut), "
+              f"psnr {cells[-1]['psnr_db_vs_uncached']} dB", file=sys.stderr)
+
+    out = {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "deepcache_flops_cut",
+        "value": min(c["flops_cut_pct"] for c in cells
+                     if c["flops_cut_pct"] is not None),
+        "unit": "pct_unet_flops_per_image",
+        "vs_baseline": None,
+        # documented floor (PERF.md "FLOP levers"): tiny-model PSNR vs the
+        # uncached output at cadence 3 + mid-ladder cutoff, measured on
+        # the random-weights quality cell below (the zero-init perf cells
+        # report 99 dB by construction)
+        "psnr_floor_db": 20.0,
+        "quality": _deepcache_quality(cadence),
+        "cells": cells,
+        "device": dev.device_kind,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_deepcache.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
 def run_serving(tiny):
     """Serving-layer microbench: 8 concurrent mixed-shape requests through
     the continuous-batching dispatcher. The headline value is the coalesce
@@ -653,6 +822,10 @@ def main() -> None:
     ap.add_argument("--serving", action="store_true",
                     help="serving-layer microbench: coalesce factor + "
                          "compile counts (CPU-safe)")
+    ap.add_argument("--deepcache", action="store_true",
+                    help="step-cache cells: FLOPs/image cut, compile "
+                         "counts, PSNR vs uncached; writes "
+                         "BENCH_deepcache.json (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -688,6 +861,8 @@ def main() -> None:
 
     if args.serving:
         print(json.dumps(run_serving(tiny)))
+    elif args.deepcache:
+        print(json.dumps(run_deepcache(tiny)))
     else:
         print(json.dumps(run_config(args.config, tiny)))
 

@@ -238,6 +238,32 @@ class Upsample(nn.Module):
                      dtype=self.dtype, name="conv")(x)
 
 
+#: Depth at which the step cache splits the UNet: levels < CACHE_SPLIT are
+#: "shallow" (recomputed every step), levels >= CACHE_SPLIT plus the mid
+#: block are "deep" (computed on refresh steps only, reused in between —
+#: DeepCache's observation that deep features vary slowly across adjacent
+#: denoise steps). Split 1 maximizes the skipped FLOPs: everything below
+#: the top resolution level is cached.
+CACHE_SPLIT = 1
+
+
+def cache_supported(cfg: UNetConfig) -> bool:
+    """Deep-feature caching needs at least one level below the split."""
+    return len(cfg.block_out_channels) > CACHE_SPLIT
+
+
+def deep_cache_shape(cfg: UNetConfig, batch: int, lat_h: int,
+                     lat_w: int) -> Tuple[int, int, int, int]:
+    """Shape of the cached deep feature: the up-path hidden state right
+    after the split level's Upsample — i.e. the value the shallow up path
+    starts from on reuse steps. Spatial dims follow the stride-2 conv
+    arithmetic (ceil halving per Downsample, doubling at the Upsample)."""
+    h, w = lat_h, lat_w
+    for _ in range(CACHE_SPLIT):
+        h, w = (h + 1) // 2, (w + 1) // 2
+    return (batch, 2 * h, 2 * w, cfg.block_out_channels[CACHE_SPLIT])
+
+
 class UNet(nn.Module):
     """The full conditional denoiser.
 
@@ -245,6 +271,20 @@ class UNet(nn.Module):
       latents (B,H,W,Cin) NHWC; timesteps (B,) f32; context (B,T,Dctx);
       added_cond: SDXL (B, projection_input_dim) vector or None.
     Returns the predicted noise/v, (B,H,W,Cout).
+
+    Step-cache modes (``cache_mode``, a static trace-time choice):
+      - ``None``: the ordinary full forward (bit-identical to the
+        pre-cache code path — the golden-hash contract).
+      - ``"deep"``: run conv_in + full down path + mid + the deep up
+        levels (>= CACHE_SPLIT) and return the hidden state right after
+        the split level's Upsample — the deep feature the engine carries
+        in its denoise scan.
+      - ``"reuse"``: ``cache`` required; run only conv_in + the shallow
+        down levels (< CACHE_SPLIT) for fresh skips, start the up path
+        from ``cache``, finish with norm_out/conv_out. This is the small
+        per-step branch on non-refresh steps.
+    ControlNet residual injection is full-forward only — the engine
+    bypasses the cache for chunks with active CN units.
     """
 
     cfg: UNetConfig
@@ -273,8 +313,19 @@ class UNet(nn.Module):
         context: jax.Array,
         added_cond: Optional[jax.Array] = None,
         control_residuals: Optional[Tuple[jax.Array, ...]] = None,
+        cache: Optional[jax.Array] = None,
+        cache_mode: Optional[str] = None,
     ) -> jax.Array:
         c = self.cfg
+        assert cache_mode in (None, "deep", "reuse"), cache_mode
+        if cache_mode is not None:
+            assert cache_supported(c), \
+                "step cache needs a level below CACHE_SPLIT"
+            assert control_residuals is None, \
+                "ControlNet requires the full forward (engine bypasses)"
+        if cache_mode == "reuse":
+            assert cache is not None, "reuse mode needs the cached feature"
+        split = CACHE_SPLIT
         ch0 = c.block_out_channels[0]
         time_dim = 4 * ch0
 
@@ -300,8 +351,17 @@ class UNet(nn.Module):
         )
 
         # --- down path ---
+        # "reuse" runs only the shallow levels (< split): a shallow level's
+        # Downsample output feeds the split level's down blocks AND the
+        # split level's up blocks (as a skip), both of which live in the
+        # cached deep half — so the last shallow Downsample is skipped too.
+        n_levels = len(c.block_out_channels)
+        down_levels = split if cache_mode == "reuse" else n_levels
+        last_ds = split - 1 if cache_mode == "reuse" else n_levels - 1
         skips = [x]
-        for level, (ch, depth) in enumerate(zip(c.block_out_channels, c.down_blocks)):
+        for level, (ch, depth) in enumerate(zip(
+                c.block_out_channels[:down_levels],
+                c.down_blocks[:down_levels])):
             for i in range(c.layers_per_block):
                 x = ResBlock(ch, dtype=self.dtype,
                              quant_convs=self.quant_convs,
@@ -313,24 +373,27 @@ class UNet(nn.Module):
                         quant_linears=self.quant_linears,
                         name=f"down_{level}_attn_{i}")(x, context)
                 skips.append(x)
-            if level < len(c.block_out_channels) - 1:
+            if level < last_ds:
                 x = Downsample(ch, dtype=self.dtype,
                                quant_convs=self.quant_convs,
                                name=f"down_{level}_ds")(x)
                 skips.append(x)
 
-        # --- mid ---
-        mid_ch = c.block_out_channels[-1]
-        x = ResBlock(mid_ch, dtype=self.dtype,
-                     quant_convs=self.quant_convs, name="mid_res_0")(x, temb)
-        if c.mid_block_depth is not None:
-            x = SpatialTransformer(
-                c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
-                self.dtype, self.attention_impl, self.mesh,
-                quant_linears=self.quant_linears,
-                name="mid_attn")(x, context)
-        x = ResBlock(mid_ch, dtype=self.dtype,
-                     quant_convs=self.quant_convs, name="mid_res_1")(x, temb)
+        if cache_mode != "reuse":
+            # --- mid ---
+            mid_ch = c.block_out_channels[-1]
+            x = ResBlock(mid_ch, dtype=self.dtype,
+                         quant_convs=self.quant_convs,
+                         name="mid_res_0")(x, temb)
+            if c.mid_block_depth is not None:
+                x = SpatialTransformer(
+                    c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
+                    self.dtype, self.attention_impl, self.mesh,
+                    quant_linears=self.quant_linears,
+                    name="mid_attn")(x, context)
+            x = ResBlock(mid_ch, dtype=self.dtype,
+                         quant_convs=self.quant_convs,
+                         name="mid_res_1")(x, temb)
 
         # ControlNet residual injection: one residual per skip + one for the
         # mid block output (the standard ControlNet contract; the reference
@@ -345,7 +408,14 @@ class UNet(nn.Module):
                      for s, r in zip(skips, control_residuals[:-1])]
 
         # --- up path (mirror of down, one extra layer per block) ---
-        for level in reversed(range(len(c.block_out_channels))):
+        # "deep" stops after the split level's Upsample and returns the
+        # hidden state there; "reuse" starts from it.
+        up_stop = split if cache_mode == "deep" else 0
+        if cache_mode == "reuse":
+            x = cache.astype(self.dtype)
+        for level in reversed(range(up_stop,
+                                    split if cache_mode == "reuse"
+                                    else n_levels)):
             ch = c.block_out_channels[level]
             depth = c.down_blocks[level]
             for i in range(c.layers_per_block + 1):
@@ -363,6 +433,10 @@ class UNet(nn.Module):
                 x = Upsample(ch, dtype=self.dtype,
                              quant_convs=self.quant_convs,
                              name=f"up_{level}_us")(x)
+        if cache_mode == "deep":
+            # the shallow skips stay unconsumed by design; the engine's
+            # reuse branch recomputes them fresh each step
+            return x
         assert not skips, f"{len(skips)} unconsumed skip connections"
 
         x = nn.silu(GroupNorm32(name="norm_out")(x))

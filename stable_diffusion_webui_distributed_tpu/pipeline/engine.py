@@ -31,9 +31,15 @@ import numpy as np
 
 from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
 from stable_diffusion_webui_distributed_tpu.models.configs import ModelFamily
-from stable_diffusion_webui_distributed_tpu.models.unet import UNet, make_added_cond
+from stable_diffusion_webui_distributed_tpu.models.unet import (
+    UNet,
+    cache_supported,
+    deep_cache_shape,
+    make_added_cond,
+)
 from stable_diffusion_webui_distributed_tpu.models.vae import VAE
 from stable_diffusion_webui_distributed_tpu.models.tokenizer import load_tokenizer
+from stable_diffusion_webui_distributed_tpu.pipeline import stepcache
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
     GenerationResult,
@@ -170,6 +176,10 @@ class Engine:
 
         self._cache: Dict[Tuple, Callable] = {}  # guarded-by: _cache_lock
         self._cache_lock = threading.Lock()
+        # XLA cost_analysis pricer for the per-request UNet-FLOPs metric
+        # (pipeline/stepcache.py); lowers abstractly, so it is cheap to
+        # hold per engine and its cache keys on eval shapes only
+        self._flops = stepcache.FlopsAccountant(self)
         # blank hybrid-conditioning latents per (batch, size); VAE-derived,
         # so set_vae clears it
         self._blank_cond_cache: Dict[Tuple, Any] = {}
@@ -330,12 +340,30 @@ class Engine:
     def _chunk_fn(self, sampler_name: str, steps: int, width: int,
                   height: int, batch: int, length: int,
                   masked: bool, n_controls: int = 0,
-                  inpaint: bool = False) -> Callable:
+                  inpaint: bool = False,
+                  step_cache: bool = False) -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
-        index. Cache key excludes prompt/seed/cfg — those are data."""
+        index. Cache key excludes prompt/seed/cfg — those are data.
+
+        ``step_cache`` selects the step-cache variant (deep-feature reuse
+        + CFG truncation, pipeline/stepcache.py): it is the ONLY static
+        bit the levers add to the compile key — the refresh cadence and
+        the cutoff step index travel as traced data — so a shape bucket
+        mints at most two chunk executables (plain + step-cache).
+        ControlNet chunks never take the cached path (the chunk loop
+        routes active-CN windows to the plain executable).
+
+        Both variants return ``(carry..., fence)`` where ``fence`` is a
+        tiny data-dependent output: the host paces progress/interrupt on
+        it because the carry's INPUT buffers are donated into the next
+        chunk (dead after each dispatch — donating halves peak latent
+        HBM) and must not be touched once a later chunk is in flight."""
         spec = kd.resolve_sampler(sampler_name)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
-               masked, n_controls, inpaint, self.family.name)
+               masked, n_controls, inpaint, self.family.name, step_cache)
+        if step_cache:
+            return self._cached(key, lambda: self._build_stepcache_chunk(
+                spec, steps, batch, length, masked, inpaint))
 
         def build():
             sigmas = kd.build_sigmas(spec, self.schedule, steps)
@@ -368,11 +396,151 @@ class Engine:
 
                 idx = start + jnp.arange(length)
                 carry, _ = jax.lax.scan(step, carry, idx)
-                return carry
+                return carry, carry.x.reshape(-1)[:1]
 
-            return jax.jit(run_chunk)
+            return jax.jit(run_chunk, donate_argnums=(1,))
 
         return self._cached(key, build)
+
+    def _build_stepcache_chunk(self, spec, steps: int, batch: int,
+                               length: int, masked: bool,
+                               inpaint: bool) -> Callable:
+        """Step-cache chunk executable (see _chunk_fn / stepcache.py).
+
+        Scan state is (sampler carry, deep-feature cache, valid bit). The
+        deep feature — everything below models/unet.py:CACHE_SPLIT plus
+        the mid block — is refreshed BEFORE the sampler step whenever the
+        bit is unset or the absolute step index lands on the cadence, so
+        every UNet eval that step makes (Heun's midpoint included) rides
+        the shallow reuse path against a feature computed from the step's
+        own entry latent. The cache always holds [uncond; cond] rows: a
+        CFG-truncated refresh computes the cond half only and mirrors it,
+        so crossing the cutoff never changes buffer shapes. Cadence and
+        cutoff are traced int32 scalars (``lax.cond`` picks the variant
+        per step); carry and cache are donated — dead after each chunk."""
+        sigmas = kd.build_sigmas(spec, self.schedule, steps)
+        v_pred = self.schedule.prediction_type == "v_prediction"
+        B = batch
+
+        def run_chunk(unet_params, carry, cache, valid, start, ctx_u,
+                      ctx_c, cfg, image_keys, added_u, added_c, mask_lat,
+                      init_lat, inpaint_cond, cadence, cfg_stop):
+            params = {"params": unet_params}
+
+            def prep(x, sigma):
+                c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
+                return (x * c_in).astype(x.dtype), \
+                    self.schedule.sigma_to_t(sigma)
+
+            def full_inputs(xin, t):
+                both = jnp.concatenate([xin, xin], axis=0)
+                tb = jnp.full((2 * B,), t, jnp.float32)
+                ctx = jnp.concatenate([
+                    jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
+                    jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
+                ], axis=0)
+                added = None
+                if added_u is not None:
+                    added = jnp.concatenate([
+                        jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
+                        jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
+                    ], axis=0)
+                if inpaint:
+                    cond2 = jnp.concatenate(
+                        [inpaint_cond, inpaint_cond],
+                        axis=0).astype(both.dtype)
+                    both = jnp.concatenate([both, cond2], axis=-1)
+                return both, tb, ctx, added
+
+            def cond_inputs(xin, t):
+                # CFG-truncated half: cond rows only, uncond branch dropped
+                tb = jnp.full((B,), t, jnp.float32)
+                ctx = jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:])
+                added = None
+                if added_u is not None:
+                    added = jnp.broadcast_to(
+                        added_c, (B,) + added_c.shape[1:])
+                xi = xin
+                if inpaint:
+                    xi = jnp.concatenate(
+                        [xin, inpaint_cond.astype(xin.dtype)], axis=-1)
+                return xi, tb, ctx, added
+
+            def step(state, i):
+                carry, cache, valid = state
+                sigma = sigmas[i]
+                xin, t = prep(carry.x, sigma)
+                refresh = jnp.logical_or(
+                    jnp.logical_not(valid), jnp.mod(i, cadence) == 0)
+
+                def do_refresh(_):
+                    def deep_full(_):
+                        xi, tb, ctx, added = full_inputs(xin, t)
+                        return self.unet.apply(params, xi, tb, ctx, added,
+                                               cache_mode="deep")
+
+                    def deep_trunc(_):
+                        xi, tb, ctx, added = cond_inputs(xin, t)
+                        d = self.unet.apply(params, xi, tb, ctx, added,
+                                            cache_mode="deep")
+                        return jnp.concatenate([d, d], axis=0)
+
+                    return jax.lax.cond(i >= cfg_stop, deep_trunc,
+                                        deep_full, None).astype(cache.dtype)
+
+                new_cache = jax.lax.cond(
+                    refresh, do_refresh, lambda _: cache, None)
+
+                def denoise(x, sigma_e, step_i):
+                    xe, te = prep(x, sigma_e)
+
+                    def eval_full(_):
+                        xi, tb, ctx, added = full_inputs(xe, te)
+                        out = self.unet.apply(
+                            params, xi, tb, ctx, added,
+                            cache=new_cache, cache_mode="reuse")
+                        out_u, out_c = jnp.split(
+                            out.astype(jnp.float32), 2, axis=0)
+                        return out_u + cfg * (out_c - out_u)
+
+                    def eval_trunc(_):
+                        xi, tb, ctx, added = cond_inputs(xe, te)
+                        out = self.unet.apply(
+                            params, xi, tb, ctx, added,
+                            cache=new_cache[B:], cache_mode="reuse")
+                        return out.astype(jnp.float32)
+
+                    guided = jax.lax.cond(step_i >= cfg_stop, eval_trunc,
+                                          eval_full, None)
+                    if v_pred:
+                        c_skip = 1.0 / (sigma_e**2 + 1.0)
+                        c_out = sigma_e / jnp.sqrt(sigma_e**2 + 1.0)
+                        return x * c_skip - guided * c_out
+                    return x - sigma_e * guided
+
+                base_step = kd.make_sampler_step(
+                    spec, denoise, sigmas, image_keys)
+                carry2, _ = base_step(carry, i)
+                if masked:
+                    # same unmasked-region pinning (and noise domain) as
+                    # the plain chunk — cadence must not move inpaint RNG
+                    def renoise(k):
+                        return jax.random.normal(
+                            jax.random.fold_in(k, 1_000_000 + i),
+                            init_lat.shape[1:], jnp.float32)
+
+                    noise = jax.vmap(renoise)(image_keys)
+                    pinned = init_lat + noise * sigmas[i + 1]
+                    xp = mask_lat * carry2.x + (1 - mask_lat) * pinned
+                    carry2 = carry2._replace(x=xp)
+                return (carry2, new_cache, jnp.full_like(valid, True)), ()
+
+            idx = start + jnp.arange(length)
+            (carry, cache, valid), _ = jax.lax.scan(
+                step, (carry, cache, valid), idx)
+            return carry, cache, valid, carry.x.reshape(-1)[:1]
+
+        return jax.jit(run_chunk, donate_argnums=(1, 2))
 
     def _adaptive_attempt_fn(self, width: int, height: int, batch: int,
                              n_controls: int = 0,
@@ -579,7 +747,10 @@ class Engine:
                 return (decode(vae_params, latents) * 255.0 + 0.5
                         ).astype(jnp.uint8)
 
-            return jax.jit(decode_u8)
+            # the latent rows handed in by _queue_decoded are per-dispatch
+            # slices, dead after decode — donate them so decoder scratch
+            # reuses their HBM
+            return jax.jit(decode_u8, donate_argnums=(1,))
 
         return self._cached(key, build)
 
@@ -1069,6 +1240,32 @@ class Engine:
         inp_arg = inpaint_cond if inpainting else jnp.float32(0)
         carry = kd.init_carry(x)
         end = steps if end_step is None else min(end_step, steps)
+
+        # Step-cache policy (pipeline/stepcache.py): deep-feature reuse +
+        # CFG truncation. Inactive (cadence 1, cutoff 0 — the default)
+        # routes every chunk to the UNCHANGED plain executable, so default
+        # outputs stay byte-identical by construction. The cutoff sigma is
+        # located on the built ladder host-side (searchsorted, like the
+        # adaptive path's CN window gating) and rides into the executable
+        # as a traced step index.
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sc = stepcache.resolve(payload)
+        cfg_stop = stepcache.cutoff_step(
+            np.asarray(kd.build_sigmas(spec, self.schedule, steps)),
+            sc.cutoff_sigma)
+        use_cache = sc.active and cache_supported(self.family.unet)
+        cache = valid = None
+        if use_cache:
+            # [uncond; cond] deep-feature rows; a fresh range starts
+            # INVALID so the first step always refreshes — which is also
+            # what makes an interrupt-resume boundary safe mid-cadence
+            cache = jnp.zeros(
+                deep_cache_shape(self.family.unet, 2 * batch,
+                                 x.shape[1], x.shape[2]),
+                self.policy.compute_dtype)
+            valid = jnp.asarray(False)
+        dispatched = []  # (start, length, cached) — FLOPs accounting
+
         self.state.begin(job, end - start_step)
         done = 0
         pos = start_step
@@ -1076,8 +1273,10 @@ class Engine:
         # on-device, so the host->device roundtrip (expensive through a
         # chip relay) overlaps compute. Interrupt latency stays <= 2
         # chunks: the flag is checked before every dispatch and at most
-        # one extra chunk is in flight when it flips.
-        pending = None  # (carry, chunk_length) still running on-device
+        # one extra chunk is in flight when it flips. The host paces on
+        # each chunk's FENCE output, never its carry — the carry buffers
+        # are donated into the next dispatch.
+        pending = None  # (fence, chunk_length) still running on-device
         while pos < end:
             if self.state.flag.interrupted:
                 break
@@ -1088,26 +1287,73 @@ class Engine:
             hi = (pos + length - 0.5) / steps
             active = tuple(c for c in controls
                            if c[3] <= hi and c[4] >= lo)
+            # ControlNet windows bypass the step cache: residuals feed the
+            # deep blocks, so a stale deep feature would drop them
+            cached_chunk = use_cache and not active
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
                                 batch, length, masked=masked,
-                                n_controls=len(active), inpaint=inpainting)
+                                n_controls=len(active), inpaint=inpainting,
+                                step_cache=cached_chunk)
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate(f"denoise[{pos}:{pos + length}]"):
-                carry = fn(self.params["unet"], carry, jnp.int32(pos), ctx_u,
-                           ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
-                           active, inp_arg)
+                if cached_chunk:
+                    carry, cache, valid, fence = fn(
+                        self.params["unet"], carry, cache, valid,
+                        jnp.int32(pos), ctx_u, ctx_c, cfg, image_keys,
+                        au, ac, mask_arg, init_arg, inp_arg,
+                        jnp.int32(sc.cadence), jnp.int32(cfg_stop))
+                else:
+                    carry, fence = fn(
+                        self.params["unet"], carry, jnp.int32(pos), ctx_u,
+                        ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
+                        active, inp_arg)
+                    if valid is not None:
+                        # a plain (CN-active) chunk advanced the latent
+                        # outside the cache's view — refresh on re-entry
+                        valid = jnp.asarray(False)
                 if sync and pending is not None:
-                    pending[0].x.block_until_ready()
+                    pending[0].block_until_ready()
                     done += pending[1]
                     self.state.step(done)
-            pending = (carry, length)
+            dispatched.append((pos, length, cached_chunk))
+            pending = (fence, length)
             pos += length
         if sync and pending is not None:
-            pending[0].x.block_until_ready()
+            pending[0].block_until_ready()
             done += pending[1]
             self.state.step(done)
         self.state.finish()
+        self._record_unet_flops(dispatched, sc.cadence if use_cache else 1,
+                                cfg_stop, spec.evals_per_step, steps, batch,
+                                x.shape[1], x.shape[2], ctx_c.shape[1])
         return carry.x
+
+    def _record_unet_flops(self, dispatched, cadence, cfg_stop,
+                           evals_per_step, steps, batch, lat_h, lat_w,
+                           ctx_len) -> None:
+        """Price a denoise range's dispatched chunk schedule with XLA
+        cost_analysis (stepcache.FlopsAccountant) and fold the total into
+        DispatchMetrics — the numerator of ``unet_flops_per_image`` on
+        ``/internal/status``. Gated by ``SDTPU_FLOPS_METRICS``; pricing
+        failures never break generation."""
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            env_flag,
+        )
+        from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+            METRICS,
+        )
+
+        if not dispatched or not env_flag("SDTPU_FLOPS_METRICS", True):
+            return
+        try:
+            counts = stepcache.plan_schedule(
+                dispatched, cadence, cfg_stop, evals_per_step, steps)
+            total = self._flops.request_flops(
+                counts, batch, lat_h, lat_w, ctx_len)
+            if total is not None:
+                METRICS.record_unet_flops(total)
+        except Exception:
+            pass
 
     def _start_sigma(self, spec, steps):
         sigmas = kd.build_sigmas(spec, self.schedule, steps)
@@ -1455,6 +1701,9 @@ class Engine:
         from stable_diffusion_webui_distributed_tpu.runtime.config import (
             env_int,
         )
+        from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+            METRICS,
+        )
 
         # snapshot-and-clear the adaptive incompletion latch HERE, at the
         # only point that knows which images a denoise produced — a sticky
@@ -1462,6 +1711,10 @@ class Engine:
         # same request once the depth-1 decode pipeline interleaves flushes
         incomplete = getattr(self, "_adaptive_incomplete", False)
         self._adaptive_incomplete = False
+        # FLOPs-per-image denominator: every kept row is one output image,
+        # counted at the single point all decode paths (engine loops, the
+        # serving dispatcher, the stage pipeline) funnel through
+        METRICS.record_unet_images(min(n, latents.shape[0]))
         budget = env_int("SDTPU_DECODE_PIXELS", self._DECODE_PIXEL_BUDGET)
         per = max(1, budget // max(1, width * height))
         entries = []
@@ -1472,7 +1725,15 @@ class Engine:
                 pad = jnp.repeat(rows[-1:], per - rows.shape[0], axis=0)
                 rows = jnp.concatenate([rows, pad], axis=0)
             decode = self._decode_u8_fn(width, height, rows.shape[0])
-            with trace.STATS.timer("vae_decode_dispatch"):
+            import warnings as _warnings
+
+            with trace.STATS.timer("vae_decode_dispatch"), \
+                    _warnings.catch_warnings():
+                # the latent rows are f32 and the output is uint8 pixels, so
+                # the declared donation can never alias an output buffer —
+                # JAX flags that at first lowering; expected, not actionable
+                _warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
                 imgs = decode(self.params["vae"], rows)
             entries.append((imgs, pos + s, keep, width, height,
                             incomplete))

@@ -37,6 +37,28 @@ def _logger():
 # for raw ``os.environ`` access. A malformed value never crashes startup —
 # it warns once and falls back, matching the config loader's quarantine
 # philosophy above.
+#
+# Step-cache knobs (pipeline/stepcache.py; README "TPU policy knobs"):
+#
+# - ``SDTPU_DEEPCACHE`` (int, default 1 = off): deep-feature refresh
+#   cadence. At N > 1 the UNet's deep blocks (below models/unet.py
+#   CACHE_SPLIT, plus the mid block) run once every N steps; in between,
+#   only the shallow down blocks + up path run against the cached deep
+#   feature. Values quantize DOWN onto stepcache.CADENCE_LADDER
+#   (1/2/3/4/6/8) before influencing anything compile-shaped (RC001);
+#   per-request override: ``override_settings.deepcache``.
+# - ``SDTPU_CFG_CUTOFF`` (float sigma, default 0 = off): below this
+#   sigma the CFG uncond half is dropped and the UNet runs cond-only
+#   rows. Mapped host-side onto the built sigma ladder and carried as a
+#   traced step index; per-request: ``override_settings.cfg_cutoff``.
+# - ``SDTPU_FLOPS_METRICS`` (flag, default on): price each dispatched
+#   denoise schedule with XLA cost_analysis and expose UNet
+#   FLOPs-per-image in DispatchMetrics / ``/internal/status``. ``0``
+#   skips the accounting (it costs one abstract lowering per new eval
+#   shape).
+#
+# Defaults keep both levers off: generation stays byte-identical to the
+# plain executable unless a deployment opts into the FLOP/quality trade.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -386,8 +386,11 @@ def make_sampler_step(
 
 
 def init_carry(x: jax.Array) -> Carry:
-    z = jnp.zeros_like(x)
-    return Carry(x, z, jnp.bool_(False), z, z, jnp.int32(0))
+    # the history leaves must be DISTINCT buffers, not one shared zeros
+    # array: the engine donates the whole carry into each chunk dispatch,
+    # and XLA rejects donating the same buffer twice
+    return Carry(x, jnp.zeros_like(x), jnp.bool_(False), jnp.zeros_like(x),
+                 jnp.zeros_like(x), jnp.int32(0))
 
 
 def run_steps(

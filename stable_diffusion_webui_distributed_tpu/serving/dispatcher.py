@@ -198,9 +198,18 @@ class ServingDispatcher:
         return p.total_images <= self.max_batch
 
     def _group_key(self, run) -> tuple:
+        from stable_diffusion_webui_distributed_tpu.pipeline import (
+            stepcache,
+        )
+
+        # step-cache knobs join the key: merged requests run ONE denoise
+        # range, so they must agree on the resolved (bucketed) cadence and
+        # CFG cutoff or the coalesced batch would change their outputs
+        sc = stepcache.resolve(run)
         return ("txt2img", run.sampler_name, int(run.steps),
                 int(run.width), int(run.height), float(run.cfg_scale),
-                run.negative_prompt or "", int(run.clip_skip or 0))
+                run.negative_prompt or "", int(run.clip_skip or 0),
+                sc.cadence, sc.cutoff_sigma)
 
     def _run_grouped(self, ticket: Ticket) -> None:
         key = self._group_key(ticket.run)

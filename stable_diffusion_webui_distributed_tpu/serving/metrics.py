@@ -53,6 +53,12 @@ class DispatchMetrics:
             #: sum of (bucket px / requested px) per bucketed request
             self.padding_ratio_total = 0.0  # guarded-by: _lock
             self.padding_ratio_count = 0  # guarded-by: _lock
+            #: UNet FLOPs actually dispatched (XLA cost_analysis priced
+            #: over each request's chunk schedule, pipeline/stepcache.py)
+            self.unet_flops_total = 0.0  # guarded-by: _lock
+            #: images decoded to outputs (denominator for FLOPs/image —
+            #: hires/refiner FLOPs fold into the one image they produce)
+            self.unet_images = 0  # guarded-by: _lock
 
     # -- engine-side ------------------------------------------------------
 
@@ -92,6 +98,15 @@ class DispatchMetrics:
             self.queue_wait_total += float(seconds)
             self.queue_wait_count += 1
 
+    def record_unet_flops(self, flops: float) -> None:
+        """One denoise range's priced UNet FLOPs (engine-side)."""
+        with self._lock:
+            self.unet_flops_total += float(flops)
+
+    def record_unet_images(self, n: int) -> None:
+        with self._lock:
+            self.unet_images += int(n)
+
     # -- readers ----------------------------------------------------------
 
     def compile_count(self, kind: str = "chunk") -> int:
@@ -118,6 +133,14 @@ class DispatchMetrics:
                 return 1.0
             return self.padding_ratio_total / self.padding_ratio_count
 
+    def unet_flops_per_image(self) -> float:
+        """Mean dispatched UNet FLOPs per output image (0.0 until both
+        a priced denoise range and a decoded image have been recorded)."""
+        with self._lock:
+            if not self.unet_images:
+                return 0.0
+            return self.unet_flops_total / self.unet_images
+
     def summary(self) -> Dict:
         with self._lock:
             total_buckets = self.bucket_hits + self.bucket_misses
@@ -140,6 +163,11 @@ class DispatchMetrics:
                 "avg_padding_ratio": (self.padding_ratio_total
                                       / self.padding_ratio_count
                                       if self.padding_ratio_count else None),
+                "unet_flops_total": self.unet_flops_total,
+                "unet_images": self.unet_images,
+                "unet_flops_per_image": (self.unet_flops_total
+                                         / self.unet_images
+                                         if self.unet_images else None),
             }
 
 

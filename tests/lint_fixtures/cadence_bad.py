@@ -1,0 +1,30 @@
+"""Known-bad step-cache cadence fixture (RC001).
+
+The deep-feature refresh cadence is env-derived (SDTPU_DEEPCACHE);
+pinning it as a jit STATIC argument mints one executable per distinct
+value. It must be quantized onto the cadence ladder first
+(stepcache.bucket_cadence — the clean variant below), or travel as
+traced data the way the engine's chunk executable actually carries it.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.pipeline.stepcache import (
+    bucket_cadence,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import env_int
+
+
+def chunk_bad(payload):
+    fn = jax.jit(lambda x, cadence: x * cadence, static_argnums=(1,))
+    cadence = env_int("SDTPU_DEEPCACHE", 1)
+    return fn(jnp.zeros(4), cadence)  # RC001: raw env cadence as static
+
+
+def chunk_clean(payload):
+    fn = jax.jit(lambda x, cadence: x * cadence, static_argnums=(1,))
+    cadence = bucket_cadence(env_int("SDTPU_DEEPCACHE", 1))
+    return fn(jnp.zeros(4), cadence)  # clean: ladder-quantized

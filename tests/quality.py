@@ -1,0 +1,109 @@
+"""Shared image-quality harness for approximation tests.
+
+The step-cache (deep-feature reuse / CFG truncation) and int8 (W8A8
+quantized linears) tests both compare an approximated pipeline against an
+exact baseline on the SAME random-weight tiny engine, asserting a PSNR /
+SSIM floor instead of bit-identity. This module holds the shared pieces:
+
+- :func:`init_params` / :func:`make_engine` — flax-random tiny engines.
+  Random weights matter: a zero-init engine produces identical pixels on
+  every compute path, so any PSNR measured against it is vacuously 99 dB.
+- :func:`psnr` / :func:`ssim` — plain-numpy metrics over uint8 images
+  (no scipy/skimage in the image; SSIM uses a 7x7 uniform window).
+- :func:`mean_psnr` / :func:`mean_ssim` — paired b64-PNG result lists,
+  the form engine results arrive in.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.clip import CLIPTextModel
+from stable_diffusion_webui_distributed_tpu.models.unet import UNet
+from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    b64png_to_array,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+
+#: PSNR returned for bit-identical images (MSE 0 has no finite PSNR).
+IDENTICAL_DB = 99.0
+
+
+def init_params(family, seed=0):
+    """Flax-random params for a tiny family (same recipe as the pipeline
+    test fixtures; seedable so quality cells can vary the network)."""
+    k = jax.random.key(seed)
+    ids = jnp.zeros((1, 77), jnp.int32)
+    te = CLIPTextModel(family.text_encoder).init(k, ids)["params"]
+    te2 = (CLIPTextModel(family.text_encoder_2).init(k, ids)["params"]
+           if family.text_encoder_2 else None)
+    ctx_dim = family.unet.cross_attention_dim
+    args = [jnp.zeros((2, 8, 8, family.unet.in_channels)), jnp.ones((2,)),
+            jnp.zeros((2, 77, ctx_dim))]
+    if family.unet.addition_embed_dim:
+        args.append(jnp.zeros((2, family.unet.projection_input_dim)))
+    un = UNet(family.unet).init(k, *args)["params"]
+    vae = VAE(family.vae).init(k, jnp.zeros((1, 16, 16, 3)),
+                               jax.random.key(seed + 1))["params"]
+    return {"text_encoder": te, "text_encoder_2": te2,
+            "unet": un, "vae": vae}
+
+
+def make_engine(family, seed=0, chunk_size=4, policy=dtypes.F32):
+    return Engine(family, init_params(family, seed=seed),
+                  chunk_size=chunk_size, policy=policy,
+                  state=GenerationState())
+
+
+def psnr(a, b) -> float:
+    """PSNR in dB between two uint8 images (:data:`IDENTICAL_DB` when
+    bit-identical)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    mse = np.mean((a - b) ** 2)
+    if mse == 0:
+        return IDENTICAL_DB
+    return float(10.0 * np.log10(255.0**2 / mse))
+
+
+def _to_gray(img):
+    img = np.asarray(img, dtype=np.float64)
+    if img.ndim == 3:
+        return img @ np.array([0.299, 0.587, 0.114])
+    return img
+
+
+def ssim(a, b, window: int = 7) -> float:
+    """Mean local SSIM between two uint8 images (luma, uniform window)."""
+    ga, gb = _to_gray(a), _to_gray(b)
+    wa = np.lib.stride_tricks.sliding_window_view(ga, (window, window))
+    wb = np.lib.stride_tricks.sliding_window_view(gb, (window, window))
+    mu_a = wa.mean(axis=(-1, -2))
+    mu_b = wb.mean(axis=(-1, -2))
+    var_a = wa.var(axis=(-1, -2))
+    var_b = wb.var(axis=(-1, -2))
+    cov = (wa * wb).mean(axis=(-1, -2)) - mu_a * mu_b
+    c1 = (0.01 * 255.0) ** 2
+    c2 = (0.03 * 255.0) ** 2
+    s = ((2 * mu_a * mu_b + c1) * (2 * cov + c2)) / (
+        (mu_a**2 + mu_b**2 + c1) * (var_a + var_b + c2))
+    return float(s.mean())
+
+
+def mean_psnr(imgs_a, imgs_b) -> float:
+    """Mean PSNR over paired b64-PNG image lists (engine result form)."""
+    assert len(imgs_a) == len(imgs_b) and imgs_a
+    return float(np.mean([psnr(b64png_to_array(x), b64png_to_array(y))
+                          for x, y in zip(imgs_a, imgs_b)]))
+
+
+def mean_ssim(imgs_a, imgs_b) -> float:
+    assert len(imgs_a) == len(imgs_b) and imgs_a
+    return float(np.mean([ssim(b64png_to_array(x), b64png_to_array(y))
+                          for x, y in zip(imgs_a, imgs_b)]))

@@ -105,6 +105,13 @@ class TestFixtures:
             ("LK003", 23),  # a->b in ab() vs b->a in ba()
         }
 
+    def test_cadence_family(self):
+        # the step-cache knob discipline: a raw env-derived refresh
+        # cadence pinned static is RC001; the bucket_cadence-quantized
+        # variant in the same fixture must stay clean
+        found = _rule_lines(_fixture_findings("cadence_bad.py"))
+        assert found == {("RC001", 24)}
+
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")
         rendered = "\n".join(f.render() for f in findings)

@@ -1,0 +1,57 @@
+"""W8A8 int8 UNet quality floor (ops/quant.py), on the shared quality
+harness (tests/quality.py — the same PSNR/SSIM rig the step-cache tests
+use).
+
+The int8 path quantizes the UNet transformer linears dynamically
+(``Policy.unet_int8``); like the step-cache levers it trades exactness
+for throughput, so the contract is the same shape: pixels may move, but
+only within a documented PSNR/SSIM floor against the exact f32 baseline
+on the SAME random-weight tiny engine.
+"""
+
+import dataclasses
+
+import pytest
+
+import quality
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import dtypes
+
+#: Quality floors for W8A8 on the tiny family (measured well above this;
+#: see PERF.md "FLOP levers" for the production caveats).
+PSNR_FLOOR_DB = 20.0
+SSIM_FLOOR = 0.6
+
+
+def _payload():
+    return GenerationPayload(prompt="a cow", steps=8, width=32, height=32,
+                             batch_size=2, seed=42)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return quality.make_engine(TINY).txt2img(_payload())
+
+
+@pytest.fixture(scope="module")
+def int8_result():
+    policy = dataclasses.replace(dtypes.F32, unet_int8=True)
+    return quality.make_engine(TINY, policy=policy).txt2img(_payload())
+
+
+@pytest.mark.slow
+class TestInt8Quality:
+    def test_int8_actually_engaged(self, baseline, int8_result):
+        # identical bytes would mean the quantized path silently no-opped
+        assert int8_result.images != baseline.images
+
+    def test_psnr_floor(self, baseline, int8_result):
+        db = quality.mean_psnr(int8_result.images, baseline.images)
+        assert db >= PSNR_FLOOR_DB, f"int8 PSNR {db:.2f} dB under floor"
+
+    def test_ssim_floor(self, baseline, int8_result):
+        s = quality.mean_ssim(int8_result.images, baseline.images)
+        assert s >= SSIM_FLOOR, f"int8 SSIM {s:.3f} under floor"

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Static UNet FLOPs-per-image report for the step-cache levers.
+
+Prices the deep-feature-reuse / CFG-truncation schedule WITHOUT running a
+single denoise step: ``stepcache.plan_schedule`` replays the in-graph
+refresh/truncation decisions on the host and ``stepcache.FlopsAccountant``
+prices each UNet-eval variant from XLA's abstract-lowering cost analysis
+(no device compile, no weight materialization — works on a CPU dev box).
+
+For each family it reports FLOPs/image under four lever settings:
+
+    off                 cadence 1, no CFG cutoff (the plain executable)
+    cadence2            deep refresh every 2nd step
+    cadence3            deep refresh every 3rd step
+    cadence3+cutoff     cadence 3 plus CFG truncation at mid-schedule
+
+    python tools/flops_report.py                  # JSON to stdout
+    python tools/flops_report.py -o flops.json    # ... or to a file
+    python tools/flops_report.py --steps 20       # deeper schedule
+
+Exit code is always 0; pricing failures surface as null cells.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from stable_diffusion_webui_distributed_tpu.models import (  # noqa: E402
+    configs as C,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline import (  # noqa: E402
+    stepcache,
+)
+from stable_diffusion_webui_distributed_tpu.samplers import (  # noqa: E402
+    kdiffusion as kd,
+)
+
+#: (label, cadence, use mid-schedule CFG cutoff)
+SETTINGS = (
+    ("off", 1, False),
+    ("cadence2", 2, False),
+    ("cadence3", 3, False),
+    ("cadence3+cutoff", 3, True),
+)
+
+
+def _engine(family):
+    import bench  # noqa: E402  (repo root on path; reuse its zero-init rig)
+
+    return bench._make_engine(family)
+
+
+def _schedule_counts(steps, cadence, cfg_stop, evals_per_step):
+    chunks = [(0, steps, True)]  # one cached chunk: the steady-state shape
+    return stepcache.plan_schedule(chunks, cadence, cfg_stop,
+                                   evals_per_step, steps)
+
+
+def family_report(family, steps, width, height, batch, sampler):
+    eng = _engine(family)
+    acct = stepcache.FlopsAccountant(eng)
+    spec = kd.resolve_sampler(sampler)
+    sigmas = np.asarray(kd.build_sigmas(spec, eng.schedule, steps))
+    lat_h = height // 8
+    lat_w = width // 8
+    ctx_len = eng.family.text_encoder.max_length
+
+    cells = {}
+    base = None
+    for label, cadence, use_cutoff in SETTINGS:
+        cutoff_sigma = float(sigmas[len(sigmas) // 2]) if use_cutoff else 0.0
+        cfg_stop = stepcache.cutoff_step(sigmas, cutoff_sigma)
+        counts = _schedule_counts(steps, cadence, cfg_stop,
+                                  spec.evals_per_step)
+        total = acct.request_flops(counts, batch, lat_h, lat_w, ctx_len)
+        per_image = None if total is None else total / batch
+        if label == "off":
+            base = per_image
+        cells[label] = {
+            "cadence": cadence,
+            "cutoff_sigma": cutoff_sigma,
+            "cfg_stop": cfg_stop,
+            "schedule": counts,
+            "unet_flops_per_image": per_image,
+            "cut_pct": (None if base is None or per_image is None or not base
+                        else round((1.0 - per_image / base) * 100.0, 1)),
+        }
+    return {
+        "family": family.name,
+        "sampler": sampler,
+        "steps": steps,
+        "width": width,
+        "height": height,
+        "batch_size": batch,
+        "settings": cells,
+    }
+
+
+def build_report(steps=8, width=64, height=64, batch=1,
+                 sampler="Euler", families=None):
+    fams = families or (C.TINY, C.TINY_XL)
+    return {
+        "tool": "flops_report",
+        "note": ("static schedule pricing via stepcache.plan_schedule + "
+                 "XLA cost_analysis; no denoise steps executed"),
+        "families": [family_report(f, steps, width, height, batch, sampler)
+                     for f in fams],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--output", default=None,
+                    help="write JSON here instead of stdout")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--height", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--sampler", default="Euler")
+    args = ap.parse_args(argv)
+
+    report = build_report(steps=args.steps, width=args.width,
+                          height=args.height, batch=args.batch,
+                          sampler=args.sampler)
+    text = json.dumps(report, indent=2) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
