@@ -791,6 +791,8 @@ def run_serving(tiny):
     for t in threads:
         t.join()
     wall = time.time() - t0
+    if errs:
+        _dump_flightrec("serving")
     s = METRICS.summary()
     images = sum(len(r.images) for r in results)
     return {
@@ -813,6 +815,25 @@ def run_serving(tiny):
         "wall_s": round(wall, 2),
         "device": dev.device_kind,
     }
+
+
+def _dump_flightrec(tag):
+    """Persist the obs flight recorder (failed/interrupted/slow requests'
+    span trees + correlated log lines) next to the bench outputs so a dead
+    chip-window run leaves a triage artifact behind."""
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import flightrec
+
+        if not len(flightrec.RECORDER):
+            return None
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"BENCH_flightrec_{tag}.json")
+        flightrec.RECORDER.dump_to_file(path)
+        print(f"bench: flight recorder dumped to {path} "
+              f"(inspect with tools/trace_report.py)", file=sys.stderr)
+        return path
+    except Exception:  # noqa: BLE001 — triage artifact must never mask rc
+        return None
 
 
 def main() -> None:
@@ -859,12 +880,16 @@ def main() -> None:
 
     enable_compilation_cache()
 
-    if args.serving:
-        print(json.dumps(run_serving(tiny)))
-    elif args.deepcache:
-        print(json.dumps(run_deepcache(tiny)))
-    else:
-        print(json.dumps(run_config(args.config, tiny)))
+    try:
+        if args.serving:
+            print(json.dumps(run_serving(tiny)))
+        elif args.deepcache:
+            print(json.dumps(run_deepcache(tiny)))
+        else:
+            print(json.dumps(run_config(args.config, tiny)))
+    except BaseException:
+        _dump_flightrec("error")
+        raise
 
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ RULES = {
     "RC001": "request/env-derived value in a static jit argument",
     "RC002": "traced function closes over a request/env-derived scalar",
     "EV001": "raw os.environ read outside runtime/config.py",
+    "OB001": "time.time() used for a duration on a serving/pipeline/obs path",
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",

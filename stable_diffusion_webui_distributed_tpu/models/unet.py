@@ -26,6 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.models.configs import UNetConfig
+from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+    channel_concat,
+)
 from stable_diffusion_webui_distributed_tpu.ops.quant import (
     conv as _conv,
     linear as _linear,
@@ -419,7 +422,11 @@ class UNet(nn.Module):
             ch = c.block_out_channels[level]
             depth = c.down_blocks[level]
             for i in range(c.layers_per_block + 1):
-                x = jnp.concatenate([x, skips.pop()], axis=-1)
+                # channel_concat, not jnp.concatenate: under tensor
+                # parallelism the channel dim is tp-sharded and a sharded
+                # -dim concatenate mis-partitions on multi-axis meshes
+                # (parallel/sharding.py:channel_concat)
+                x = channel_concat([x, skips.pop()])
                 x = ResBlock(ch, dtype=self.dtype,
                              quant_convs=self.quant_convs,
                              name=f"up_{level}_res_{i}")(x, temb)

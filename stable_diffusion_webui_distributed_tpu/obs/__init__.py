@@ -1,0 +1,24 @@
+"""Request-scoped observability: span trees, Prometheus exposition, and a
+failure flight recorder.
+
+The reference's only "profiler" is the benchmark/ETA wall-clock loop
+(SURVEY.md §5, worker.py:477-481); our StageStats/DispatchMetrics surfaces
+aggregate globally, so nothing can answer "where did THIS request's nine
+seconds go" or "what was the p99 queue wait under coalescing". This package
+adds the per-request layer:
+
+- :mod:`.spans` — a ``request_id`` contextvar minted at API ingress and
+  threaded through bucketer -> coalesce queue -> compile -> device dispatch
+  -> decode, recorded into a bounded lock-disciplined store with
+  Chrome-trace-event export (``/internal/trace.json``, Perfetto-loadable).
+- :mod:`.prometheus` — text exposition (``/internal/metrics``) of every
+  DispatchMetrics/StageStats scalar plus fixed-ladder latency histograms
+  (e2e, queue wait, device dispatch, decode) for real p50/p95/p99, and the
+  live ETA mean-percent-error gauge.
+- :mod:`.flightrec` — the last N failed/interrupted/slow requests' full
+  span trees plus their correlated log lines (``/internal/flightrec``;
+  ``bench.py`` dumps it on error).
+
+Everything is host-side ``time.perf_counter()`` — no device sync ever rides
+on the hot path — and spans are default-on (``SDTPU_OBS=0`` disables).
+"""

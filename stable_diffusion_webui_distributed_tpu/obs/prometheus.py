@@ -1,0 +1,312 @@
+"""Prometheus text exposition (format 0.0.4) — no client library needed.
+
+Four fixed-ladder latency histograms give real p50/p95/p99 where
+``StageStats`` only has rolling means:
+
+- ``sdtpu_request_e2e_seconds`` — full request latency (obs/spans.py
+  observes it when a request context closes);
+- ``sdtpu_queue_wait_seconds`` — coalesce-queue wait (dispatcher);
+- ``sdtpu_device_dispatch_seconds`` — denoise-chunk device time
+  (fed from ``StageStats.timer("denoise_chunk")`` via
+  :func:`observe_stage`);
+- ``sdtpu_decode_seconds`` — VAE decode dispatch + fetch.
+
+:func:`render` additionally exposes every ``DispatchMetrics`` and
+``StageStats`` scalar plus the live ETA mean-percent-error gauge
+(:data:`ETA_GAUGE`, fed by ``scheduler/eta.record_eta_error``), so
+``/internal/metrics`` is a strict superset of ``/internal/status``'s
+numbers in scrapeable form.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
+
+#: Fixed bucket ladder (seconds). Spans sub-ms host work up to the minutes
+#: an XLA compile can take; identical for every histogram so dashboards can
+#: aggregate across them.
+BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 25.0, 60.0, 120.0)
+
+
+def _fmt(v: Any) -> str:
+    """Prometheus sample value: ints bare, floats via repr, None -> NaN."""
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label(v: Any) -> str:
+    s = str(v)
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _bucket_label(b: float) -> str:
+    return _fmt(b) if b != int(b) else f"{b:.1f}"
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram (cumulative ``le`` exposition)."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Iterable[float] = BUCKETS) -> None:
+        self.name = name
+        self.help = help_text
+        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts: List[int] = [0] * (len(self.bounds) + 1)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """(per-bucket counts incl. +Inf overflow, sum, count)."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def quantile(self, q: float) -> float:
+        """Bucket-upper-bound estimate of the q-quantile (0 when empty)."""
+        counts, _total, n = self.snapshot()
+        if n <= 0:
+            return 0.0
+        target = q * n
+        running = 0
+        for i, c in enumerate(counts):
+            running += c
+            if running >= target:
+                return self.bounds[i] if i < len(self.bounds) \
+                    else self.bounds[-1]
+        return self.bounds[-1]
+
+    def render(self) -> List[str]:
+        counts, total, n = self.snapshot()
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        running = 0
+        for bound, c in zip(self.bounds, counts):
+            running += c
+            lines.append(f'{self.name}_bucket{{le="{_bucket_label(bound)}"}}'
+                         f" {running}")
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
+        lines.append(f"{self.name}_sum {_fmt(total)}")
+        lines.append(f"{self.name}_count {n}")
+        return lines
+
+
+HISTOGRAMS: Dict[str, Histogram] = {
+    "e2e": Histogram(
+        "sdtpu_request_e2e_seconds",
+        "End-to-end request latency (span-root duration)."),
+    "queue_wait": Histogram(
+        "sdtpu_queue_wait_seconds",
+        "Time a request waited in the coalesce queue before its device "
+        "dispatch."),
+    "device_dispatch": Histogram(
+        "sdtpu_device_dispatch_seconds",
+        "Denoise-chunk device dispatch latency (host-observed)."),
+    "decode": Histogram(
+        "sdtpu_decode_seconds",
+        "VAE decode latency (dispatch + fetch halves observed "
+        "separately)."),
+}
+
+#: StageStats stage name -> histogram key (stages not listed only appear as
+#: ``sdtpu_stage_seconds`` gauges).
+STAGE_TO_HIST: Dict[str, str] = {
+    "denoise_chunk": "device_dispatch",
+    "vae_decode_dispatch": "decode",
+    "vae_decode_fetch": "decode",
+}
+
+
+def observe_hist(name: str, value: float) -> None:
+    h = HISTOGRAMS.get(name)
+    if h is not None:
+        h.observe(value)
+
+
+def observe_stage(stage: str, seconds: float) -> None:
+    key = STAGE_TO_HIST.get(stage)
+    if key is not None:
+        HISTOGRAMS[key].observe(seconds)
+
+
+def clear_histograms() -> None:
+    for h in HISTOGRAMS.values():
+        h.clear()
+
+
+class EtaGauge:
+    """Live predicted-vs-actual ETA calibration across every backend.
+
+    Mirrors the paper's per-worker MPE feedback (scheduler/eta.py,
+    reference worker.py:476-492) as one process-wide gauge: same window,
+    same |error| >= 500% rejection, fed by ``record_eta_error``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: window/rejection adopted from scheduler.eta at first record —
+        #: importing the scheduler package here (obs import time) would
+        #: drag worker/world in and risk an import cycle
+        self._errors: Optional[Deque[float]] = None  # guarded-by: _lock
+        self._samples = 0  # guarded-by: _lock
+        self._last_predicted: Optional[float] = None  # guarded-by: _lock
+        self._last_actual: Optional[float] = None  # guarded-by: _lock
+
+    def record(self, predicted: float, actual: float) -> None:
+        from stable_diffusion_webui_distributed_tpu.scheduler import (
+            eta as eta_mod,
+        )
+
+        if actual <= 0 or predicted <= 0:
+            return
+        error = (predicted - actual) / actual * 100.0
+        if abs(error) >= eta_mod.MPE_REJECT_ABS_PERCENT:
+            return
+        with self._lock:
+            if self._errors is None:
+                self._errors = deque(maxlen=eta_mod.MPE_WINDOW)
+            self._errors.append(error)
+            self._samples += 1
+            self._last_predicted = float(predicted)
+            self._last_actual = float(actual)
+
+    def mpe(self) -> float:
+        with self._lock:
+            if not self._errors:
+                return 0.0
+            return sum(self._errors) / len(self._errors)
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            mpe = (sum(self._errors) / len(self._errors)
+                   if self._errors else 0.0)
+            return {
+                "mpe_percent": mpe,
+                "samples": self._samples,
+                "last_predicted_s": self._last_predicted,
+                "last_actual_s": self._last_actual,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._errors = None
+            self._samples = 0
+            self._last_predicted = None
+            self._last_actual = None
+
+
+#: Process-wide ETA calibration gauge (scheduler/eta.py feeds it).
+ETA_GAUGE = EtaGauge()
+
+
+def _scalar(lines: List[str], name: str, mtype: str, help_text: str,
+            value: Any, labels: str = "") -> None:
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} {mtype}")
+    lines.append(f"{name}{labels} {_fmt(value)}")
+
+
+def render() -> str:
+    """The full /internal/metrics body (Prometheus text format 0.0.4)."""
+    # lazy imports: this module must stay importable without dragging the
+    # serving/runtime stacks in at obs import time (no cycles)
+    from stable_diffusion_webui_distributed_tpu.runtime.trace import STATS
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import (
+        METRICS,
+    )
+
+    lines: List[str] = []
+    for h in HISTOGRAMS.values():
+        lines.extend(h.render())
+
+    s = METRICS.summary()
+    _scalar(lines, "sdtpu_serving_requests_total", "counter",
+            "Requests accepted by the serving dispatcher.", s["requests"])
+    _scalar(lines, "sdtpu_serving_bucket_hits_total", "counter",
+            "Requests whose shape matched a bucket exactly.",
+            s["bucket_hits"])
+    _scalar(lines, "sdtpu_serving_bucket_misses_total", "counter",
+            "Requests padded up to a bucket.", s["bucket_misses"])
+    _scalar(lines, "sdtpu_serving_bucket_bypasses_total", "counter",
+            "Requests that bypassed bucketing (hires/img2img/no fit).",
+            s["bucket_bypasses"])
+    _scalar(lines, "sdtpu_serving_bucket_hit_rate", "gauge",
+            "bucket_hits / (bucket_hits + bucket_misses).",
+            s["bucket_hit_rate"])
+    _scalar(lines, "sdtpu_serving_dispatches_total", "counter",
+            "Device batches executed.", s["dispatches"])
+    _scalar(lines, "sdtpu_serving_coalesced_dispatches_total", "counter",
+            "Dispatches that merged >= 2 requests.",
+            s["coalesced_dispatches"])
+    _scalar(lines, "sdtpu_serving_coalesce_factor", "gauge",
+            "Mean requests per device dispatch.", s["coalesce_factor"])
+    _scalar(lines, "sdtpu_serving_avg_queue_wait_seconds", "gauge",
+            "Rolling mean coalesce-queue wait.", s["avg_queue_wait_s"])
+    _scalar(lines, "sdtpu_serving_avg_padding_ratio", "gauge",
+            "Mean bucket-px / requested-px over bucketed requests.",
+            s["avg_padding_ratio"])
+    _scalar(lines, "sdtpu_serving_unet_flops_total", "counter",
+            "UNet FLOPs dispatched (XLA cost_analysis pricing).",
+            s["unet_flops_total"])
+    _scalar(lines, "sdtpu_serving_unet_images_total", "counter",
+            "Images decoded to outputs.", s["unet_images"])
+    _scalar(lines, "sdtpu_serving_unet_flops_per_image", "gauge",
+            "Mean dispatched UNet FLOPs per output image.",
+            s["unet_flops_per_image"])
+
+    lines.append("# HELP sdtpu_stage_compiles_total XLA stage builds "
+                 "(one compile each) by stage kind.")
+    lines.append("# TYPE sdtpu_stage_compiles_total counter")
+    for kind in sorted(s["compiles"]):
+        lines.append(f'sdtpu_stage_compiles_total{{kind="{_label(kind)}"}} '
+                     f'{_fmt(s["compiles"][kind])}')
+    lines.append("# HELP sdtpu_stage_cache_hits_total Compiled-stage cache "
+                 "hits by stage kind.")
+    lines.append("# TYPE sdtpu_stage_cache_hits_total counter")
+    for kind in sorted(s["cache_hits"]):
+        lines.append(f'sdtpu_stage_cache_hits_total{{kind="{_label(kind)}"}}'
+                     f' {_fmt(s["cache_hits"][kind])}')
+
+    timings = STATS.summary()
+    lines.append("# HELP sdtpu_stage_seconds Rolling stage wall-clock "
+                 "stats (StageStats window).")
+    lines.append("# TYPE sdtpu_stage_seconds gauge")
+    lines.append("# HELP sdtpu_stage_samples Rolling StageStats sample "
+                 "count per stage.")
+    lines.append("# TYPE sdtpu_stage_samples gauge")
+    for stage in sorted(timings):
+        st = timings[stage]
+        for stat in ("mean", "p50", "last"):
+            lines.append(f'sdtpu_stage_seconds{{stage="{_label(stage)}",'
+                         f'stat="{stat}"}} {_fmt(st[stat])}')
+        lines.append(f'sdtpu_stage_samples{{stage="{_label(stage)}"}} '
+                     f'{_fmt(st["count"])}')
+
+    eta = ETA_GAUGE.summary()
+    _scalar(lines, "sdtpu_eta_mpe_percent", "gauge",
+            "Live ETA mean percent error (paper MPE window).",
+            eta["mpe_percent"])
+    _scalar(lines, "sdtpu_eta_samples_total", "counter",
+            "Accepted predicted-vs-actual ETA samples.", eta["samples"])
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,349 @@
+"""Per-request span trees behind a ``contextvars`` request context.
+
+One :class:`SpanTracer` (the module singleton :data:`TRACER`) holds every
+in-flight and recently-finished request trace. A request context is minted
+at API ingress (or lazily by the serving dispatcher for direct callers) via
+:func:`request`; any code on that thread — or on a thread entered through
+:func:`bind_current` — can then open child spans with :func:`span`, and
+``runtime/trace.py`` feeds every ``StageStats.timer`` block in as a leaf
+span automatically (:func:`stage_event`).
+
+Coalesced dispatches link leader and followers: the leader's device span is
+mirrored into each follower's trace with ``leader_request_id`` /
+``leader_span_id`` attrs (:func:`mirror_span`), so a follower's tree still
+shows where its wall-clock went even though another request drove the TPU.
+
+Timing is host-side ``time.perf_counter()`` only — recording a span never
+syncs the device. The store is bounded (``SDTPU_OBS_MAX_REQUESTS`` finished
+traces) and lock-disciplined: one lock, nothing external called while
+holding it. Export is Chrome trace-event JSON ("X" complete events with
+ph/ts/dur/pid/tid), loadable in Perfetto / ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+
+from stable_diffusion_webui_distributed_tpu.obs import flightrec, prometheus
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_flag, env_float, env_int,
+)
+
+#: Finished request traces retained for /internal/trace.json.
+DEFAULT_MAX_REQUESTS = 256
+#: e2e latency (seconds) above which a request is flight-recorded as a
+#: slow outlier; 0 disables slow capture.
+DEFAULT_SLOW_S = 30.0
+
+#: perf_counter base for trace-event timestamps (µs since process start of
+#: tracing, not wall clock — Perfetto only needs a shared monotonic base).
+_EPOCH = time.perf_counter()
+_PID = os.getpid()
+
+#: Process-wide span-id allocator. ``next()`` on itertools.count is atomic
+#: under the GIL, so ids are unique without touching the tracer lock.
+_IDS = itertools.count(1)
+
+#: (RequestTrace, parent span id) for the code currently executing, or None
+#: outside any request. Thread- and contextvars-scoped: HTTP handler
+#: threads each see only their own request.
+_CURRENT: "contextvars.ContextVar[Optional[Tuple[RequestTrace, int]]]" = \
+    contextvars.ContextVar("sdtpu_obs_request", default=None)
+
+
+class Span:
+    """One timed region. ``t0`` is perf_counter seconds, ``dur`` seconds."""
+
+    __slots__ = ("span_id", "parent_id", "name", "t0", "dur", "tid", "attrs")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 t0: float, dur: float, tid: int,
+                 attrs: Dict[str, Any]) -> None:
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = t0
+        self.dur = dur
+        self.tid = tid
+        self.attrs = attrs
+
+
+class RequestTrace:
+    """All spans of one request plus its terminal status."""
+
+    __slots__ = ("request_id", "name", "attrs", "t0", "dur", "status",
+                 "detail", "spans", "root_id")
+
+    def __init__(self, request_id: str, name: str,
+                 attrs: Dict[str, Any]) -> None:
+        self.request_id = request_id
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+        self.dur = 0.0
+        self.status = "active"  # active | ok | error | interrupted | slow
+        self.detail = ""
+        self.spans: List[Span] = []  # appended under TRACER's lock
+        self.root_id = next(_IDS)
+
+
+def _span_event(req: RequestTrace, sp: Span) -> Dict[str, Any]:
+    """One Chrome trace-event ("X" = complete event, timestamps in µs)."""
+    args: Dict[str, Any] = {"request_id": req.request_id,
+                            "span_id": sp.span_id}
+    if sp.parent_id is not None:
+        args["parent_id"] = sp.parent_id
+    for k, v in sp.attrs.items():
+        args.setdefault(str(k), v)
+    return {
+        "ph": "X",
+        "cat": "sdtpu",
+        "name": sp.name,
+        "pid": _PID,
+        "tid": sp.tid,
+        "ts": (sp.t0 - _EPOCH) * 1e6,
+        "dur": sp.dur * 1e6,
+        "args": args,
+    }
+
+
+class SpanTracer:
+    """Bounded, lock-disciplined store of request traces."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_requests: Optional[int] = None,
+                 slow_s: Optional[float] = None) -> None:
+        if enabled is None:
+            enabled = env_flag("SDTPU_OBS", True)
+        if max_requests is None:
+            max_requests = env_int("SDTPU_OBS_MAX_REQUESTS",
+                                   DEFAULT_MAX_REQUESTS)
+        if slow_s is None:
+            slow_s = env_float("SDTPU_OBS_SLOW_S", DEFAULT_SLOW_S)
+        #: set once at construction; tests flip it to measure overhead
+        self.enabled = bool(enabled)
+        self.slow_s = max(0.0, float(slow_s or 0.0))
+        self._lock = threading.Lock()
+        self._active: Dict[str, RequestTrace] = {}  # guarded-by: _lock
+        self._done: Deque[RequestTrace] = deque(
+            maxlen=max(1, int(max_requests or DEFAULT_MAX_REQUESTS)))  # guarded-by: _lock
+
+    # -- store ------------------------------------------------------------
+
+    def open(self, req: RequestTrace) -> None:
+        with self._lock:
+            self._active[req.request_id] = req
+
+    def close(self, req: RequestTrace) -> None:
+        with self._lock:
+            self._active.pop(req.request_id, None)
+            self._done.append(req)
+
+    def record(self, req: Optional[RequestTrace], sp: Span) -> None:
+        """Append a finished span to a trace (any thread)."""
+        if req is None or not self.enabled:
+            return
+        with self._lock:
+            req.spans.append(sp)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._done.clear()
+
+    # -- export -----------------------------------------------------------
+
+    def export_chrome(self) -> Dict[str, Any]:
+        """All retained traces as a Chrome trace-event JSON object."""
+        events: List[Dict[str, Any]] = []
+        with self._lock:
+            reqs = list(self._done) + list(self._active.values())
+            for req in reqs:
+                for sp in req.spans:
+                    events.append(_span_event(req, sp))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def events_for(self, req: RequestTrace) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [_span_event(req, sp) for sp in req.spans]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "active": len(self._active),
+                "retained": len(self._done),
+                "capacity": self._done.maxlen,
+                "slow_threshold_s": self.slow_s,
+            }
+
+    def finished(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._done)
+
+
+#: Process-wide tracer (mirrors trace.STATS / metrics.METRICS).
+TRACER = SpanTracer()
+
+
+# -- request / span context managers ----------------------------------------
+
+@contextlib.contextmanager
+def request(request_id: Optional[str] = None, name: str = "request",
+            **attrs: Any) -> Iterator[Optional[RequestTrace]]:
+    """Root context for one request. Mints/propagates the request id, opens
+    the root span, and on exit records e2e latency, feeds the e2e histogram
+    and hands failed/interrupted/slow traces to the flight recorder."""
+    tr = TRACER
+    if not tr.enabled:
+        yield None
+        return
+    rid = str(request_id or uuid.uuid4().hex)
+    req = RequestTrace(rid, name, dict(attrs))
+    tr.open(req)
+    token = _CURRENT.set((req, req.root_id))
+    error: Optional[str] = None
+    try:
+        yield req
+    except BaseException as e:  # noqa: BLE001 — recorded, then re-raised
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _CURRENT.reset(token)
+        _finish(tr, req, error)
+
+
+def _finish(tr: SpanTracer, req: RequestTrace, error: Optional[str]) -> None:
+    req.dur = time.perf_counter() - req.t0
+    if error is not None:
+        req.status, req.detail = "error", error
+    elif req.status == "interrupted":
+        pass  # marked mid-flight by cancel/interrupt
+    elif tr.slow_s > 0 and req.dur >= tr.slow_s:
+        req.status = "slow"
+        req.detail = f"e2e {req.dur:.3f}s >= {tr.slow_s:.3f}s threshold"
+    else:
+        req.status = "ok"
+    root = Span(req.root_id, None, req.name, req.t0, req.dur,
+                threading.get_ident(), dict(req.attrs, status=req.status))
+    tr.record(req, root)
+    tr.close(req)
+    prometheus.observe_hist("e2e", req.dur)
+    if req.status != "ok":
+        flightrec.RECORDER.record(
+            request_id=req.request_id, reason=req.status, detail=req.detail,
+            duration_s=req.dur, events=tr.events_for(req))
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[Optional[Span]]:
+    """Child span under the active request; cheap no-op outside one."""
+    tr = TRACER
+    ctx = _CURRENT.get()
+    if ctx is None or not tr.enabled:
+        yield None
+        return
+    req, parent = ctx
+    sp = Span(next(_IDS), parent, name, time.perf_counter(), 0.0,
+              threading.get_ident(), dict(attrs))
+    token = _CURRENT.set((req, sp.span_id))
+    try:
+        yield sp
+    finally:
+        _CURRENT.reset(token)
+        sp.dur = time.perf_counter() - sp.t0
+        tr.record(req, sp)
+
+
+@contextlib.contextmanager
+def maybe_request(request_id: Optional[str] = None, name: str = "request",
+                  **attrs: Any) -> Iterator[Optional[RequestTrace]]:
+    """:func:`request` unless one is already active (the HTTP ingress minted
+    it); then just yield the active trace. Lets the dispatcher serve both
+    API traffic and direct callers without double-rooting."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        yield ctx[0]
+        return
+    with request(request_id, name, **attrs) as req:
+        yield req
+
+
+# -- cross-thread / cross-request recording ----------------------------------
+
+def current() -> Optional[RequestTrace]:
+    ctx = _CURRENT.get()
+    return None if ctx is None else ctx[0]
+
+
+def current_request_id() -> Optional[str]:
+    ctx = _CURRENT.get()
+    return None if ctx is None else ctx[0].request_id
+
+
+def add_span(req: Optional[RequestTrace], name: str, t0: float, dur: float,
+             attrs: Optional[Dict[str, Any]] = None,
+             parent_id: Optional[int] = None) -> Optional[Span]:
+    """Record an already-measured interval into ``req`` from any thread
+    (the coalesce leader records queue waits for its followers)."""
+    if req is None or not TRACER.enabled:
+        return None
+    sp = Span(next(_IDS), req.root_id if parent_id is None else parent_id,
+              name, t0, max(0.0, dur), threading.get_ident(),
+              dict(attrs or {}))
+    TRACER.record(req, sp)
+    return sp
+
+
+def mirror_span(req: Optional[RequestTrace], name: str, src: Optional[Span],
+                **attrs: Any) -> Optional[Span]:
+    """Copy ``src``'s interval into another request's trace — the
+    leader/follower link for coalesced dispatches."""
+    if req is None or src is None:
+        return None
+    return add_span(req, name, src.t0, src.dur, attrs=dict(attrs))
+
+
+def mark(req: Optional[RequestTrace], status: str, detail: str = "") -> None:
+    """Flag an in-flight request (e.g. "interrupted"); picked up when its
+    root context exits."""
+    if req is None:
+        return
+    req.status = status
+    if detail:
+        req.detail = detail
+
+
+def stage_event(stage: str, seconds: float,
+                t0: Optional[float] = None) -> None:
+    """Leaf span + stage histogram for one ``StageStats.timer`` block
+    (called by runtime/trace.py on every timed stage)."""
+    prometheus.observe_stage(stage, seconds)
+    tr = TRACER
+    ctx = _CURRENT.get()
+    if ctx is None or not tr.enabled:
+        return
+    req, parent = ctx
+    if t0 is None:
+        t0 = time.perf_counter() - seconds
+    tr.record(req, Span(next(_IDS), parent, stage, t0, seconds,
+                        threading.get_ident(), {}))
+
+
+def bind_current(fn):
+    """Wrap ``fn`` so it runs under the caller's request context in another
+    thread (contextvars don't cross thread starts on their own)."""
+    ctx = contextvars.copy_context()
+
+    def run(*args: Any, **kwargs: Any) -> Any:
+        return ctx.run(fn, *args, **kwargs)
+
+    return run

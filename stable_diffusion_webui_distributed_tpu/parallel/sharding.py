@@ -26,6 +26,32 @@ _ROW_ENDINGS = ("out_proj", "fc2", "ff_out", "time_fc2", "add_fc2",
                 "proj_out")
 
 
+def keystr_path(keypath, separator: str = "/") -> str:
+    """Version-compat ``jax.tree_util.keystr`` in "simple" form.
+
+    ``keystr(..., simple=True, separator=...)`` only exists from jax 0.4.35
+    behind a changing signature (0.4.37 still raises TypeError on the
+    kwargs). Every keystr call site in the repo goes through this shim:
+    try the modern call, fall back to joining the key entries by hand —
+    DictKey('a')/GetAttrKey('a') -> "a", SequenceKey(0) -> "0" — which is
+    exactly what ``simple=True`` produces."""
+    try:
+        return jax.tree_util.keystr(keypath, simple=True,
+                                    separator=separator)
+    except TypeError:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):       # DictKey / FlattenedIndexKey
+                parts.append(str(k.key))
+            elif hasattr(k, "name"):    # GetAttrKey
+                parts.append(str(k.name))
+            elif hasattr(k, "idx"):     # SequenceKey
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return separator.join(parts)
+
+
 def tp_spec_for(path: str, ndim: int):
     """PartitionSpec for one param, from its tree path (joined with '/')."""
     from jax.sharding import PartitionSpec as P
@@ -63,7 +89,7 @@ def shard_params(params, mesh, use_tp: bool = True):
     placed = []
     for keypath, leaf in leaves:
         if tp > 1 and use_tp and hasattr(leaf, "ndim"):
-            path = jax.tree_util.keystr(keypath, simple=True, separator="/")
+            path = keystr_path(keypath, separator="/")
             spec = tp_spec_for(path, leaf.ndim)
             # only shard dims that divide evenly; else replicate
             ok = True
@@ -75,6 +101,55 @@ def shard_params(params, mesh, use_tp: bool = True):
             sharding = NamedSharding(mesh, P())
         placed.append(jax.device_put(leaf, sharding))
     return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def batch_concat(parts):
+    """Concatenate equal-shaped blocks along axis 0 (the CFG [uncond; cond]
+    doubling and its conditioning rows) without ``jnp.concatenate``.
+
+    jax 0.4.x's SPMD partitioner mis-compiles a concatenate whose concat
+    dimension is sharded when the mesh carries a second axis the operands
+    do not use: each replica along that axis contributes a partial
+    concatenate that gets summed, scaling values by the axis size.
+    Minimal repro — place x with P('dp') on a ('dp','tp') mesh and
+    ``jnp.concatenate([x, x], axis=0)`` returns rows of 2*x. stack+reshape
+    expresses the identical layout through a reshape, which partitions
+    correctly on the same meshes (eager and jitted), so every batch-axis
+    concat reachable with a dp-sharded operand routes through here."""
+    import jax.numpy as jnp
+
+    parts = list(parts)
+    if len(parts) == 1:
+        return parts[0]
+    first = parts[0]
+    stacked = jnp.stack(parts, axis=0)
+    return stacked.reshape((len(parts) * first.shape[0],)
+                           + tuple(first.shape[1:]))
+
+
+def channel_concat(parts):
+    """Concatenate along the last (feature/channel) dimension without
+    ``jnp.concatenate`` — the same partitioner mis-lowering as
+    ``batch_concat`` hits here when the channel dim is tp-sharded (the
+    UNet decoder's skip concat, the SDXL dual-text-encoder context).
+    Parts may have different channel widths, so instead of stack+reshape
+    each part is zero-padded to the full output width at its own offset
+    and the padded blocks are summed; pad and add both partition
+    correctly on multi-axis meshes."""
+    import jax.numpy as jnp
+
+    parts = list(parts)
+    if len(parts) == 1:
+        return parts[0]
+    total = sum(p.shape[-1] for p in parts)
+    out = None
+    off = 0
+    for p in parts:
+        widths = [(0, 0)] * (p.ndim - 1) + [(off, total - off - p.shape[-1])]
+        padded = jnp.pad(p, widths)
+        out = padded if out is None else out + padded
+        off += p.shape[-1]
+    return out
 
 
 def place_batch(x, mesh):

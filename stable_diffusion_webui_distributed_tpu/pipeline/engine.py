@@ -38,6 +38,10 @@ from stable_diffusion_webui_distributed_tpu.models.unet import (
     make_added_cond,
 )
 from stable_diffusion_webui_distributed_tpu.models.vae import VAE
+from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+    batch_concat,
+    channel_concat,
+)
 from stable_diffusion_webui_distributed_tpu.models.tokenizer import load_tokenizer
 from stable_diffusion_webui_distributed_tpu.pipeline import stepcache
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
@@ -200,6 +204,10 @@ class Engine:
             METRICS,
         )
 
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
         with self._cache_lock:
             fn = self._cache.get(key)
             if fn is None:
@@ -208,7 +216,9 @@ class Engine:
                 # serving layer asserts on this counter (compile count,
                 # bucket hit rate) instead of wall-clock
                 METRICS.record_compile(key[0])
-                fn = build()
+                with obs_spans.span("compile", kind=str(key[0]),
+                                    key=str(key)):
+                    fn = build()
                 self._cache[key] = fn
             else:
                 METRICS.record_cache_hit(key[0])
@@ -250,10 +260,12 @@ class Engine:
                         {"params": te2_params}, ids, skip=skip_arg,
                         inject_values=inj_g, inject_mask=inj_mask,
                     )
-                    ctx = jnp.concatenate(
-                        [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)],
-                        axis=-1,
-                    )
+                    # channel_concat: both encoder outputs can be
+                    # tp-sharded along features under a mesh, and a
+                    # sharded-dim concatenate mis-partitions
+                    # (parallel/sharding.py:channel_concat)
+                    ctx = channel_concat(
+                        [ctx.astype(jnp.float32), ctx2.astype(jnp.float32)])
                     pooled = pooled2
                 ctx = ctx.astype(jnp.float32)
                 # emphasis: scale tokens, restore the chunk mean
@@ -290,18 +302,21 @@ class Engine:
             c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
             t = self.schedule.sigma_to_t(sigma)
             xin = (x * c_in).astype(x.dtype)
-            both = jnp.concatenate([xin, xin], axis=0)
+            # batch_concat, not jnp.concatenate: x may arrive dp-sharded
+            # and the partitioner mis-lowers a batch-axis concatenate on
+            # multi-axis meshes (parallel/sharding.py:batch_concat)
+            both = batch_concat([xin, xin])
             tb = jnp.full((2 * B,), t, jnp.float32)
-            ctx = jnp.concatenate([
+            ctx = batch_concat([
                 jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
                 jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
-            ], axis=0)
+            ])
             added = None
             if added_u is not None:
-                added = jnp.concatenate([
+                added = batch_concat([
                     jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
                     jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
-                ], axis=0)
+                ])
 
             residuals = None
             frac = (step.astype(jnp.float32) + 0.5) / total_steps
@@ -310,7 +325,7 @@ class Engine:
                     (frac >= g_start) & (frac <= g_end), weight, 0.0
                 ).astype(jnp.float32)
                 hint_b = jnp.broadcast_to(hint, (B,) + hint.shape[1:])
-                hint2 = jnp.concatenate([hint_b, hint_b], axis=0)
+                hint2 = batch_concat([hint_b, hint_b])
                 rs = self.controlnet_module.apply(
                     {"params": cn_params}, both, tb, ctx, hint2, added)
                 rs = tuple(r.astype(jnp.float32) * gate for r in rs)
@@ -322,9 +337,9 @@ class Engine:
                 # inpainting-specialized model (ldm hybrid conditioning):
                 # [latent, mask, masked-image latent] per CFG branch.
                 # ControlNet above still sees the bare 4-channel input.
-                cond2 = jnp.concatenate(
-                    [inpaint_cond, inpaint_cond], axis=0).astype(both.dtype)
-                unet_in = jnp.concatenate([both, cond2], axis=-1)
+                cond2 = batch_concat(
+                    [inpaint_cond, inpaint_cond]).astype(both.dtype)
+                unet_in = channel_concat([both, cond2])
             out = self.unet.apply(unet_params, unet_in, tb, ctx, added,
                                   control_residuals=residuals)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
@@ -433,23 +448,25 @@ class Engine:
                     self.schedule.sigma_to_t(sigma)
 
             def full_inputs(xin, t):
-                both = jnp.concatenate([xin, xin], axis=0)
+                # batch_concat: the carry latent is dp-sharded under a
+                # mesh and a batch-axis jnp.concatenate mis-partitions
+                # there (parallel/sharding.py:batch_concat)
+                both = batch_concat([xin, xin])
                 tb = jnp.full((2 * B,), t, jnp.float32)
-                ctx = jnp.concatenate([
+                ctx = batch_concat([
                     jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
                     jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
-                ], axis=0)
+                ])
                 added = None
                 if added_u is not None:
-                    added = jnp.concatenate([
+                    added = batch_concat([
                         jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
                         jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
-                    ], axis=0)
+                    ])
                 if inpaint:
-                    cond2 = jnp.concatenate(
-                        [inpaint_cond, inpaint_cond],
-                        axis=0).astype(both.dtype)
-                    both = jnp.concatenate([both, cond2], axis=-1)
+                    cond2 = batch_concat(
+                        [inpaint_cond, inpaint_cond]).astype(both.dtype)
+                    both = channel_concat([both, cond2])
                 return both, tb, ctx, added
 
             def cond_inputs(xin, t):
@@ -462,8 +479,8 @@ class Engine:
                         added_c, (B,) + added_c.shape[1:])
                 xi = xin
                 if inpaint:
-                    xi = jnp.concatenate(
-                        [xin, inpaint_cond.astype(xin.dtype)], axis=-1)
+                    xi = channel_concat(
+                        [xin, inpaint_cond.astype(xin.dtype)])
                 return xi, tb, ctx, added
 
             def step(state, i):
@@ -483,7 +500,7 @@ class Engine:
                         xi, tb, ctx, added = cond_inputs(xin, t)
                         d = self.unet.apply(params, xi, tb, ctx, added,
                                             cache_mode="deep")
-                        return jnp.concatenate([d, d], axis=0)
+                        return batch_concat([d, d])
 
                     return jax.lax.cond(i >= cfg_stop, deep_trunc,
                                         deep_full, None).astype(cache.dtype)
@@ -1095,9 +1112,16 @@ class Engine:
             payload.context_chunks = self.request_context_chunks(payload)
         self._apply_prompt_loras(payload)
         count = payload.total_images if count is None else count
-        if payload.init_images:
-            return self._run_img2img(payload, start_index, count, job)
-        return self._run_txt2img(payload, start_index, count, job)
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        with obs_spans.span("generate_range", job=job,
+                            start=int(start_index), count=int(count),
+                            size=f"{payload.width}x{payload.height}"):
+            if payload.init_images:
+                return self._run_img2img(payload, start_index, count, job)
+            return self._run_txt2img(payload, start_index, count, job)
 
     def txt2img(self, payload: GenerationPayload) -> GenerationResult:
         # top-level request: reset the interrupt latch and expand native
@@ -1214,6 +1238,25 @@ class Engine:
                        width, height, start_step, steps, job,
                        mask_lat, init_lat, controls=(), end_step=None,
                        inpaint_cond=None, sync=True):
+        """Obs-span wrapper around the chunk loop: one ``denoise_range``
+        span (host-side perf_counter, no extra device sync) grouping the
+        per-chunk ``denoise_chunk`` leaf spans StageStats feeds in."""
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        with obs_spans.span("denoise_range", sampler=payload.sampler_name,
+                            steps=int(steps), start_step=int(start_step),
+                            batch=int(x.shape[0]), size=f"{width}x{height}"):
+            return self._denoise_range_timed(
+                payload, x, image_keys, conds, pooleds, width, height,
+                start_step, steps, job, mask_lat, init_lat, controls,
+                end_step, inpaint_cond, sync)
+
+    def _denoise_range_timed(self, payload, x, image_keys, conds, pooleds,
+                             width, height, start_step, steps, job,
+                             mask_lat, init_lat, controls=(), end_step=None,
+                             inpaint_cond=None, sync=True):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs

@@ -59,6 +59,20 @@ def _logger():
 #
 # Defaults keep both levers off: generation stays byte-identical to the
 # plain executable unless a deployment opts into the FLOP/quality trade.
+#
+# Observability knobs (obs/ package; README "Observability"):
+#
+# - ``SDTPU_OBS`` (flag, default on): per-request span tracing. Spans are
+#   host-side perf_counter intervals — never a device sync — so they stay
+#   on by default; ``0`` turns :func:`obs.spans.span` into a no-op.
+# - ``SDTPU_OBS_MAX_REQUESTS`` (int, default 256): finished request
+#   traces retained for ``/internal/trace.json`` (bounded store; oldest
+#   evicted first).
+# - ``SDTPU_OBS_FLIGHTREC`` (int, default 16): failed/interrupted/slow
+#   request entries the flight recorder keeps (``/internal/flightrec``).
+# - ``SDTPU_OBS_SLOW_S`` (float seconds, default 30): e2e latency above
+#   which a request is flight-recorded as a slow outlier; ``0`` disables
+#   slow capture (errors and interrupts are always recorded).
 
 
 def read_env(name: str, default: str = "") -> str:

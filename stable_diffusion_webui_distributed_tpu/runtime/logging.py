@@ -20,9 +20,94 @@ from typing import Deque, List
 LOGGER_NAME = "distributed"
 #: Number of messages the GUI ring buffer retains (reference: shared.py:44 keeps 16).
 RING_CAPACITY = 16
+#: Per-request correlation index bounds (obs flight recorder): how many
+#: recent request ids keep log lines, and how many lines each keeps.
+REQUEST_INDEX_CAPACITY = 64
+REQUEST_LINE_CAPACITY = 64
 
 _lock = threading.Lock()
 _configured = False
+
+
+class RequestLogIndex:
+    """Log lines grouped by obs request id.
+
+    The flight recorder (obs/flightrec.py) attaches a dead request's own
+    log lines to its span tree; this index is how those lines are found
+    after the fact. Bounded two ways: the most recent
+    ``REQUEST_INDEX_CAPACITY`` request ids, ``REQUEST_LINE_CAPACITY``
+    lines each.
+    """
+
+    def __init__(self, max_requests: int = REQUEST_INDEX_CAPACITY,
+                 max_lines: int = REQUEST_LINE_CAPACITY):
+        self._max_requests = max_requests
+        self._max_lines = max_lines
+        self._lock = threading.Lock()
+        self._lines: "collections.OrderedDict[str, Deque[str]]" = \
+            collections.OrderedDict()  # guarded-by: _lock
+
+    def note(self, request_id: str, line: str) -> None:
+        with self._lock:
+            buf = self._lines.get(request_id)
+            if buf is None:
+                buf = collections.deque(maxlen=self._max_lines)
+                self._lines[request_id] = buf
+                while len(self._lines) > self._max_requests:
+                    self._lines.popitem(last=False)
+            else:
+                self._lines.move_to_end(request_id)
+            buf.append(line)
+
+    def lines(self, request_id: str) -> List[str]:
+        with self._lock:
+            return list(self._lines.get(request_id, ()))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lines.clear()
+
+
+_request_index = RequestLogIndex()
+
+
+def lines_for_request(request_id: str) -> List[str]:
+    """Log lines emitted while ``request_id``'s obs context was active."""
+    return _request_index.lines(str(request_id))
+
+
+class RequestIdFilter(logging.Filter):
+    """Stamps every record with the active obs request id and mirrors the
+    line into the per-request correlation index.
+
+    Installed as a logger-level filter so every handler (console, file,
+    ring) sees ``record.request_id``; '' outside any request context or
+    when the obs layer is unavailable.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        rid = ""
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import spans
+
+            rid = spans.current_request_id() or ""
+        except Exception:  # noqa: BLE001 — logging must never fail
+            rid = ""
+        record.request_id = rid
+        if rid:
+            import time as _time
+
+            stamp = _time.strftime("%H:%M:%S",
+                                   _time.localtime(record.created))
+            try:
+                msg = record.getMessage()
+            except Exception:  # noqa: BLE001
+                msg = str(record.msg)
+            _request_index.note(rid, f"{stamp} {record.levelname} {msg}")
+        return True
+
+
+_request_filter = RequestIdFilter()
 
 
 class RingBufferHandler(logging.Handler):
@@ -83,6 +168,8 @@ def configure(
 
         logger.setLevel(logging.DEBUG if debug else logging.INFO)
         logger.propagate = False
+        # request-id stamping + per-request correlation for the obs layer
+        logger.addFilter(_request_filter)
 
         fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s", "%H:%M:%S")
 

@@ -36,7 +36,9 @@ class StageStats:
         try:
             yield
         finally:
-            self.record(stage, time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            self.record(stage, dur)
+            _obs_stage(stage, dur, t0)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """{stage: {count, mean, p50, last}} over the rolling window."""
@@ -57,6 +59,21 @@ class StageStats:
     def clear(self) -> None:
         with self._lock:
             self._samples.clear()
+
+
+def _obs_stage(stage: str, seconds: float, t0: float) -> None:
+    """Mirror one timed stage into the obs layer: a leaf span on the active
+    request trace plus the matching latency histogram. Lazy import (trace
+    is imported everywhere; obs pulls serving/metrics) and exception-proof:
+    observability must never take a generation down."""
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        obs_spans.stage_event(stage, seconds, t0)
+    except Exception:  # noqa: BLE001 — pragma: no cover
+        pass
 
 
 #: Process-wide stats the engine and server share.

@@ -1,7 +1,9 @@
 """ETA prediction: benchmark-calibrated completion-time estimates.
 
-Pure functions over a small calibration record — no I/O, no globals — so the
-whole model is unit-testable (the reference buries this in its Worker class,
+Pure functions over a small calibration record — no I/O, and the only
+global touch is the fire-and-forget MPE gauge mirror in
+:func:`_note_obs` — so the whole model is unit-testable (the reference
+buries this in its Worker class,
 /root/reference/scripts/spartan/worker.py:176-286; formula reproduced here):
 
     eta = (n / ipm) * 60                      # base from benchmark ipm
@@ -153,9 +155,23 @@ def record_eta_error(cal: EtaCalibration, predicted: float,
     """
     if actual <= 0 or predicted <= 0:
         return
+    _note_obs(predicted, actual)
     error = (predicted - actual) / actual * 100.0
     if abs(error) >= MPE_REJECT_ABS_PERCENT:
         return
     cal.eta_percent_error.append(error)
     while len(cal.eta_percent_error) > MPE_WINDOW:
         cal.eta_percent_error.pop(0)
+
+
+def _note_obs(predicted: float, actual: float) -> None:
+    """Mirror the sample into the live process-wide MPE gauge exposed at
+    ``/internal/metrics`` (obs/prometheus.py). The calibration math above
+    stays pure — this is a fire-and-forget side channel that must never
+    fail a request (and keeps this module importable without obs)."""
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import prometheus
+
+        prometheus.ETA_GAUGE.record(predicted, actual)
+    except Exception:  # noqa: BLE001 — pragma: no cover
+        pass

@@ -211,10 +211,17 @@ class WorkerNode:
                 predicted = self.eta(payload, batch_size=count)
             except ValueError:
                 predicted = None
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
         started = time.monotonic()
         stop_watch = self._start_interrupt_watchdog()
         try:
-            result = self.backend.generate(payload, start_index, count)
+            with obs_spans.span("worker.generate", worker=self.label,
+                                start=int(start_index), count=int(count),
+                                predicted_s=predicted) as wsp:
+                result = self.backend.generate(payload, start_index, count)
         except Exception as e:  # noqa: BLE001 — any backend failure demotes
             log.error("worker '%s' failed request: %s", self.label, e)
             self.set_state(State.UNAVAILABLE)
@@ -224,6 +231,10 @@ class WorkerNode:
                 stop_watch.set()
         elapsed = time.monotonic() - started
         self.response_time = elapsed
+        if wsp is not None:
+            # predicted-vs-actual on the span itself: one request's ETA
+            # calibration quality is readable straight off its trace
+            wsp.attrs["actual_s"] = elapsed
         if predicted is not None:
             eta_mod.record_eta_error(self.cal, predicted, elapsed)
         self.set_state(State.IDLE)

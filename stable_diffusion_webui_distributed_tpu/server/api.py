@@ -16,6 +16,7 @@ import os
 import signal
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -27,6 +28,13 @@ from stable_diffusion_webui_distributed_tpu.runtime import config as config_mod
 from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
 from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 from stable_diffusion_webui_distributed_tpu.samplers.kdiffusion import SAMPLERS
+
+
+class TextResponse(str):
+    """A handler return value sent as plain text instead of JSON/HTML
+    (Prometheus exposition needs ``text/plain; version=0.0.4``)."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class ApiServer:
@@ -158,21 +166,39 @@ class ApiServer:
         except ValueError as e:
             raise ApiError(422, str(e))
 
+    def _mint_request(self, payload: GenerationPayload, route: str):
+        """Root obs span context for one API generation request.
+
+        The request id comes from the client (``request_id`` in the
+        payload — same field ``/internal/cancel`` addresses) or is minted
+        here; either way it is pinned back onto the payload so the
+        dispatcher, flight recorder and log correlation all agree on it."""
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        rid = str(getattr(payload, "request_id", "") or uuid.uuid4().hex)
+        payload.request_id = rid
+        return obs_spans.request(rid, name=route.rsplit("/", 1)[-1],
+                                 route=route)
+
     def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
         from stable_diffusion_webui_distributed_tpu.pipeline.xyz import is_xyz
 
         payload = GenerationPayload(**body)
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
-        if self.dispatcher is not None and not is_xyz(payload):
-            # continuous-batching path: the dispatcher owns serialization
-            # (its exec lock) so concurrent compatible requests can merge
-            # during the coalesce window instead of queuing on _busy
-            result = self.dispatcher.submit(payload, job="txt2img")
+        with self._mint_request(payload, "/sdapi/v1/txt2img"):
+            if self.dispatcher is not None and not is_xyz(payload):
+                # continuous-batching path: the dispatcher owns
+                # serialization (its exec lock) so concurrent compatible
+                # requests can merge during the coalesce window instead of
+                # queuing on _busy
+                result = self.dispatcher.submit(payload, job="txt2img")
+                return self._generation_response(result)
+            with self._busy:
+                result = self._run_scripted(payload)
             return self._generation_response(result)
-        with self._busy:
-            result = self._run_scripted(payload)
-        return self._generation_response(result)
 
     def handle_img2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
         payload = GenerationPayload(**body)
@@ -180,12 +206,13 @@ class ApiServer:
             raise ApiError(422, "img2img requires init_images")
         self._apply_styles(payload)
         payload = self._expand_scripts(payload)
-        if self.dispatcher is not None:
-            result = self.dispatcher.submit(payload, job="img2img")
+        with self._mint_request(payload, "/sdapi/v1/img2img"):
+            if self.dispatcher is not None:
+                result = self.dispatcher.submit(payload, job="img2img")
+                return self._generation_response(result)
+            with self._busy:
+                result = self._run_scripted(payload)
             return self._generation_response(result)
-        with self._busy:
-            result = self._run_scripted(payload)
-        return self._generation_response(result)
 
     def _run_scripted(self, payload: GenerationPayload) -> GenerationResult:
         """Dispatch through master-side multi-generation scripts (x/y/z
@@ -429,11 +456,18 @@ class ApiServer:
                 f"{w}x{h}" for w, h in self.dispatcher.bucketer.shapes]
             serving["batch_ladder"] = list(self.dispatcher.bucketer.batches)
             serving["eta_overhead"] = self.dispatcher.eta_overhead()
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            flightrec, spans as obs_spans,
+        )
+
+        obs = obs_spans.TRACER.summary()
+        obs["flightrec_entries"] = len(flightrec.RECORDER)
         return {
             "model": self.options.get("sd_model_checkpoint", ""),
             "workers": workers,
             "settings": settings,
             "serving": serving,
+            "obs": obs,
             "progress": {
                 "job": p.job,
                 "sampling_step": p.sampling_step,
@@ -444,6 +478,30 @@ class ApiServer:
             "timings": trace.STATS.summary(),
             "logs": get_ring_buffer().dump(),
         }
+
+    def handle_trace_json(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON of every retained request trace — save
+        the body and load it in Perfetto / chrome://tracing (PERF.md)."""
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        return obs_spans.TRACER.export_chrome()
+
+    def handle_metrics(self) -> "TextResponse":
+        """Prometheus text exposition: latency histograms (e2e / queue
+        wait / device dispatch / decode), every DispatchMetrics and
+        StageStats scalar, and the live ETA MPE gauge."""
+        from stable_diffusion_webui_distributed_tpu.obs import prometheus
+
+        return TextResponse(prometheus.render())
+
+    def handle_flightrec(self) -> Dict[str, Any]:
+        """The failure flight recorder: last N failed/interrupted/slow
+        requests' span trees + correlated log lines."""
+        from stable_diffusion_webui_distributed_tpu.obs import flightrec
+
+        return flightrec.RECORDER.dump()
 
     def handle_profile(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Start/stop a jax.profiler capture (runtime/trace.py). The client
@@ -689,6 +747,9 @@ class ApiServer:
             # _dispatch rstrips trailing slashes, so "/" arrives as ""
             ("GET", ""): self.handle_panel,
             ("GET", "/internal/status"): self.handle_internal_status,
+            ("GET", "/internal/trace.json"): self.handle_trace_json,
+            ("GET", "/internal/metrics"): self.handle_metrics,
+            ("GET", "/internal/flightrec"): self.handle_flightrec,
             ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
             ("POST", "/internal/restart-all"): self.handle_restart_all,
@@ -753,7 +814,9 @@ class ApiServer:
                             else fn()
                     else:
                         result = fn()
-                    if isinstance(result, str):
+                    if isinstance(result, TextResponse):
+                        self._send_text(200, result)
+                    elif isinstance(result, str):
                         self._send_html(200, result)
                     else:
                         self._send(200, result if result is not None else {})
@@ -775,6 +838,14 @@ class ApiServer:
                 data = text.encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_text(self, status: int, text: "TextResponse"):
+                data = str(text).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", text.content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

@@ -149,15 +149,25 @@ class ShapeBucketer:
         the caller keeps the original payload for user-visible metadata.
         ``bucketed`` is False on an exact shape hit (copy still returned
         so the group_size snap applies uniformly)."""
-        run = payload.model_copy()
-        bucket = self.bucket_shape(payload.width, payload.height)
-        bucketed = False
-        if bucket is not None:
-            run.width, run.height = bucket
-            bucketed = bucket != (payload.width, payload.height)
-        group = max(1, run.group_size or run.batch_size)
-        run.group_size = self.bucket_batch(group)
-        return run, bucketed
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        with obs_spans.span("bucket", width=payload.width,
+                            height=payload.height) as sp:
+            run = payload.model_copy()
+            bucket = self.bucket_shape(payload.width, payload.height)
+            bucketed = False
+            if bucket is not None:
+                run.width, run.height = bucket
+                bucketed = bucket != (payload.width, payload.height)
+            group = max(1, run.group_size or run.batch_size)
+            run.group_size = self.bucket_batch(group)
+            if sp is not None:
+                sp.attrs.update(bucket=f"{run.width}x{run.height}",
+                                bucketed=bucketed,
+                                group_size=run.group_size)
+            return run, bucketed
 
     @staticmethod
     def crop(img: np.ndarray, width: int, height: int) -> np.ndarray:

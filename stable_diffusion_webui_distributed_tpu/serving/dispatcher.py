@@ -45,6 +45,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from stable_diffusion_webui_distributed_tpu.obs import (
+    prometheus as obs_prom,
+)
+from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
 from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
     ShapeBucketer,
 )
@@ -77,10 +81,14 @@ class Ticket:
         self.bucketed = bucketed
         self.request_id = request_id
         self.enqueued = time.monotonic()
+        self.enqueued_perf = time.perf_counter()
         self.done = threading.Event()
         self.cancelled = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        #: submitting thread's obs trace; the coalesce leader records
+        #: queue-wait and mirrored device spans into it cross-thread
+        self.obs_req = obs_spans.current()
 
 
 class _Group:
@@ -127,32 +135,35 @@ class ServingDispatcher:
         payload.seed = fix_seed(payload.seed)
         payload.subseed = fix_seed(payload.subseed)
 
-        bypass = bool(payload.init_images or payload.enable_hr)
-        if bypass:
-            run, bucketed = payload.model_copy(), False
-            METRICS.record_request(False, bypassed=True)
-        else:
-            run, bucketed = self.bucketer.bucket_payload(payload)
-            METRICS.record_request(
-                bucketed,
-                padding_ratio=self.bucketer.padding_ratio(
-                    payload.width, payload.height))
-
         rid = str(getattr(payload, "request_id", "") or uuid.uuid4().hex)
-        ticket = Ticket(payload, run, job, bucketed, rid)
-        with self._lock:
-            self._tickets[rid] = ticket
-        try:
-            if self._coalescable(run):
-                self._run_grouped(ticket)
+        # root the obs trace here for direct callers; HTTP ingress already
+        # minted one for API traffic (maybe_request joins it)
+        with obs_spans.maybe_request(rid, name=f"serve.{job}"):
+            bypass = bool(payload.init_images or payload.enable_hr)
+            if bypass:
+                run, bucketed = payload.model_copy(), False
+                METRICS.record_request(False, bypassed=True)
             else:
-                self._run_solo(ticket)
-            if ticket.error is not None:
-                raise ticket.error
-            return ticket.result
-        finally:
+                run, bucketed = self.bucketer.bucket_payload(payload)
+                METRICS.record_request(
+                    bucketed,
+                    padding_ratio=self.bucketer.padding_ratio(
+                        payload.width, payload.height))
+
+            ticket = Ticket(payload, run, job, bucketed, rid)
             with self._lock:
-                self._tickets.pop(rid, None)
+                self._tickets[rid] = ticket
+            try:
+                if self._coalescable(run):
+                    self._run_grouped(ticket)
+                else:
+                    self._run_solo(ticket)
+                if ticket.error is not None:
+                    raise ticket.error
+                return ticket.result
+            finally:
+                with self._lock:
+                    self._tickets.pop(rid, None)
 
     def cancel(self, request_id: str) -> bool:
         """Cancel ONE queued/running request; its images are dropped at
@@ -162,6 +173,7 @@ class ServingDispatcher:
         if t is None:
             return False
         t.cancelled.set()
+        obs_spans.mark(t.obs_req, "interrupted", "cancelled by client")
         return True
 
     def eta_overhead(self, payload=None) -> Dict[str, float]:
@@ -237,30 +249,56 @@ class ServingDispatcher:
                 if self._groups.get(key) is g:
                     self._groups.pop(key)
             start = time.monotonic()
+            start_perf = time.perf_counter()
+            leader_req = obs_spans.current()
             for t in g.tickets:
-                METRICS.record_queue_wait(start - t.enqueued)
+                wait = start - t.enqueued
+                METRICS.record_queue_wait(wait)
+                obs_prom.observe_hist("queue_wait", wait)
+                obs_spans.add_span(t.obs_req, "queue_wait", t.enqueued_perf,
+                                   start_perf - t.enqueued_perf)
+            dsp = None
             try:
-                self._execute_group(g)
+                with obs_spans.span("dispatch.device",
+                                    requests=len(g.tickets)) as dsp:
+                    self._execute_group(g)
             except BaseException as e:  # noqa: BLE001 — delivered per ticket
                 for t in g.tickets:
                     if t.error is None and t.result is None:
                         t.error = e
             finally:
+                # leader/follower link: mirror the leader's device span
+                # into every follower's trace so a follower's tree shows
+                # where its wall-clock went
+                if dsp is not None and leader_req is not None:
+                    for t in g.tickets:
+                        if t.obs_req is not None \
+                                and t.obs_req is not leader_req:
+                            obs_spans.mirror_span(
+                                t.obs_req, "coalesced.dispatch", dsp,
+                                leader_request_id=leader_req.request_id,
+                                leader_span_id=dsp.span_id)
                 for t in g.tickets:
                     t.done.set()
 
     def _run_solo(self, ticket: Ticket) -> None:
         with self._exec_lock:
             start = time.monotonic()
-            METRICS.record_queue_wait(start - ticket.enqueued)
+            wait = start - ticket.enqueued
+            METRICS.record_queue_wait(wait)
+            obs_prom.observe_hist("queue_wait", wait)
+            obs_spans.add_span(ticket.obs_req, "queue_wait",
+                               ticket.enqueued_perf,
+                               time.perf_counter() - ticket.enqueued_perf)
             METRICS.record_dispatch(1)
             try:
                 self.engine.state.begin_request()
                 if ticket.cancelled.is_set():
                     ticket.result = self._empty_result(ticket)
                     return
-                result = self.engine.generate_range(
-                    ticket.run, 0, None, ticket.job)
+                with obs_spans.span("dispatch.device", requests=1):
+                    result = self.engine.generate_range(
+                        ticket.run, 0, None, ticket.job)
                 if ticket.bucketed:
                     result = self._restore_solo(result, ticket)
                 ticket.result = result
@@ -352,20 +390,22 @@ class ServingDispatcher:
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)
 
-        off = 0
-        for t, n_p in zip(live, counts):
-            rows = imgs[off:off + n_p]
-            off += n_p
-            if t.cancelled.is_set():
-                t.result = self._empty_result(t)
-                continue
-            out = GenerationResult(parameters=t.payload.model_dump())
-            ow, oh = t.payload.width, t.payload.height
-            if t.bucketed:
-                rows = np.stack(
-                    [self.bucketer.crop(im, ow, oh) for im in rows])
-            engine._append_images(out, t.payload, rows, 0, n_p, ow, oh)
-            t.result = out
+        with obs_spans.span("merge.split", requests=len(live),
+                            images=b_raw):
+            off = 0
+            for t, n_p in zip(live, counts):
+                rows = imgs[off:off + n_p]
+                off += n_p
+                if t.cancelled.is_set():
+                    t.result = self._empty_result(t)
+                    continue
+                out = GenerationResult(parameters=t.payload.model_dump())
+                ow, oh = t.payload.width, t.payload.height
+                if t.bucketed:
+                    rows = np.stack(
+                        [self.bucketer.crop(im, ow, oh) for im in rows])
+                engine._append_images(out, t.payload, rows, 0, n_p, ow, oh)
+                t.result = out
         engine.state.finish()
 
     # -- result fix-up -----------------------------------------------------

@@ -1,0 +1,29 @@
+"""OB001 fixture: wall-clock durations where spans measure latency.
+
+Tests load this file twice: once under a spoofed
+``stable_diffusion_webui_distributed_tpu/serving/`` rel path (OB001 fires on
+the two wall-clock duration reads below) and once under its real
+``tests/lint_fixtures/`` path (out of scope -> zero findings).
+"""
+
+import time
+
+
+def bad_duration():
+    t0 = time.time()
+    work()
+    return time.time() - t0
+
+
+def good_duration():
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+
+
+def stamped_entry():
+    return {"recorded_at": time.time()}  # sdtpu-lint: wallclock
+
+
+def work():
+    return None

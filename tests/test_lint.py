@@ -69,7 +69,8 @@ class TestRepoGate:
 
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
-                     "EV001", "LK001", "LK002", "LK003", "AL001", "AL002"):
+                     "EV001", "OB001", "LK001", "LK002", "LK003",
+                     "AL001", "AL002"):
             assert rule in RULES and RULES[rule]
 
 
@@ -111,6 +112,24 @@ class TestFixtures:
         # variant in the same fixture must stay clean
         found = _rule_lines(_fixture_findings("cadence_bad.py"))
         assert found == {("RC001", 24)}
+
+    def test_timing_family(self):
+        # OB001 is path-scoped: load the fixture under a spoofed serving/
+        # rel path so the wall-clock duration reads fire
+        rel = "stable_diffusion_webui_distributed_tpu/serving/timing_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "timing_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert found == {
+            ("OB001", 13),  # t0 = time.time() as a duration start
+            ("OB001", 15),  # time.time() - t0
+        }
+        # perf_counter idiom and the '# sdtpu-lint: wallclock' marker (line
+        # 25) stay clean
+
+    def test_timing_rule_is_path_scoped(self):
+        # the same file under its real tests/lint_fixtures/ path is out of
+        # the serving/pipeline/obs scope: zero findings
+        assert not _fixture_findings("timing_bad.py")
 
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")

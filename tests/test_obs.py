@@ -1,0 +1,525 @@
+"""Observability layer: span trees, Prometheus exposition, flight recorder.
+
+The acceptance scenario: a 4-request coalesced run through the serving
+dispatcher must export valid Chrome trace-event JSON whose per-request span
+trees account for the measured e2e latency, and ``/internal/metrics`` must
+serve parseable Prometheus text with the four latency histograms. Spans are
+default-on, so the overhead test pins that recording stays negligible.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.obs import flightrec, prometheus
+from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+    get_logger, lines_for_request,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+from test_pipeline import init_params
+
+
+def payload(**kw):
+    defaults = dict(prompt="a cow", steps=4, width=32, height=32,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+def assert_chrome_event(e):
+    """One Chrome trace-event "X" record with the sdtpu arg contract."""
+    assert e["ph"] == "X"
+    for key in ("name", "cat", "pid", "tid", "ts", "dur", "args"):
+        assert key in e, f"missing {key}: {e}"
+    assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+    assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    assert "request_id" in e["args"] and "span_id" in e["args"]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState())
+
+
+@pytest.fixture(scope="module")
+def bucketer():
+    return ShapeBucketer(shapes=[(32, 32), (48, 48)], batches=[4])
+
+
+# -- span lifecycle ----------------------------------------------------------
+
+class TestSpanLifecycle:
+    def test_request_records_root_and_children(self):
+        obs_spans.TRACER.clear()
+        with obs_spans.request("rid-1", name="unit", route="/x") as req:
+            assert obs_spans.current() is req
+            assert obs_spans.current_request_id() == "rid-1"
+            with obs_spans.span("outer", k=1) as outer:
+                with obs_spans.span("inner"):
+                    pass
+        assert obs_spans.current() is None
+        done = {t.request_id: t for t in obs_spans.TRACER.finished()}
+        tr = done["rid-1"]
+        assert tr.status == "ok" and tr.dur > 0
+        by_name = {s.name: s for s in tr.spans}
+        assert set(by_name) == {"unit", "outer", "inner"}
+        root, out, inner = by_name["unit"], by_name["outer"], by_name["inner"]
+        assert root.parent_id is None and root.span_id == tr.root_id
+        assert out.parent_id == tr.root_id
+        assert inner.parent_id == out.span_id
+        assert root.attrs["status"] == "ok" and root.attrs["route"] == "/x"
+        assert out.attrs == {"k": 1} and out is outer
+
+    def test_error_status_and_detail(self):
+        flightrec.RECORDER.clear()
+        with pytest.raises(ValueError):
+            with obs_spans.request("rid-err", name="unit"):
+                raise ValueError("kaboom")
+        tr = {t.request_id: t for t in
+              obs_spans.TRACER.finished()}["rid-err"]
+        assert tr.status == "error"
+        assert "ValueError" in tr.detail and "kaboom" in tr.detail
+        assert len(flightrec.RECORDER) == 1
+
+    def test_interrupt_mark_sticks(self):
+        with obs_spans.request("rid-int", name="unit") as req:
+            obs_spans.mark(req, "interrupted", "cancelled by client")
+        tr = {t.request_id: t for t in
+              obs_spans.TRACER.finished()}["rid-int"]
+        assert tr.status == "interrupted"
+        assert tr.detail == "cancelled by client"
+
+    def test_slow_threshold(self, monkeypatch):
+        monkeypatch.setattr(obs_spans.TRACER, "slow_s", 0.01)
+        with obs_spans.request("rid-slow", name="unit"):
+            time.sleep(0.03)
+        tr = {t.request_id: t for t in
+              obs_spans.TRACER.finished()}["rid-slow"]
+        assert tr.status == "slow" and "threshold" in tr.detail
+
+    def test_disabled_tracer_is_noop(self, monkeypatch):
+        monkeypatch.setattr(obs_spans.TRACER, "enabled", False)
+        before = len(obs_spans.TRACER.finished())
+        with obs_spans.request("rid-off", name="unit") as req:
+            assert req is None
+            with obs_spans.span("child") as sp:
+                assert sp is None
+        assert len(obs_spans.TRACER.finished()) == before
+
+    def test_span_outside_request_is_noop(self):
+        with obs_spans.span("orphan") as sp:
+            assert sp is None
+
+    def test_store_retention_bounded(self):
+        tr = obs_spans.SpanTracer(enabled=True, max_requests=2)
+        for i in range(3):
+            req = obs_spans.RequestTrace(f"r{i}", "unit", {})
+            tr.open(req)
+            tr.close(req)
+        kept = [t.request_id for t in tr.finished()]
+        assert kept == ["r1", "r2"]  # oldest evicted
+        assert tr.summary()["capacity"] == 2
+
+    def test_maybe_request_joins_active_context(self):
+        with obs_spans.request("rid-outer", name="unit") as outer:
+            with obs_spans.maybe_request("rid-ignored") as joined:
+                assert joined is outer  # no double-rooting
+        done = {t.request_id for t in obs_spans.TRACER.finished()}
+        assert "rid-ignored" not in done
+
+    def test_bind_current_crosses_threads(self):
+        seen = {}
+
+        def probe():
+            seen["rid"] = obs_spans.current_request_id()
+
+        with obs_spans.request("rid-thread", name="unit"):
+            t = threading.Thread(target=obs_spans.bind_current(probe))
+            t.start()
+            t.join()
+        assert seen["rid"] == "rid-thread"
+
+
+# -- the acceptance scenario: 4-request coalesced run ------------------------
+
+class TestCoalescedRunTracing:
+    RIDS = ("req-obs-0", "req-obs-1", "req-obs-2", "req-obs-3")
+
+    @pytest.fixture(scope="class")
+    def run(self, engine, bucketer):
+        """4 concurrent requests (2 shapes -> 2 buckets) through a
+        coalescing dispatcher, with per-request wall clocks."""
+        obs_spans.TRACER.clear()
+        flightrec.RECORDER.clear()
+        METRICS.clear()
+        prometheus.clear_histograms()
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.6)
+        shapes = [(32, 32), (24, 32), (48, 48), (40, 40)]
+        walls, errors = {}, []
+
+        def submit(i):
+            w, h = shapes[i]
+            p = payload(width=w, height=h, seed=300 + i,
+                        prompt=f"obs cow {i}", request_id=self.RIDS[i])
+            t0 = time.perf_counter()
+            try:
+                disp.submit(p)
+            except Exception as e:  # noqa: BLE001 — surfaced by assert
+                errors.append(e)
+            walls[self.RIDS[i]] = time.perf_counter() - t0
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total_wall = time.perf_counter() - t0
+        assert not errors, errors
+        traces = {t.request_id: t for t in obs_spans.TRACER.finished()
+                  if t.request_id in self.RIDS}
+        return {"traces": traces, "walls": walls, "total_wall": total_wall}
+
+    def test_every_request_has_a_trace(self, run):
+        assert set(run["traces"]) == set(self.RIDS)
+        for tr in run["traces"].values():
+            assert tr.status == "ok"
+            assert tr.name == "serve.txt2img"
+
+    def test_span_tree_shape(self, run):
+        for rid, tr in run["traces"].items():
+            names = {s.name for s in tr.spans}
+            assert "serve.txt2img" in names  # root
+            assert "bucket" in names         # bucketer span joins the ctx
+            assert "queue_wait" in names     # recorded by the group leader
+            # the device time is visible either as this request's own
+            # dispatch span or as the mirrored leader span
+            assert ("dispatch.device" in names
+                    or "coalesced.dispatch" in names), (rid, names)
+
+    def test_coalesce_links_leader_and_followers(self, run):
+        mirrored = [s for tr in run["traces"].values() for s in tr.spans
+                    if s.name == "coalesced.dispatch"]
+        if not all("dispatch.device" in {s.name for s in tr.spans}
+                   for tr in run["traces"].values()):
+            assert mirrored, "followers must carry the mirrored leader span"
+        for sp in mirrored:
+            leader = sp.attrs["leader_request_id"]
+            assert leader in self.RIDS
+            assert "leader_span_id" in sp.attrs
+
+    def test_root_duration_matches_measured_e2e(self, run):
+        # acceptance: the span tree accounts for the measured latency
+        for rid, tr in run["traces"].items():
+            wall = run["walls"][rid]
+            assert abs(tr.dur - wall) < 0.35, (rid, tr.dur, wall)
+            # direct children cover the bulk of the request: queue wait +
+            # device dispatch dominate e2e by construction
+            children = [s for s in tr.spans
+                        if s.parent_id == tr.root_id
+                        and s.name != "serve.txt2img"]
+            covered = sum(s.dur for s in children)
+            assert covered >= 0.5 * tr.dur, (rid, covered, tr.dur)
+            for s in tr.spans:
+                assert s.t0 >= tr.t0 - 0.05
+                assert s.t0 + s.dur <= tr.t0 + tr.dur + 0.05
+
+    def test_chrome_export_is_schema_valid(self, run):
+        doc = obs_spans.TRACER.export_chrome()
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        assert len(events) >= 4
+        for e in events:
+            assert_chrome_event(e)
+        # round-trips through strict JSON
+        assert json.loads(json.dumps(doc)) == doc
+        # the events of this run span ~the measured total wall clock
+        ours = [e for e in events
+                if e["args"]["request_id"] in self.RIDS]
+        lo = min(e["ts"] for e in ours)
+        hi = max(e["ts"] + e["dur"] for e in ours)
+        assert abs((hi - lo) / 1e6 - run["total_wall"]) < 0.5
+
+    def test_histograms_observed_per_request(self, run):
+        for key, minimum in (("e2e", 4), ("queue_wait", 4),
+                             ("device_dispatch", 1), ("decode", 1)):
+            _counts, _sum, n = prometheus.HISTOGRAMS[key].snapshot()
+            assert n >= minimum, (key, n)
+        # e2e sum is the sum of the four root durations
+        _c, total, n = prometheus.HISTOGRAMS["e2e"].snapshot()
+        assert n == 4
+        want = sum(t.dur for t in run["traces"].values())
+        assert total == pytest.approx(want, rel=0.01)
+
+
+# -- histogram mechanics -----------------------------------------------------
+
+class TestHistogram:
+    def test_bucket_counts_and_cumulative_render(self):
+        h = prometheus.Histogram("test_seconds", "test",
+                                 buckets=(0.01, 0.1, 1.0))
+        for v in (0.003, 0.05, 0.05, 0.5, 7.0):
+            h.observe(v)
+        counts, total, n = h.snapshot()
+        assert counts == [1, 2, 1, 1]  # le=0.01, 0.1, 1.0, +Inf
+        assert n == 5 and total == pytest.approx(7.603)
+        lines = h.render()
+        assert lines[0] == "# HELP test_seconds test"
+        assert lines[1] == "# TYPE test_seconds histogram"
+        assert 'test_seconds_bucket{le="0.01"} 1' in lines
+        assert 'test_seconds_bucket{le="0.1"} 3' in lines  # cumulative
+        assert 'test_seconds_bucket{le="1.0"} 4' in lines
+        assert 'test_seconds_bucket{le="+Inf"} 5' in lines
+        assert "test_seconds_count 5" in lines
+
+    def test_boundary_is_inclusive(self):
+        h = prometheus.Histogram("b_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.1)  # le="0.1" must include exactly 0.1
+        counts, _total, _n = h.snapshot()
+        assert counts == [1, 0, 0]
+
+    def test_quantile_estimate(self):
+        h = prometheus.Histogram("q_seconds", "t", buckets=(0.01, 0.1, 1.0))
+        for _ in range(90):
+            h.observe(0.005)
+        for _ in range(10):
+            h.observe(0.5)
+        assert h.quantile(0.5) == 0.01
+        assert h.quantile(0.99) == 1.0
+        assert prometheus.Histogram("e", "t").quantile(0.5) == 0.0
+
+    def test_clear(self):
+        h = prometheus.Histogram("c_seconds", "t")
+        h.observe(1.0)
+        h.clear()
+        assert h.snapshot() == ([0] * (len(h.bounds) + 1), 0.0, 0)
+
+
+# -- prometheus exposition over HTTP -----------------------------------------
+
+SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (NaN|[-+]?[0-9.eE+-]+)$")
+
+
+class TestInternalEndpoints:
+    @pytest.fixture()
+    def server(self, engine, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            ApiServer,
+        )
+
+        # tiny-model ladder: the default 512x512 ladder would pad a 32x32
+        # request 256x
+        monkeypatch.setenv("SDTPU_BUCKET_LADDER", "32x32")
+        monkeypatch.setenv("SDTPU_BATCH_LADDER", "1,2")
+        srv = ApiServer(engine, state=engine.state,
+                        host="127.0.0.1", port=0).start()
+        yield srv
+        srv.stop()
+
+    @staticmethod
+    def _get(server, route):
+        url = f"http://127.0.0.1:{server.port}{route}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.read().decode(), r.headers.get("Content-Type", "")
+
+    @staticmethod
+    def _post(server, route, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{route}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def test_metrics_exposition_parses(self, server):
+        out = self._post(server, "/sdapi/v1/txt2img",
+                         {"prompt": "metric cow", "steps": 2, "width": 32,
+                          "height": 32, "seed": 5})
+        assert len(out["images"]) == 1
+        body, ctype = self._get(server, "/internal/metrics")
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        names = set()
+        for line in body.strip().splitlines():
+            if line.startswith("# HELP "):
+                names.add(line.split()[2])
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                assert parts[3] in ("counter", "gauge", "histogram")
+                continue
+            assert SAMPLE_RE.match(line), f"unparseable sample: {line!r}"
+        for want in ("sdtpu_request_e2e_seconds", "sdtpu_queue_wait_seconds",
+                     "sdtpu_device_dispatch_seconds", "sdtpu_decode_seconds",
+                     "sdtpu_serving_requests_total", "sdtpu_eta_mpe_percent",
+                     "sdtpu_stage_seconds"):
+            assert want in names, f"missing metric family {want}"
+        # the request above landed in the e2e histogram
+        assert re.search(
+            r"^sdtpu_request_e2e_seconds_count [1-9]\d*$", body, re.M)
+
+    def test_trace_json_served(self, server):
+        self._post(server, "/sdapi/v1/txt2img",
+                   {"prompt": "trace cow", "steps": 2, "width": 32,
+                    "height": 32, "seed": 6, "request_id": "http-rid-1"})
+        body, _ctype = self._get(server, "/internal/trace.json")
+        doc = json.loads(body)
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        for e in events:
+            assert_chrome_event(e)
+        mine = [e for e in events
+                if e["args"]["request_id"] == "http-rid-1"]
+        assert any(e["name"] == "txt2img" for e in mine)  # ingress root
+
+    def test_flightrec_route_and_status_summary(self, server):
+        body, _ = self._get(server, "/internal/flightrec")
+        doc = json.loads(body)
+        assert set(doc) == {"entries", "capacity", "count"}
+        status, _ = self._get(server, "/internal/status")
+        obs = json.loads(status)["obs"]
+        assert obs["enabled"] is True
+        assert "retained" in obs and "flightrec_entries" in obs
+
+
+# -- flight recorder ---------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_retention_and_eviction(self):
+        rec = flightrec.FlightRecorder(capacity=2)
+        for i in range(3):
+            rec.record(f"r{i}", "error", f"d{i}", events=[], duration_s=i)
+        dump = rec.dump()
+        assert dump["capacity"] == 2 and dump["count"] == 2
+        assert [e["request_id"] for e in dump["entries"]] == ["r1", "r2"]
+        rec.clear()
+        assert len(rec) == 0
+
+    def test_dump_to_file_is_trace_report_readable(self, tmp_path):
+        rec = flightrec.FlightRecorder(capacity=4)
+        rec.record("rf", "slow", "over threshold", duration_s=1.5, events=[
+            {"ph": "X", "name": "root", "pid": 1, "tid": 1, "ts": 0,
+             "dur": 1.5e6, "args": {"request_id": "rf", "span_id": 1}}])
+        path = rec.dump_to_file(str(tmp_path / "rec.json"))
+        doc = json.loads(open(path).read())
+        assert doc["entries"][0]["reason"] == "slow"
+        import sys
+        sys.path.insert(0, "tools")
+        import trace_report
+        assert len(trace_report.load_events(doc)) == 1
+
+    def test_failed_request_correlates_logs(self):
+        flightrec.RECORDER.clear()
+        logger = get_logger()
+        rid = "rid-logged-failure"
+        with pytest.raises(RuntimeError):
+            with obs_spans.request(rid, name="unit"):
+                logger.info("marker line for %s", rid)
+                raise RuntimeError("dies after logging")
+        entry = flightrec.RECORDER.dump()["entries"][-1]
+        assert entry["request_id"] == rid and entry["reason"] == "error"
+        assert any(rid in line for line in entry["logs"])
+        assert entry["spans"][0]["args"]["request_id"] == rid
+        assert lines_for_request(rid) == entry["logs"]
+
+    def test_no_request_no_log_correlation(self):
+        get_logger().info("uncorrelated line")
+        assert lines_for_request("") == []
+
+
+# -- ETA calibration gauge ---------------------------------------------------
+
+class TestEtaGauge:
+    def test_record_eta_error_feeds_gauge(self):
+        from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+            EtaCalibration, record_eta_error,
+        )
+
+        prometheus.ETA_GAUGE.clear()
+        cal = EtaCalibration(avg_ipm=6.0)
+        record_eta_error(cal, predicted=10.0, actual=8.0)
+        s = prometheus.ETA_GAUGE.summary()
+        assert s["samples"] == 1
+        assert s["mpe_percent"] == pytest.approx(25.0)
+        assert s["last_predicted_s"] == 10.0 and s["last_actual_s"] == 8.0
+        assert cal.eta_percent_error == [pytest.approx(25.0)]
+        # the gauge value reaches the exposition
+        assert "sdtpu_eta_mpe_percent 25" in prometheus.render()
+
+    def test_outlier_rejected_like_the_paper_window(self):
+        from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+            EtaCalibration, record_eta_error,
+        )
+
+        prometheus.ETA_GAUGE.clear()
+        cal = EtaCalibration(avg_ipm=6.0)
+        record_eta_error(cal, predicted=100.0, actual=1.0)  # +9900%
+        assert prometheus.ETA_GAUGE.summary()["samples"] == 0
+        assert cal.eta_percent_error == []
+        prometheus.ETA_GAUGE.record(0.0, 5.0)  # non-positive: ignored
+        assert prometheus.ETA_GAUGE.summary()["samples"] == 0
+
+    def test_window_matches_scheduler_constant(self):
+        from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+            MPE_WINDOW,
+        )
+
+        prometheus.ETA_GAUGE.clear()
+        for i in range(MPE_WINDOW + 3):
+            prometheus.ETA_GAUGE.record(10.0 + i, 10.0)
+        s = prometheus.ETA_GAUGE.summary()
+        assert s["samples"] == MPE_WINDOW + 3  # total accepted
+        # but the MPE itself averages only the window's most-recent errors:
+        # sample i has error (10+i-10)/10*100 = 10*i percent
+        want = sum(10.0 * i
+                   for i in range(3, MPE_WINDOW + 3)) / MPE_WINDOW
+        assert s["mpe_percent"] == pytest.approx(want)
+
+
+# -- overhead ----------------------------------------------------------------
+
+class TestOverhead:
+    def test_span_recording_is_cheap(self):
+        n = 2000
+        with obs_spans.request("rid-overhead", name="unit"):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with obs_spans.span("tick"):
+                    pass
+            cost = time.perf_counter() - t0
+        # ~5-20 µs/span typical; 1 ms/span is already catastrophic.
+        # Generous CI bound: the point is "negligible", not a benchmark.
+        assert cost / n < 1e-3, f"{cost / n * 1e6:.1f} µs per span"
+        obs_spans.TRACER.clear()
+
+    def test_noop_span_outside_request_is_cheaper(self):
+        n = 5000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs_spans.span("tick"):
+                pass
+        cost = time.perf_counter() - t0
+        assert cost / n < 5e-4, f"{cost / n * 1e6:.1f} µs per no-op span"

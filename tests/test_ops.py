@@ -327,6 +327,83 @@ class TestInt8UnderMesh:
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
+class TestMeshSafeConcat:
+    """Regression guards for the SPMD partitioner concat hazard: on the
+    pinned jax 0.4.x, ``jnp.concatenate`` along a sharded dimension on a
+    mesh with a second (operand-unused) axis sums the replicas along that
+    axis into the output — rows come out scaled by the axis size. The
+    engine and the UNet route every such concat through
+    ``parallel/sharding.py``'s batch_concat/channel_concat, whose
+    stack+reshape / pad+add lowerings partition correctly. These tests pin
+    the helpers' semantics AND their correctness on sharded operands
+    (which is exactly what the raw concatenate gets wrong)."""
+
+    def _dp_sharded(self, x, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(*(["dp"] + [None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh8, spec))
+
+    def test_batch_concat_matches_concatenate_semantics(self):
+        from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+            batch_concat,
+        )
+
+        a = jnp.asarray(RNG.standard_normal((4, 3, 2), np.float32))
+        b = jnp.asarray(RNG.standard_normal((4, 3, 2), np.float32))
+        got = np.asarray(batch_concat([a, b]))
+        np.testing.assert_array_equal(got, np.concatenate([a, b], axis=0))
+        assert batch_concat([a]) is a
+
+    def test_batch_concat_dp_sharded_operand(self, mesh8):
+        """The CFG [x; x] doubling with a dp-sharded latent — the exact
+        shape of the TestMeshEngine dp=4,tp=2 corruption."""
+        from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+            batch_concat,
+        )
+
+        x = np.asarray(RNG.standard_normal((4, 8, 8, 4), np.float32))
+        xs = self._dp_sharded(jnp.asarray(x), mesh8)
+        want = np.concatenate([x, x], axis=0)
+        np.testing.assert_array_equal(np.asarray(batch_concat([xs, xs])),
+                                      want)
+        jitted = jax.jit(lambda v: batch_concat([v, v]))
+        np.testing.assert_array_equal(np.asarray(jitted(xs)), want)
+
+    def test_channel_concat_matches_concatenate_semantics(self):
+        from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+            channel_concat,
+        )
+
+        a = jnp.asarray(RNG.standard_normal((2, 4, 4, 3), np.float32))
+        b = jnp.asarray(RNG.standard_normal((2, 4, 4, 5), np.float32))
+        c = jnp.asarray(RNG.standard_normal((2, 4, 4, 2), np.float32))
+        got = np.asarray(channel_concat([a, b, c]))
+        np.testing.assert_array_equal(
+            got, np.concatenate([a, b, c], axis=-1))
+        assert channel_concat([a]) is a
+
+    def test_channel_concat_tp_sharded_operands(self, mesh8):
+        """The UNet decoder's skip concat with tp-sharded channels —
+        unequal widths, so the stack trick can't apply; pad+add must."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
+            channel_concat,
+        )
+
+        a = np.asarray(RNG.standard_normal((2, 4), np.float32))
+        b = np.asarray(RNG.standard_normal((2, 6), np.float32))
+        sh = NamedSharding(mesh8, P(None, "tp"))
+        as_, bs_ = jax.device_put(jnp.asarray(a), sh), \
+            jax.device_put(jnp.asarray(b), sh)
+        want = np.concatenate([a, b], axis=-1)
+        np.testing.assert_array_equal(
+            np.asarray(channel_concat([as_, bs_])), want)
+        jitted = jax.jit(lambda u, v: channel_concat([u, v]))
+        np.testing.assert_array_equal(np.asarray(jitted(as_, bs_)), want)
+
+
 @pytest.mark.slow
 class TestInt8ControlNet:
     def test_controlnet_quant_same_params_close_output(self):

@@ -264,6 +264,90 @@ class TestFlopsReport:
         assert json.loads(json.dumps(report)) == report
 
 
+class TestTraceReport:
+    """tools/trace_report.py: span-tree rendering and the slowest-span
+    roll-up over the /internal/trace.json artifact shape."""
+
+    @staticmethod
+    def _event(name, rid, span_id, parent_id=None, ts=0.0, dur_us=1000.0,
+               **attrs):
+        args = {"request_id": rid, "span_id": span_id, **attrs}
+        if parent_id is not None:
+            args["parent_id"] = parent_id
+        return {"ph": "X", "cat": "sdtpu", "name": name, "pid": 1, "tid": 2,
+                "ts": ts, "dur": dur_us, "args": args}
+
+    @pytest.fixture()
+    def trace(self):
+        e = self._event
+        return {"traceEvents": [
+            # request A: root(1) > dispatch(2) > denoise_chunk(3)
+            e("txt2img", "aaa", 1, ts=0.0, dur_us=50_000.0),
+            e("dispatch.device", "aaa", 2, parent_id=1, ts=5_000.0,
+              dur_us=40_000.0),
+            e("denoise_chunk", "aaa", 3, parent_id=2, ts=6_000.0,
+              dur_us=30_000.0),
+            # request B: follower with a mirrored leader span
+            e("txt2img", "bbb", 4, ts=1_000.0, dur_us=48_000.0),
+            e("coalesced.dispatch", "bbb", 5, parent_id=4, ts=5_000.0,
+              dur_us=40_000.0, leader_request_id="aaa"),
+        ], "displayTimeUnit": "ms"}
+
+    def test_tree_structure_and_grouping(self, trace):
+        import trace_report
+
+        report = trace_report.build_report(trace)
+        assert report["event_count"] == 5
+        assert list(report["requests"]) == ["aaa", "bbb"]
+        tree_a = report["requests"]["aaa"]
+        assert len(tree_a) == 3
+        assert tree_a[0].lstrip().startswith("txt2img")
+        # nesting depth shows in indentation: root < child < grandchild
+        indents = [len(l) - len(l.lstrip()) for l in tree_a]
+        assert indents[0] < indents[1] < indents[2]
+        # the mirrored leader link survives into the rendered line
+        assert any("leader_request_id=aaa" in l
+                   for l in report["requests"]["bbb"])
+
+    def test_top_stages_ranked_by_total(self, trace):
+        import trace_report
+
+        rows = trace_report.top_stages(trace_report.load_events(trace), k=2)
+        assert len(rows) == 2
+        assert rows[0]["name"] == "txt2img"          # 50+48 ms total
+        assert rows[0]["count"] == 2
+        assert rows[0]["total_ms"] >= rows[1]["total_ms"]
+
+    def test_flightrec_shape_accepted(self, trace):
+        import trace_report
+
+        dump = {"entries": [
+            {"request_id": "aaa", "reason": "error",
+             "spans": trace["traceEvents"][:3]}], "capacity": 16, "count": 1}
+        assert len(trace_report.load_events(dump)) == 3
+
+    def test_request_filter(self, trace):
+        import trace_report
+
+        report = trace_report.build_report(trace, request_id="bb")
+        assert list(report["requests"]) == ["bbb"]
+        assert report["event_count"] == 5  # top table still whole-file
+
+    def test_main_exit_codes(self, tmp_path, trace, capsys):
+        import trace_report
+
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(trace))
+        assert trace_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "request aaa" in out and "top" in out
+
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"traceEvents": []}))
+        assert trace_report.main([str(empty)]) == 1
+        assert trace_report.main([str(tmp_path / "missing.json")]) == 2
+
+
 class TestClassifyTriage:
     def test_rules(self):
         c = tpu_claim_probe.classify_triage
