@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -817,6 +818,219 @@ def run_serving(tiny):
     }
 
 
+def _percentile(samples, q):
+    """Nearest-rank percentile over a list of seconds (0.0 when empty)."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = max(0, min(len(ordered) - 1,
+                     int(math.ceil(q * len(ordered))) - 1))
+    return ordered[idx]
+
+
+class _EnvPatch:
+    """Set env knobs for one bench phase and restore them exactly."""
+
+    def __init__(self, **kv):
+        self.kv = kv
+        self.saved = {}
+
+    def __enter__(self):
+        for k, v in self.kv.items():
+            self.saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self.saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
+def _fleet_workload(tiny, dev):
+    """The mixed-tenant open-loop arrival plan: (delay_s, tenant, class,
+    payload-kwargs) per request. Interactive traffic is Poisson (seeded —
+    the fleet and FIFO phases replay identical arrivals), batch is an
+    immediate backlog, best-effort is an immediate flood."""
+    import random
+
+    if tiny or dev.platform == "cpu":
+        size, i_steps, b_steps = 64, 4, 8
+    else:
+        size, i_steps, b_steps = 512, 20, 40
+    rng = random.Random(7)
+    plan = []
+    t = 0.0
+    for i in range(6):  # interactive: Poisson arrivals, ~80ms mean gap
+        t += rng.expovariate(1.0 / 0.08)
+        plan.append((t, "alice", "interactive",
+                     dict(steps=i_steps, seed=500 + i)))
+    for i in range(3):  # batch: backlog waiting at t=0
+        plan.append((0.0, "batch-corp", "batch",
+                     dict(steps=b_steps, batch_size=2, seed=600 + i)))
+    for i in range(10):  # best-effort: flood at t=0 (quota fodder)
+        plan.append((0.0, "scraper", "best_effort",
+                     dict(steps=i_steps, seed=700 + i)))
+    return size, plan
+
+
+def _fleet_phase(dispatcher, plan, size):
+    """Replay the arrival plan open-loop (threads fire at their arrival
+    times regardless of completions) and collect per-request outcomes."""
+    from stable_diffusion_webui_distributed_tpu.fleet.admission import (
+        FleetRejected,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+
+    records, errs = [], []
+    lock = threading.Lock()
+    start = time.time()
+
+    def fire(delay, tenant, cls, kw):
+        time.sleep(max(0.0, delay))
+        p = GenerationPayload(prompt=f"fleet {cls}", width=size, height=size,
+                              sampler_name="Euler a", tenant=tenant,
+                              priority_class=cls, **kw)
+        t0 = time.time()
+        status = "ok"
+        try:
+            dispatcher.submit(p)
+        except FleetRejected as e:
+            status = e.reason  # "quota" | "slo"
+        except Exception as e:  # noqa: BLE001 — reported in the JSON line
+            status = "error"
+            with lock:
+                errs.append(repr(e))
+        with lock:
+            records.append({"class": cls, "tenant": tenant,
+                            "status": status,
+                            "latency_s": time.time() - t0})
+
+    threads = [threading.Thread(target=fire, args=req) for req in plan]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return records, errs, time.time() - start
+
+
+def _fleet_class_stats(records, slo_s):
+    out = {}
+    for cls in ("interactive", "batch", "best_effort"):
+        rows = [r for r in records if r["class"] == cls]
+        done = [r["latency_s"] for r in rows if r["status"] == "ok"]
+        stats = {
+            "requests": len(rows),
+            "completed": len(done),
+            "throttled": sum(1 for r in rows if r["status"] == "quota"),
+            "rejected": sum(1 for r in rows if r["status"] == "slo"),
+            "p50_s": round(_percentile(done, 0.50), 4),
+            "p95_s": round(_percentile(done, 0.95), 4),
+        }
+        if cls == "interactive":
+            stats["slo_s"] = slo_s
+            stats["slo_attainment"] = round(
+                sum(1 for s in done if s <= slo_s) / len(done), 4) \
+                if done else None
+        out[cls] = stats
+    return out
+
+
+def run_fleet(tiny):
+    """Fleet-scheduler microbench: one mixed-tenant open-loop workload
+    (Poisson interactive + batch backlog + best-effort flood) replayed
+    twice — FIFO baseline, then the weighted-fair fleet gate with quotas
+    and chunk-boundary preemption. Reports per-class p50/p95 latency,
+    interactive SLO attainment, preemption count and the quota-throttle
+    rate; writes the full comparison to BENCH_fleet.json."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        prometheus as obs_prom,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    cpu = tiny or dev.platform == "cpu"
+    family = C.TINY if cpu else C.SD15
+    slo_s = 10.0 if cpu else 30.0
+    size, plan = _fleet_workload(tiny, dev)
+
+    # short chunks give the preemptible batch jobs several yield points
+    with _EnvPatch(SDTPU_CHUNK="2" if cpu else "5"):
+        engine = _make_engine(family)
+    bucketer = ShapeBucketer(shapes=[(size, size)], batches=[4])
+
+    # warm every executable the workload touches so neither phase pays
+    # compile time (the FIFO phase runs first and would otherwise eat it)
+    with _EnvPatch(SDTPU_FLEET="0"):
+        warm = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        warm_plan = [(0.0, t, c, kw) for (_d, t, c, kw) in
+                     {(r[2]): r for r in plan}.values()]
+        _fleet_phase(warm, warm_plan, size)
+
+    # phase 1: FIFO baseline — the pre-fleet dispatcher, same arrivals
+    with _EnvPatch(SDTPU_FLEET="0"):
+        fifo = ServingDispatcher(engine, bucketer=bucketer, window=0.05)
+        METRICS.clear()
+        fifo_records, fifo_errs, fifo_wall = _fleet_phase(fifo, plan, size)
+
+    # phase 2: the fleet gate — WFQ + quotas + zero-quantum preemption
+    with _EnvPatch(SDTPU_FLEET="1", SDTPU_FLEET_QUANTUM_S="0",
+                   SDTPU_QUOTA_IPM="240", SDTPU_QUOTA_BURST="8"):
+        obs_prom.clear_histograms()
+        fleet = ServingDispatcher(engine, bucketer=bucketer, window=0.05)
+        METRICS.clear()
+        records, errs, wall = _fleet_phase(fleet, plan, size)
+
+    if errs or fifo_errs:
+        _dump_flightrec("fleet")
+    stats = _fleet_class_stats(records, slo_s)
+    fifo_stats = _fleet_class_stats(fifo_records, slo_s)
+    throttled = sum(s["throttled"] for s in stats.values())
+    fleet_state = fleet.fleet_summary() or {}
+    out = {
+        "metric": ("tiny_" if cpu else "") + "fleet_interactive_p95_s",
+        "value": stats["interactive"]["p95_s"],
+        "unit": "s",
+        "vs_baseline": fifo_stats["interactive"]["p95_s"],
+        "slo_attainment": stats["interactive"]["slo_attainment"],
+        "preemptions": fleet_state.get("preemptions", 0),
+        "quota_throttle_rate": round(throttled / len(records), 4)
+        if records else 0.0,
+        "classes": stats,
+        "baseline_fifo": fifo_stats,
+        "queue_wait_p95_s": round(obs_prom.fleet_queue_wait_p95(), 4),
+        "requests": len(plan),
+        "errors": errs + fifo_errs,
+        "wall_s": round(wall, 2),
+        "fifo_wall_s": round(fifo_wall, 2),
+        "device": dev.device_kind,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_fleet.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+    print(f"bench: fleet comparison written to {path} "
+          f"(summarize with tools/fleet_report.py)", file=sys.stderr)
+    return out
+
+
 def _dump_flightrec(tag):
     """Persist the obs flight recorder (failed/interrupted/slow requests'
     span trees + correlated log lines) next to the bench outputs so a dead
@@ -847,6 +1061,10 @@ def main() -> None:
                     help="step-cache cells: FLOPs/image cut, compile "
                          "counts, PSNR vs uncached; writes "
                          "BENCH_deepcache.json (CPU-safe)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet-scheduler comparison: mixed-tenant "
+                         "open-loop workload, FIFO vs WFQ gate; writes "
+                         "BENCH_fleet.json (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -883,6 +1101,8 @@ def main() -> None:
     try:
         if args.serving:
             print(json.dumps(run_serving(tiny)))
+        elif args.fleet:
+            print(json.dumps(run_fleet(tiny)))
         elif args.deepcache:
             print(json.dumps(run_deepcache(tiny)))
         else:

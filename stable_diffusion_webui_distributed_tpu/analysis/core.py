@@ -40,6 +40,7 @@ RULES = {
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",
+    "FL001": "unguarded mutable container in a lock-bearing fleet class",
     "AL001": "allowlist entry expired",
     "AL002": "allowlist entry matched no finding",
 }

@@ -1,0 +1,49 @@
+"""Multi-tenant fleet tier above the serving dispatcher.
+
+- :mod:`.policy` — priority classes, weighted-fair queueing with aging,
+  the device gate, and chunk-boundary preemption hooks;
+- :mod:`.quotas` — per-tenant token-bucket admission quotas;
+- :mod:`.admission` — ETA-SLO accept / degrade / reject control;
+- :mod:`.slices` — slice registry + queue-wait-driven autoscale signals.
+
+Everything is host-side policy over the existing engine/dispatcher
+machinery; ``SDTPU_FLEET=0`` (the default) keeps the whole tier inert
+and the serving path byte-identical to the pre-fleet build.
+"""
+
+from stable_diffusion_webui_distributed_tpu.fleet.admission import (
+    AdmissionController,
+    AdmissionDecision,
+    FleetRejected,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.policy import (
+    BATCH,
+    BEST_EFFORT,
+    INTERACTIVE,
+    ClassPolicy,
+    EnginePreemptHook,
+    FleetGate,
+    FleetPolicy,
+    GateEntry,
+    WeightedFairQueue,
+    fleet_enabled,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.quotas import (
+    QuotaLedger,
+    TokenBucket,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.slices import (
+    AutoscaleEngine,
+    ScaleDecision,
+    SliceInfo,
+    SliceRegistry,
+)
+
+__all__ = [
+    "AdmissionController", "AdmissionDecision", "FleetRejected",
+    "BATCH", "BEST_EFFORT", "INTERACTIVE", "ClassPolicy",
+    "EnginePreemptHook", "FleetGate", "FleetPolicy", "GateEntry",
+    "WeightedFairQueue", "fleet_enabled",
+    "QuotaLedger", "TokenBucket",
+    "AutoscaleEngine", "ScaleDecision", "SliceInfo", "SliceRegistry",
+]

@@ -1,0 +1,188 @@
+"""Slice registry + autoscale signals.
+
+A *slice* is a logical mesh partition serving one group of traffic (a
+model family at a precision, e.g. ``sdxl/bf16``). This registry is the
+fleet's placement table — which serving groups live on which slices and
+how many replicas each has — and the decision engine that turns the
+Prometheus queue-wait evidence into scale-up/scale-down signals.
+
+Scope (ISSUE 6d): decisions + hooks land now; *acting* on a decision
+(instantiating another engine over a disjoint device set) rides the
+existing stage-pipeline disjoint-mesh machinery and is wired by the
+deployment via :meth:`AutoscaleEngine.add_hook`. The decision engine
+therefore never touches a device — it reads histogram quantiles and
+emits :class:`ScaleDecision` records, which also makes it fully
+CPU-testable.
+
+Signal: per-class fleet queue-wait p95 (``sdtpu_fleet_queue_wait_seconds``
+in obs/prometheus.py). Sustained p95 above ``SDTPU_AUTOSCALE_UP_S``
+asks for a replica; p95 below ``SDTPU_AUTOSCALE_DOWN_S`` with more than
+``min_replicas`` releases one. A cooldown stops flapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+DEFAULT_UP_P95_S = 5.0
+DEFAULT_DOWN_P95_S = 0.5
+DEFAULT_COOLDOWN_S = 60.0
+
+
+@dataclasses.dataclass
+class SliceInfo:
+    """One logical mesh slice and the serving group pinned to it."""
+
+    name: str
+    group: str = ""                 # serving group key, e.g. "sdxl/bf16"
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    replicas: int = 1
+    min_replicas: int = 1
+    max_replicas: int = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    slice_name: str
+    direction: str                  # "up" | "down"
+    reason: str
+    p95_s: float
+    replicas: int                   # replica count AFTER the decision
+
+
+class SliceRegistry:
+    """Thread-safe name -> :class:`SliceInfo` table."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slices: Dict[str, SliceInfo] = {}  # guarded-by: _lock
+
+    def register(self, info: SliceInfo) -> None:
+        with self._lock:
+            self._slices[info.name] = info
+
+    def get(self, name: str) -> Optional[SliceInfo]:
+        with self._lock:
+            return self._slices.get(name)
+
+    def for_group(self, group: str) -> List[SliceInfo]:
+        with self._lock:
+            return [s for s in self._slices.values() if s.group == group]
+
+    def set_replicas(self, name: str, replicas: int) -> None:
+        with self._lock:
+            s = self._slices.get(name)
+            if s is not None:
+                s.replicas = max(s.min_replicas,
+                                 min(s.max_replicas, int(replicas)))
+
+    def summary(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {name: dataclasses.asdict(s)
+                    for name, s in self._slices.items()}
+
+
+class AutoscaleEngine:
+    """Queue-wait-driven scale decisions over a :class:`SliceRegistry`.
+
+    ``quantile_source`` abstracts the Prometheus read — production passes
+    :func:`obs.prometheus.fleet_queue_wait_p95`, tests pass a lambda.
+    Hooks receive every emitted :class:`ScaleDecision`; the registry's
+    replica count is updated first, so a hook reads the post-decision
+    state.
+    """
+
+    def __init__(self, registry: SliceRegistry,
+                 quantile_source: Optional[Callable[[], float]] = None,
+                 up_p95_s: Optional[float] = None,
+                 down_p95_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock=time.monotonic) -> None:
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            env_float,
+        )
+
+        self.registry = registry
+        self.quantile_source = quantile_source \
+            or _default_quantile_source
+        self.up_p95_s = env_float("SDTPU_AUTOSCALE_UP_S", DEFAULT_UP_P95_S) \
+            if up_p95_s is None else up_p95_s
+        self.down_p95_s = env_float("SDTPU_AUTOSCALE_DOWN_S",
+                                    DEFAULT_DOWN_P95_S) \
+            if down_p95_s is None else down_p95_s
+        self.cooldown_s = env_float("SDTPU_AUTOSCALE_COOLDOWN_S",
+                                    DEFAULT_COOLDOWN_S) \
+            if cooldown_s is None else cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._hooks: List[Callable[[ScaleDecision], None]] = []  # guarded-by: _lock
+        self._last_decision: Dict[str, float] = {}  # guarded-by: _lock
+        self._decisions: List[ScaleDecision] = []  # guarded-by: _lock
+
+    def add_hook(self, hook: Callable[[ScaleDecision], None]) -> None:
+        with self._lock:
+            self._hooks.append(hook)
+
+    def decide(self) -> List[ScaleDecision]:
+        """One evaluation pass over every registered slice; returns (and
+        dispatches to hooks) the decisions made this pass."""
+        p95 = float(self.quantile_source())
+        now = self._clock()
+        out: List[ScaleDecision] = []
+        for name, info in self.registry.summary().items():
+            with self._lock:
+                last = self._last_decision.get(name, -1e18)
+                in_cooldown = now - last < self.cooldown_s
+            if in_cooldown:
+                continue
+            replicas = info["replicas"]
+            decision = None
+            if p95 >= self.up_p95_s and replicas < info["max_replicas"]:
+                decision = ScaleDecision(
+                    name, "up",
+                    f"queue-wait p95 {p95:.2f}s >= {self.up_p95_s:.2f}s",
+                    p95, replicas + 1)
+            elif p95 <= self.down_p95_s and replicas > info["min_replicas"]:
+                decision = ScaleDecision(
+                    name, "down",
+                    f"queue-wait p95 {p95:.2f}s <= {self.down_p95_s:.2f}s",
+                    p95, replicas - 1)
+            if decision is None:
+                continue
+            self.registry.set_replicas(name, decision.replicas)
+            with self._lock:
+                self._last_decision[name] = now
+                self._decisions.append(decision)
+                hooks = list(self._hooks)
+            for hook in hooks:  # outside the lock: hooks may re-enter
+                hook(decision)
+            out.append(decision)
+        return out
+
+    def history(self) -> List[ScaleDecision]:
+        with self._lock:
+            return list(self._decisions)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            decisions = list(self._decisions)
+        return {
+            "slices": self.registry.summary(),
+            "thresholds": {"up_p95_s": self.up_p95_s,
+                           "down_p95_s": self.down_p95_s,
+                           "cooldown_s": self.cooldown_s},
+            "decisions": [dataclasses.asdict(d) for d in decisions[-16:]],
+        }
+
+
+def _default_quantile_source() -> float:
+    """Worst per-class p95 of the fleet queue-wait histograms — the
+    autoscaler keys on the most-starved class, not the average."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        prometheus as obs_prom,
+    )
+
+    return obs_prom.fleet_queue_wait_p95()

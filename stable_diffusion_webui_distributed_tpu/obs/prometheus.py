@@ -56,9 +56,14 @@ class Histogram:
     """Thread-safe fixed-bucket histogram (cumulative ``le`` exposition)."""
 
     def __init__(self, name: str, help_text: str,
-                 buckets: Iterable[float] = BUCKETS) -> None:
+                 buckets: Iterable[float] = BUCKETS,
+                 labels: str = "") -> None:
         self.name = name
         self.help = help_text
+        #: pre-rendered label body (e.g. ``class="interactive"``) merged
+        #: into every sample; HELP/TYPE are emitted by the caller when a
+        #: labeled family has several instances
+        self.labels = labels
         self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
         self._lock = threading.Lock()
         self._counts: List[int] = [0] * (len(self.bounds) + 1)  # guarded-by: _lock
@@ -98,18 +103,22 @@ class Histogram:
                     else self.bounds[-1]
         return self.bounds[-1]
 
-    def render(self) -> List[str]:
+    def render(self, header: bool = True) -> List[str]:
         counts, total, n = self.snapshot()
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
+        lines = []
+        if header:
+            lines += [f"# HELP {self.name} {self.help}",
+                      f"# TYPE {self.name} histogram"]
+        pre = f"{self.labels}," if self.labels else ""
+        suf = f"{{{self.labels}}}" if self.labels else ""
         running = 0
         for bound, c in zip(self.bounds, counts):
             running += c
-            lines.append(f'{self.name}_bucket{{le="{_bucket_label(bound)}"}}'
-                         f" {running}")
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
-        lines.append(f"{self.name}_sum {_fmt(total)}")
-        lines.append(f"{self.name}_count {n}")
+            lines.append(f'{self.name}_bucket{{{pre}le='
+                         f'"{_bucket_label(bound)}"}} {running}')
+        lines.append(f'{self.name}_bucket{{{pre}le="+Inf"}} {n}')
+        lines.append(f"{self.name}_sum{suf} {_fmt(total)}")
+        lines.append(f"{self.name}_count{suf} {n}")
         return lines
 
 
@@ -154,6 +163,114 @@ def observe_stage(stage: str, seconds: float) -> None:
 def clear_histograms() -> None:
     for h in HISTOGRAMS.values():
         h.clear()
+    with _FLEET_LOCK:
+        _FLEET_QUEUE_WAIT.clear()
+    for c in FLEET_COUNTERS.values():
+        c.clear()
+
+
+# -- fleet tier (fleet/ package) --------------------------------------------
+
+class LabeledCounter:
+    """Thread-safe counter family with a fixed label-name tuple."""
+
+    def __init__(self, name: str, help_text: str,
+                 label_names: Tuple[str, ...]) -> None:
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
+
+    def inc(self, n: float = 1.0, **labels: Any) -> None:
+        key = tuple(str(labels.get(ln, "")) for ln in self.label_names)
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0.0) + float(n)
+
+    def value(self, **labels: Any) -> float:
+        key = tuple(str(labels.get(ln, "")) for ln in self.label_names)
+        with self._lock:
+            return self._counts.get(key, 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def snapshot(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._counts)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts = {}
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        for key in sorted(self.snapshot()):
+            body = ",".join(f'{ln}="{_label(v)}"'
+                            for ln, v in zip(self.label_names, key))
+            lines.append(f"{self.name}{{{body}}} "
+                         f"{_fmt(self.snapshot()[key])}")
+        return lines
+
+
+#: Fleet-tier counter families (fleet/policy.py, fleet/admission.py and
+#: the dispatcher feed these; /internal/metrics renders them).
+FLEET_COUNTERS: Dict[str, LabeledCounter] = {
+    "admissions": LabeledCounter(
+        "sdtpu_fleet_admissions_total",
+        "Admission decisions by class and outcome "
+        "(accept/degrade/reject).", ("class", "decision")),
+    "quota_throttles": LabeledCounter(
+        "sdtpu_fleet_quota_throttles_total",
+        "Requests throttled by per-tenant token-bucket quotas.",
+        ("tenant",)),
+    "preemptions": LabeledCounter(
+        "sdtpu_fleet_preemptions_total",
+        "Chunk-boundary device yields by the preempted job's class.",
+        ("class",)),
+    "requests": LabeledCounter(
+        "sdtpu_fleet_requests_total",
+        "Requests entering the fleet gate by tenant and class.",
+        ("tenant", "class")),
+}
+
+_FLEET_LOCK = threading.Lock()
+#: per-class queue-wait histograms, created on first observation
+_FLEET_QUEUE_WAIT: Dict[str, Histogram] = {}  # guarded-by: _FLEET_LOCK
+
+
+def fleet_count(name: str, n: float = 1.0, **labels: Any) -> None:
+    c = FLEET_COUNTERS.get(name)
+    if c is not None:
+        c.inc(n, **labels)
+
+
+def fleet_observe_queue_wait(cls: str, seconds: float) -> None:
+    """Per-class companion to the unlabeled ``queue_wait`` histogram —
+    the autoscaler keys its p95 signal on these."""
+    with _FLEET_LOCK:
+        h = _FLEET_QUEUE_WAIT.get(cls)
+        if h is None:
+            h = Histogram(
+                "sdtpu_fleet_queue_wait_seconds",
+                "Gate queue wait by priority class.",
+                labels=f'class="{_label(cls)}"')
+            _FLEET_QUEUE_WAIT[cls] = h
+    h.observe(seconds)
+
+
+def fleet_queue_wait_p95(cls: Optional[str] = None) -> float:
+    """p95 gate wait for one class, or the worst class when ``cls`` is
+    None (the autoscale signal keys on the most-starved class)."""
+    with _FLEET_LOCK:
+        hists = ([_FLEET_QUEUE_WAIT[cls]]
+                 if cls is not None and cls in _FLEET_QUEUE_WAIT
+                 else list(_FLEET_QUEUE_WAIT.values()))
+    if not hists:
+        return 0.0
+    return max(h.quantile(0.95) for h in hists)
 
 
 class EtaGauge:
@@ -302,6 +419,14 @@ def render() -> str:
                          f'stat="{stat}"}} {_fmt(st[stat])}')
         lines.append(f'sdtpu_stage_samples{{stage="{_label(stage)}"}} '
                      f'{_fmt(st["count"])}')
+
+    for c in FLEET_COUNTERS.values():
+        lines.extend(c.render())
+    with _FLEET_LOCK:
+        fleet_hists = [_FLEET_QUEUE_WAIT[k]
+                       for k in sorted(_FLEET_QUEUE_WAIT)]
+    for i, h in enumerate(fleet_hists):
+        lines.extend(h.render(header=(i == 0)))
 
     eta = ETA_GAUGE.summary()
     _scalar(lines, "sdtpu_eta_mpe_percent", "gauge",

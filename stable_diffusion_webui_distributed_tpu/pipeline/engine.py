@@ -196,6 +196,14 @@ class Engine:
         self._cond_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._cond_epoch = 0
         self._COND_CACHE_MAX = 64
+        # Cooperative chunk-boundary preemption (fleet/policy.py): when a
+        # preemptible job runs, the fleet gate installs an object with
+        # should_yield()/yield_device() here; the denoise loop polls it
+        # between chunk dispatches (the same boundary the interrupt flag
+        # uses). The hook is thread-filtered — work executing DURING a
+        # yield sees the same attribute and no-ops — so installation needs
+        # no lock: only the gate-holding thread ever swaps it.
+        self.preempt_hook = None
 
     # -- compiled stage factories ------------------------------------------
 
@@ -1323,6 +1331,24 @@ class Engine:
         while pos < end:
             if self.state.flag.interrupted:
                 break
+            hook = self.preempt_hook
+            if hook is not None and hook.should_yield():
+                # chunk-boundary yield: drain the in-flight chunk so the
+                # device is quiet, then block in the gate until the fleet
+                # hands it back. Everything the loop needs (carry, cache,
+                # valid, pos) lives in this frame — resumption is
+                # byte-identical and reuses the same executables.
+                if sync and pending is not None:
+                    pending[0].block_until_ready()
+                    done += pending[1]
+                    self.state.step(done)
+                    pending = None
+                hook.yield_device()
+                # the interloper drove the shared progress record; restore
+                # this range's view before continuing
+                self.state.begin(job, end - start_step)
+                if done:
+                    self.state.step(done)
             length = min(self.chunk_size, end - pos)
             # drop units whose guidance window misses this chunk entirely —
             # a gated-off ControlNet forward is ~half a UNet of wasted MXU

@@ -85,6 +85,16 @@ class GenerationPayload(BaseModel):
     # every HTTP sub-range (slices can't reconstruct it).
     context_chunks: Optional[int] = None
 
+    # fleet tier (fleet/ package): multi-tenant scheduling identity.
+    # tenant keys the per-tenant quota bucket; priority_class selects the
+    # scheduling class ("interactive" / "batch" / "best_effort"; empty =
+    # interactive, the pre-fleet behavior for every request). slo_s, when
+    # > 0, overrides the class completion SLO for THIS request (capped
+    # admission still applies). All three are inert at SDTPU_FLEET=0.
+    tenant: str = "default"
+    priority_class: str = ""
+    slo_s: float = 0.0
+
     # model / misc
     override_settings: Dict[str, Any] = Field(default_factory=dict)
     styles: List[str] = Field(default_factory=list)

@@ -73,6 +73,38 @@ def _logger():
 # - ``SDTPU_OBS_SLOW_S`` (float seconds, default 30): e2e latency above
 #   which a request is flight-recorded as a slow outlier; ``0`` disables
 #   slow capture (errors and interrupts are always recorded).
+#
+# Fleet-scheduler knobs (fleet/ package; README "Fleet scheduling"):
+#
+# - ``SDTPU_FLEET`` (flag, default off): master switch for the multi-tenant
+#   tier — weighted-fair device gate, per-tenant quotas, ETA-SLO admission
+#   and chunk-boundary preemption. Off keeps the dispatcher's plain
+#   exec-lock path byte-identical to the pre-fleet build. The config field
+#   ``fleet_enabled`` sets the same switch; the env var wins.
+# - ``SDTPU_FLEET_CLASSES`` (``name:weight`` list, default
+#   ``interactive:8,batch:2,best_effort:1``): WFQ weight per priority
+#   class. Unknown names define extra classes scheduled like ``batch``.
+# - ``SDTPU_SLO_INTERACTIVE_S`` (float seconds, default 30): completion
+#   SLO the admission controller enforces for ``interactive`` requests;
+#   0 disables SLO admission. Per-request ``slo_s`` overrides it.
+# - ``SDTPU_QUOTA_IPM`` (float images/minute, default 0 = unlimited):
+#   per-tenant token-bucket refill rate; ``SDTPU_QUOTA_BURST`` (float,
+#   default 8) is the bucket depth. Exhausted tenants get 429 +
+#   Retry-After.
+# - ``SDTPU_FLEET_AGING_S`` (float seconds, default 10): waiters older
+#   than this are served oldest-first regardless of fair-queue tags
+#   (starvation bound).
+# - ``SDTPU_FLEET_QUANTUM_S`` (float seconds, default 0.25): minimum
+#   device tenure before a preemptible job may be asked to yield.
+# - ``SDTPU_FLEET_FEWSTEP`` (int, default 12): step budget the deepest
+#   admission degrade rung clamps to before rejecting; 0 disables the
+#   few-step rung.
+# - ``SDTPU_AUTOSCALE_UP_S`` / ``SDTPU_AUTOSCALE_DOWN_S`` /
+#   ``SDTPU_AUTOSCALE_COOLDOWN_S`` (floats, defaults 5 / 0.5 / 60):
+#   slice autoscale thresholds — scale a slice group up when the worst
+#   per-class queue-wait p95 crosses UP_S, down when it falls below
+#   DOWN_S, with at most one decision per slice per cooldown
+#   (fleet/slices.py; decision engine + hooks only, no provisioning).
 
 
 def read_env(name: str, default: str = "") -> str:
@@ -218,6 +250,10 @@ class ConfigModel(BaseModel):
     # engine is busy with a previous batch). Env SDTPU_COALESCE_WINDOW
     # overrides; default 0.05.
     coalesce_window: Optional[float] = None
+    # Multi-tenant fleet tier (fleet/ package): priority classes, quotas,
+    # SLO admission and preemption. None = off unless SDTPU_FLEET says
+    # otherwise (the env var always wins; see the knob block above).
+    fleet_enabled: Optional[bool] = None
 
 
 def default_config_path() -> str:

@@ -146,6 +146,37 @@ def _eta_hires(cal, payload, bench, batch_size) -> float:
     return predict_eta(cal, pseudo, bench, _include_hr=False)
 
 
+def admission_eta(
+    cal: EtaCalibration,
+    payload,
+    benchmark: Optional[BenchmarkPayload] = None,
+    steps: Optional[int] = None,
+    queue_wait: float = 0.0,
+    padding_overhead: float = 1.0,
+) -> float:
+    """SLO-admission variant of :func:`predict_eta` (fleet/admission.py).
+
+    Identical model, but when this calibration has no local error history
+    the correction falls back to the process-wide MPE gauge
+    (``sdtpu_eta_mpe_percent``, obs/prometheus.py) — a freshly registered
+    backend then still benefits from the fleet's live calibration instead
+    of admitting on raw benchmark arithmetic. Wait stays additive and is
+    never rescaled by either correction (it is measured, not predicted).
+    """
+    eta = predict_eta(cal, payload, benchmark=benchmark, steps=steps,
+                      padding_overhead=padding_overhead)
+    if not cal.eta_percent_error:
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                prometheus as obs_prom,
+            )
+
+            eta -= eta * (obs_prom.ETA_GAUGE.mpe() / 100.0)
+        except Exception:  # noqa: BLE001 — importable without obs
+            pass
+    return max(0.0, eta) + max(0.0, queue_wait)
+
+
 def record_eta_error(cal: EtaCalibration, predicted: float,
                      actual: float) -> None:
     """Feed one (prediction, reality) pair back into the calibration.

@@ -182,6 +182,21 @@ class ApiServer:
         return obs_spans.request(rid, name=route.rsplit("/", 1)[-1],
                                  route=route)
 
+    def _submit_dispatch(self, payload: GenerationPayload,
+                         job: str) -> GenerationResult:
+        """Dispatcher submit with fleet admission mapped to HTTP: a
+        quota/SLO refusal (fleet/admission.py) becomes 429 + Retry-After
+        instead of a 500."""
+        from stable_diffusion_webui_distributed_tpu.fleet.admission import (
+            FleetRejected,
+        )
+
+        try:
+            return self.dispatcher.submit(payload, job=job)
+        except FleetRejected as e:
+            raise ApiError(429, e.detail, headers={
+                "Retry-After": str(max(1, round(e.retry_after)))})
+
     def handle_txt2img(self, body: Dict[str, Any]) -> Dict[str, Any]:
         from stable_diffusion_webui_distributed_tpu.pipeline.xyz import is_xyz
 
@@ -194,7 +209,7 @@ class ApiServer:
                 # serialization (its exec lock) so concurrent compatible
                 # requests can merge during the coalesce window instead of
                 # queuing on _busy
-                result = self.dispatcher.submit(payload, job="txt2img")
+                result = self._submit_dispatch(payload, job="txt2img")
                 return self._generation_response(result)
             with self._busy:
                 result = self._run_scripted(payload)
@@ -208,7 +223,7 @@ class ApiServer:
         payload = self._expand_scripts(payload)
         with self._mint_request(payload, "/sdapi/v1/img2img"):
             if self.dispatcher is not None:
-                result = self.dispatcher.submit(payload, job="img2img")
+                result = self._submit_dispatch(payload, job="img2img")
                 return self._generation_response(result)
             with self._busy:
                 result = self._run_scripted(payload)
@@ -456,6 +471,7 @@ class ApiServer:
                 f"{w}x{h}" for w, h in self.dispatcher.bucketer.shapes]
             serving["batch_ladder"] = list(self.dispatcher.bucketer.batches)
             serving["eta_overhead"] = self.dispatcher.eta_overhead()
+            serving["fleet"] = self.dispatcher.fleet_summary()
         from stable_diffusion_webui_distributed_tpu.obs import (
             flightrec, spans as obs_spans,
         )
@@ -821,16 +837,20 @@ class ApiServer:
                     else:
                         self._send(200, result if result is not None else {})
                 except ApiError as e:
-                    self._send(e.status, {"detail": e.detail})
+                    self._send(e.status, {"detail": e.detail},
+                               headers=e.headers)
                 except Exception as e:  # noqa: BLE001
                     log.error("api error on %s %s: %s", method, self.path, e)
                     self._send(500, {"detail": str(e)})
 
-            def _send(self, status: int, obj: Any):
+            def _send(self, status: int, obj: Any,
+                      headers: Optional[Dict[str, str]] = None):
                 data = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -905,10 +925,12 @@ class ApiServer:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, detail: str):
+    def __init__(self, status: int, detail: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(detail)
         self.status = status
         self.detail = detail
+        self.headers = headers or {}
 
 
 def _fleet_workers(source) -> list:

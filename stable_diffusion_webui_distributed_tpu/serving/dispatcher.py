@@ -38,6 +38,8 @@ possible.
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import threading
 import time
 import uuid
@@ -45,6 +47,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from stable_diffusion_webui_distributed_tpu.fleet import (
+    admission as fleet_admission,
+)
+from stable_diffusion_webui_distributed_tpu.fleet import (
+    policy as fleet_policy,
+)
+from stable_diffusion_webui_distributed_tpu.fleet import (
+    quotas as fleet_quotas,
+)
 from stable_diffusion_webui_distributed_tpu.obs import (
     prometheus as obs_prom,
 )
@@ -80,6 +91,7 @@ class Ticket:
         self.job = job
         self.bucketed = bucketed
         self.request_id = request_id
+        self.fleet_class = ""           # resolved class name (fleet on)
         self.enqueued = time.monotonic()
         self.enqueued_perf = time.perf_counter()
         self.done = threading.Event()
@@ -103,7 +115,8 @@ class ServingDispatcher:
     """Leader/follower coalescer in front of a single :class:`Engine`."""
 
     def __init__(self, engine, bucketer: Optional[ShapeBucketer] = None,
-                 window: Optional[float] = None, config=None) -> None:
+                 window: Optional[float] = None, config=None,
+                 calibration=None) -> None:
         self.engine = engine
         self.bucketer = bucketer or (
             ShapeBucketer.from_config(config) if config is not None
@@ -119,6 +132,20 @@ class ServingDispatcher:
         self._exec_lock = threading.Lock()
         self._groups: Dict[tuple, _Group] = {}  # guarded-by: _lock
         self._tickets: Dict[str, Ticket] = {}  # guarded-by: _lock
+        # fleet tier (SDTPU_FLEET, fleet/): the bare exec lock becomes a
+        # weighted-fair gate with per-tenant quotas and ETA-SLO admission.
+        # Disabled (default): all three stay None and every fleet branch
+        # below is dead code — dispatch order, seeds and outputs are
+        # byte-identical to the pre-fleet build.
+        self.fleet: Optional[fleet_policy.FleetGate] = None
+        self.quotas: Optional[fleet_quotas.QuotaLedger] = None
+        self.admission: Optional[fleet_admission.AdmissionController] = None
+        if fleet_policy.fleet_enabled(config):
+            self.fleet = fleet_policy.FleetGate(
+                fleet_policy.FleetPolicy.from_env())
+            self.quotas = fleet_quotas.QuotaLedger.from_env()
+            self.admission = fleet_admission.AdmissionController(
+                calibration=calibration)
 
     # -- public API --------------------------------------------------------
 
@@ -139,6 +166,12 @@ class ServingDispatcher:
         # root the obs trace here for direct callers; HTTP ingress already
         # minted one for API traffic (maybe_request joins it)
         with obs_spans.maybe_request(rid, name=f"serve.{job}"):
+            fleet_class = ""
+            if self.fleet is not None:
+                # quota + SLO gate BEFORE any metrics accounting: a
+                # never-admitted request must not feed the queue-wait
+                # histogram or the ETA calibration
+                fleet_class = self._admit_fleet(payload)
             bypass = bool(payload.init_images or payload.enable_hr)
             if bypass:
                 run, bucketed = payload.model_copy(), False
@@ -151,6 +184,7 @@ class ServingDispatcher:
                         payload.width, payload.height))
 
             ticket = Ticket(payload, run, job, bucketed, rid)
+            ticket.fleet_class = fleet_class
             with self._lock:
                 self._tickets[rid] = ticket
             try:
@@ -187,6 +221,122 @@ class ServingDispatcher:
         else:
             pad = METRICS.avg_padding_ratio()
         return {"queue_wait": wait, "padding_overhead": pad}
+
+    def set_calibration(self, cal, benchmark=None) -> None:
+        """Attach an ETA calibration (scheduler/eta.py) so SLO admission
+        can predict completion times; without one every request is
+        accepted untouched."""
+        if self.admission is not None:
+            self.admission.calibration = cal
+            self.admission.benchmark = benchmark
+
+    def fleet_summary(self) -> Optional[Dict[str, object]]:
+        """Live fleet state for /internal/status; None when fleet is off."""
+        if self.fleet is None:
+            return None
+        out = self.fleet.summary()
+        if self.quotas is not None:
+            out["quotas"] = self.quotas.summary()
+        if self.admission is not None:
+            cal = self.admission.calibration
+            out["admission"] = {
+                "calibrated": bool(cal is not None and cal.benchmarked),
+                "fewstep": self.admission.fewstep,
+            }
+        return out
+
+    # -- fleet admission ---------------------------------------------------
+
+    def _admit_fleet(self, payload) -> str:
+        """Quota + ETA-SLO gate (fleet/): returns the resolved class name,
+        mutates the payload on degrade (step-cache cadence / few-step
+        budget), raises :class:`fleet_admission.FleetRejected` on refusal."""
+        pol = self.fleet.policy.resolve(payload.priority_class)
+        slo = float(getattr(payload, "slo_s", 0.0) or 0.0)
+        if slo > 0:  # per-request SLO overrides the class default
+            pol = dataclasses.replace(pol, slo_s=slo)
+        tenant = str(getattr(payload, "tenant", "") or "default")
+        obs_prom.fleet_count("requests", tenant=tenant,
+                             **{"class": pol.name})
+        if self.quotas is not None and self.quotas.enabled:
+            retry = self.quotas.admit(tenant, payload.total_images)
+            if retry is not None:
+                obs_prom.fleet_count("quota_throttles", tenant=tenant)
+                raise fleet_admission.FleetRejected(
+                    "quota",
+                    f"tenant {tenant!r} image quota exhausted",
+                    retry_after=retry)
+        decision = self.admission.decide(payload, pol,
+                                         self.eta_overhead(payload))
+        obs_prom.fleet_count("admissions", decision=decision.action,
+                             **{"class": pol.name})
+        if decision.action == "reject":
+            raise fleet_admission.FleetRejected(
+                "slo", decision.detail,
+                retry_after=max(1.0, (decision.predicted_s or 0.0)
+                                - (decision.slo_s or 0.0)))
+        if decision.action == "degrade":
+            ov = dict(payload.override_settings or {})
+            ov.update(decision.overrides)
+            # marker key: consumers read override_settings with .get only,
+            # so this rides through to result.parameters for visibility
+            ov["fleet_degraded"] = decision.detail
+            payload.override_settings = ov
+            if decision.steps:
+                payload.steps = decision.steps
+        return pol.name
+
+    @contextlib.contextmanager
+    def _device(self, tickets: List[Ticket], images: int):
+        """The engine-execution critical section.  Fleet off: the plain
+        exec lock, untouched.  Fleet on: a weighted-fair gate entry per
+        dispatch, with the chunk-boundary preempt hook installed when the
+        work is preemptible and preempt-safe."""
+        if self.fleet is None:
+            with self._exec_lock:
+                yield
+            return
+        gate = self.fleet
+        with self._lock:
+            tickets = list(tickets)  # group lists grow until close
+        lead = tickets[0]
+        pol = gate.policy.resolve(lead.fleet_class)
+        for t in tickets[1:]:
+            p = gate.policy.resolve(t.fleet_class)
+            if p.weight > pol.weight:
+                pol = p  # a mixed group schedules at its strongest class
+        entry = fleet_policy.GateEntry(
+            pol, tenant=str(getattr(lead.payload, "tenant", "") or "default"),
+            cost=max(1, images), request_id=lead.request_id)
+        gate.acquire(entry)
+        engine = self.engine
+        prev = engine.preempt_hook
+        hooked = False
+        try:
+            if pol.preemptible \
+                    and all(self._preempt_safe(t.run) for t in tickets):
+                # save/restore prev so nested installs (an interloper that
+                # is itself preemptible) cannot clear the outer hook
+                engine.preempt_hook = fleet_policy.EnginePreemptHook(
+                    gate, entry)
+                hooked = True
+            yield
+        finally:
+            if hooked:
+                engine.preempt_hook = prev
+            gate.release(entry)
+
+    def _preempt_safe(self, p) -> bool:
+        """May this payload yield mid-denoise?  LoRA-tagged work cannot —
+        an interloper's tagless run restores pristine params under it —
+        and adaptive samplers drive a separate loop without the hook."""
+        from stable_diffusion_webui_distributed_tpu.samplers import (
+            kdiffusion as kd,
+        )
+
+        if "<lora:" in (p.prompt or ""):
+            return False
+        return not kd.resolve_sampler(p.sampler_name).adaptive
 
     # -- grouping ----------------------------------------------------------
 
@@ -241,7 +391,7 @@ class ServingDispatcher:
             return
         if self.window > 0:
             time.sleep(self.window)
-        with self._exec_lock:
+        with self._device(g.tickets, g.images):
             # close AFTER taking the engine: followers kept joining while
             # a previous batch held the device (continuous batching)
             with self._lock:
@@ -252,9 +402,16 @@ class ServingDispatcher:
             start_perf = time.perf_counter()
             leader_req = obs_spans.current()
             for t in g.tickets:
+                if t.cancelled.is_set():
+                    # never dispatched: its wait must not feed the
+                    # histogram or the ETA calibration
+                    continue
                 wait = start - t.enqueued
                 METRICS.record_queue_wait(wait)
                 obs_prom.observe_hist("queue_wait", wait)
+                if self.fleet is not None:
+                    obs_prom.fleet_observe_queue_wait(
+                        self.fleet.policy.resolve(t.fleet_class).name, wait)
                 obs_spans.add_span(t.obs_req, "queue_wait", t.enqueued_perf,
                                    start_perf - t.enqueued_perf)
             dsp = None
@@ -282,20 +439,26 @@ class ServingDispatcher:
                     t.done.set()
 
     def _run_solo(self, ticket: Ticket) -> None:
-        with self._exec_lock:
-            start = time.monotonic()
-            wait = start - ticket.enqueued
-            METRICS.record_queue_wait(wait)
-            obs_prom.observe_hist("queue_wait", wait)
-            obs_spans.add_span(ticket.obs_req, "queue_wait",
-                               ticket.enqueued_perf,
-                               time.perf_counter() - ticket.enqueued_perf)
-            METRICS.record_dispatch(1)
+        with self._device([ticket], ticket.run.total_images):
             try:
                 self.engine.state.begin_request()
                 if ticket.cancelled.is_set():
+                    # cancelled before dispatch: record neither a queue
+                    # wait nor a dispatch (queue-depth accounting fix)
                     ticket.result = self._empty_result(ticket)
                     return
+                wait = time.monotonic() - ticket.enqueued
+                METRICS.record_queue_wait(wait)
+                obs_prom.observe_hist("queue_wait", wait)
+                if self.fleet is not None:
+                    obs_prom.fleet_observe_queue_wait(
+                        self.fleet.policy.resolve(
+                            ticket.fleet_class).name, wait)
+                obs_spans.add_span(ticket.obs_req, "queue_wait",
+                                   ticket.enqueued_perf,
+                                   time.perf_counter()
+                                   - ticket.enqueued_perf)
+                METRICS.record_dispatch(1)
                 with obs_spans.span("dispatch.device", requests=1):
                     result = self.engine.generate_range(
                         ticket.run, 0, None, ticket.job)

@@ -1,0 +1,44 @@
+"""FL001 fixture: lock-bearing fleet classes with unannotated containers.
+
+Analyzed under a spoofed ``stable_diffusion_webui_distributed_tpu/fleet/``
+relative path (the rule is path-scoped); never imported.
+"""
+
+import collections
+import threading
+
+
+class BadQueue:
+    """Has a lock, but its containers carry no guarded-by annotations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []                       # FL001: no annotation
+        self._tags = {}                          # FL001: no annotation
+        self._pending = collections.deque()      # FL001: no annotation
+        self._vt = 0.0  # scalar: out of FL001's scope (LK001 territory)
+
+    def push(self, item):
+        with self._lock:
+            self._entries.append(item)
+
+
+class GoodQueue:
+    """Annotated containers: clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []  # guarded-by: _lock
+        self._tags = {}  # guarded-by: _lock
+
+    def push(self, item):
+        with self._lock:
+            self._entries.append(item)
+            self._tags[item] = 1
+
+
+class PolicyTable:
+    """No lock attribute: immutable-after-init, exempt from FL001."""
+
+    def __init__(self):
+        self.classes = {"interactive": 8.0}

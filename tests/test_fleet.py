@@ -1,0 +1,679 @@
+"""Fleet scheduler: priority classes, WFQ gate, quotas, SLO admission,
+chunk-boundary preemption, and slice autoscale decisions.
+
+The policy layer (fleet/) is pure host code driven by injectable clocks,
+so everything except the engine-resume tests runs with zero device work.
+The preemption tests use the TINY pipeline and assert the tentpole
+acceptance property directly: a preempted-then-resumed request is
+byte-identical to an unpreempted run and triggers zero new compiles.
+"""
+
+import threading
+import time
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.fleet.admission import (
+    AdmissionController, FleetRejected, cadence_speedup,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.policy import (
+    BATCH, BEST_EFFORT, INTERACTIVE, EnginePreemptHook, FleetGate,
+    FleetPolicy, GateEntry, WeightedFairQueue, _parse_class_weights,
+    fleet_enabled,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.quotas import (
+    QuotaLedger, TokenBucket,
+)
+from stable_diffusion_webui_distributed_tpu.fleet.slices import (
+    AutoscaleEngine, SliceInfo, SliceRegistry,
+)
+from stable_diffusion_webui_distributed_tpu.obs import (
+    prometheus as obs_prom,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.eta import (
+    EtaCalibration,
+)
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def payload(**kw):
+    defaults = dict(prompt="a cow", steps=20, width=512, height=512,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+# -- policy + class table ----------------------------------------------------
+
+class TestPolicy:
+    def test_parse_class_weights(self):
+        assert _parse_class_weights("interactive:8, batch:2") == {
+            "interactive": 8.0, "batch": 2.0}
+        with pytest.raises(ValueError):
+            _parse_class_weights("interactive:zero")
+        with pytest.raises(ValueError):
+            _parse_class_weights("interactive:-1")
+
+    def test_resolve(self):
+        pol = FleetPolicy()
+        assert pol.resolve("").name == INTERACTIVE
+        assert pol.resolve(None).name == INTERACTIVE
+        assert pol.resolve("no-such-class").name == BEST_EFFORT
+        assert pol.resolve(BATCH).preemptible
+        assert not pol.resolve(INTERACTIVE).preemptible
+        assert BATCH in pol.resolve(INTERACTIVE).preempts
+        assert pol.resolve(INTERACTIVE).slo_s == 30.0
+
+    def test_custom_class_scheduled_like_batch(self):
+        pol = FleetPolicy(weights={"research": 4.0})
+        cp = pol.resolve("research")
+        assert cp.weight == 4.0 and cp.preemptible and cp.slo_s is None
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_FLEET_CLASSES", "interactive:16,batch:4")
+        monkeypatch.setenv("SDTPU_SLO_INTERACTIVE_S", "12")
+        pol = FleetPolicy.from_env()
+        assert pol.resolve(INTERACTIVE).weight == 16.0
+        assert pol.resolve(BATCH).weight == 4.0
+        assert pol.resolve(INTERACTIVE).slo_s == 12.0
+
+    def test_fleet_enabled_precedence(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_FLEET", raising=False)
+        assert fleet_enabled() is False
+
+        class Cfg:
+            fleet_enabled = True
+
+        assert fleet_enabled(Cfg()) is True
+        monkeypatch.setenv("SDTPU_FLEET", "0")
+        assert fleet_enabled(Cfg()) is False  # env wins over config
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        assert fleet_enabled() is True
+
+
+# -- weighted-fair queue -----------------------------------------------------
+
+class TestWFQ:
+    def test_weight_order(self):
+        clk = FakeClock()
+        pol = FleetPolicy(aging_s=1e9)
+        q = WeightedFairQueue(aging_s=1e9, clock=clk)
+        e_best = GateEntry(pol.resolve(BEST_EFFORT), cost=1)
+        e_batch = GateEntry(pol.resolve(BATCH), cost=1)
+        e_int = GateEntry(pol.resolve(INTERACTIVE), cost=1)
+        for e in (e_best, e_batch, e_int):  # arrival order worst-first
+            q.push(e)
+        order = []
+        for _ in range(3):
+            e = q.select()
+            order.append(e.policy.name)
+            q.remove(e)
+        assert order == [INTERACTIVE, BATCH, BEST_EFFORT]
+        assert q.select() is None
+
+    def test_fair_share_within_class(self):
+        # same class, two tenants: the second tenant's first image goes
+        # ahead of the first tenant's backlog (tags accumulate per flow)
+        clk = FakeClock()
+        pol = FleetPolicy(aging_s=1e9)
+        q = WeightedFairQueue(aging_s=1e9, clock=clk)
+        a1 = GateEntry(pol.resolve(BATCH), tenant="a", cost=1)
+        a2 = GateEntry(pol.resolve(BATCH), tenant="a", cost=1)
+        b1 = GateEntry(pol.resolve(BATCH), tenant="b", cost=1)
+        q.push(a1)
+        q.push(a2)
+        q.push(b1)
+        order = []
+        for _ in range(3):
+            e = q.select()
+            order.append(e)
+            q.remove(e)
+        assert order.index(b1) < order.index(a2)
+
+    def test_aging_override(self):
+        clk = FakeClock()
+        pol = FleetPolicy(aging_s=10.0)
+        q = WeightedFairQueue(aging_s=10.0, clock=clk)
+        e_old = GateEntry(pol.resolve(BEST_EFFORT), cost=1)
+        q.push(e_old)
+        clk.advance(11.0)
+        e_new = GateEntry(pol.resolve(INTERACTIVE), cost=1)
+        q.push(e_new)
+        # best_effort has waited past the aging bound: served first even
+        # though interactive's tag is far smaller
+        assert q.select() is e_old
+
+    def test_repush_keeps_tag(self):
+        clk = FakeClock()
+        pol = FleetPolicy(aging_s=1e9)
+        q = WeightedFairQueue(aging_s=1e9, clock=clk)
+        e_batch = GateEntry(pol.resolve(BATCH), cost=4)
+        q.push(e_batch)
+        q.remove(e_batch)  # it ran, then got preempted
+        tag = e_batch.tag
+        later = GateEntry(pol.resolve(BATCH), tenant="other", cost=4)
+        q.push(later)
+        q.push(e_batch, recost=False)
+        assert e_batch.tag == tag  # no double charge
+        # the preempted runner resumes ahead of later-arrived equal work
+        assert q.select() is e_batch
+
+    def test_depth_by_class(self):
+        pol = FleetPolicy()
+        q = WeightedFairQueue()
+        q.push(GateEntry(pol.resolve(BATCH)))
+        q.push(GateEntry(pol.resolve(BATCH)))
+        q.push(GateEntry(pol.resolve(INTERACTIVE)))
+        assert q.depth() == 3
+        assert q.depth_by_class() == {BATCH: 2, INTERACTIVE: 1}
+
+
+# -- quotas ------------------------------------------------------------------
+
+class TestQuotas:
+    def test_token_bucket_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=1.0, burst=2.0, clock=clk)
+        assert b.try_take(2)
+        assert not b.try_take(1)
+        assert b.retry_after(1) == pytest.approx(1.0)
+        clk.advance(1.5)
+        assert b.try_take(1)
+        assert b.available() == pytest.approx(0.5)
+
+    def test_ledger_per_tenant_isolation(self):
+        clk = FakeClock()
+        led = QuotaLedger(images_per_minute=60.0, burst=2.0, clock=clk)
+        assert led.enabled
+        assert led.admit("a", 2) is None
+        retry = led.admit("a", 1)
+        assert retry is not None and retry >= 1.0
+        assert led.admit("b", 2) is None  # b has its own bucket
+        s = led.summary()
+        assert s["admitted"] == 2 and s["throttled"] == 1
+        assert set(s["tenants"]) == {"a", "b"}
+
+    def test_disabled_ledger_admits_everything(self):
+        led = QuotaLedger(images_per_minute=0.0)
+        assert not led.enabled
+        for _ in range(100):
+            assert led.admit("t", 100) is None
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_QUOTA_IPM", "120")
+        monkeypatch.setenv("SDTPU_QUOTA_BURST", "3")
+        led = QuotaLedger.from_env()
+        assert led.rate == pytest.approx(2.0)
+        assert led.burst == 3.0
+
+
+# -- admission ---------------------------------------------------------------
+
+class TestAdmission:
+    # cal at 6 ipm, benchmark defaults (20 steps, 512x512) -> a default
+    # payload predicts 10s of compute; the pinned zero-MPE history keeps
+    # the process-wide ETA gauge (other tests may feed it) out of the math
+    def controller(self):
+        return AdmissionController(
+            calibration=EtaCalibration(avg_ipm=6.0,
+                                       eta_percent_error=[0.0]),
+            fewstep=12)
+
+    def test_accept_when_inside_slo(self):
+        pol = FleetPolicy(slo_interactive_s=15.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol)
+        assert d.action == "accept"
+        assert d.predicted_s == pytest.approx(10.0, rel=0.01)
+
+    def test_accept_without_calibration(self):
+        pol = FleetPolicy(slo_interactive_s=1.0).resolve(INTERACTIVE)
+        d = AdmissionController(calibration=None).decide(payload(), pol)
+        assert d.action == "accept"
+        d = AdmissionController(
+            calibration=EtaCalibration()).decide(payload(), pol)
+        assert d.action == "accept"
+
+    def test_accept_without_slo(self):
+        d = self.controller().decide(
+            payload(), FleetPolicy().resolve(BATCH))
+        assert d.action == "accept"
+
+    def test_degrade_cadence(self):
+        # 10s * speedup(2)=0.725 -> 7.25s fits an 8s SLO
+        pol = FleetPolicy(slo_interactive_s=8.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol)
+        assert d.action == "degrade"
+        assert d.overrides == {"deepcache": 2}
+        assert d.steps is None
+        assert d.predicted_s == pytest.approx(
+            10.0 * cadence_speedup(2), rel=0.01)
+
+    def test_degrade_fewstep(self):
+        # cadence alone tops out at 10*0.633=6.33s; a 6s SLO needs the
+        # few-step rung: 12 steps -> 6s compute * 0.633 = 3.8s
+        pol = FleetPolicy(slo_interactive_s=6.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol)
+        assert d.action == "degrade"
+        assert d.overrides == {"deepcache": 3}
+        assert d.steps == 12
+
+    def test_reject_when_nothing_fits(self):
+        pol = FleetPolicy(slo_interactive_s=2.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol)
+        assert d.action == "reject"
+        assert "2.0s" in d.detail
+
+    def test_queue_wait_is_never_rescaled(self):
+        # 10s compute + 5s wait; an SLO of 12s can be met by cadence 2
+        # only because the wait stays additive (10*0.725+5 = 12.25 > 12
+        # fails; cadence 3: 10*0.633+5 = 11.3 fits)
+        pol = FleetPolicy(slo_interactive_s=12.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol,
+                                     {"queue_wait": 5.0})
+        assert d.action == "degrade"
+        assert d.overrides == {"deepcache": 3}
+
+    def test_rejected_exception_floors_retry_after(self):
+        e = FleetRejected("slo", "x", retry_after=0.01)
+        assert e.retry_after == 1.0
+        assert e.reason == "slo"
+
+
+# -- gate + preemption (host-only) -------------------------------------------
+
+class TestGate:
+    def test_acquire_release_orders_waiters(self):
+        pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
+        gate = FleetGate(pol)
+        holder = GateEntry(pol.resolve(BATCH), cost=1)
+        gate.acquire(holder)
+        order = []
+        done = []
+
+        def waiter(name, cls):
+            e = GateEntry(pol.resolve(cls), cost=1)
+            gate.acquire(e)
+            order.append(name)
+            gate.release(e)
+            done.append(name)
+
+        threads = [
+            threading.Thread(target=waiter, args=("best", BEST_EFFORT)),
+            threading.Thread(target=waiter, args=("inter", INTERACTIVE)),
+        ]
+        threads[0].start()
+        while gate.queue.depth() < 1:
+            time.sleep(0.005)
+        threads[1].start()
+        while gate.queue.depth() < 2:
+            time.sleep(0.005)
+        gate.release(holder)
+        for t in threads:
+            t.join(timeout=10)
+        assert order == ["inter", "best"]
+        assert done == ["inter", "best"]
+
+    def test_should_yield_only_for_entitled_waiters(self):
+        pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
+        gate = FleetGate(pol)
+        batch = GateEntry(pol.resolve(BATCH), cost=1)
+        gate.acquire(batch)
+        assert not gate.should_yield(batch)  # empty queue
+        # another batch job does NOT preempt a batch runner
+        gate.queue.push(GateEntry(pol.resolve(BATCH), cost=1))
+        assert not gate.should_yield(batch)
+        gate.queue.push(GateEntry(pol.resolve(INTERACTIVE), cost=1))
+        assert gate.should_yield(batch)
+        # interactive runners are never asked to yield
+        gate.release(batch)
+
+    def test_quantum_suppresses_early_yield(self):
+        clk = FakeClock()
+        pol = FleetPolicy(aging_s=1e9, quantum_s=5.0)
+        gate = FleetGate(pol, clock=clk)
+        batch = GateEntry(pol.resolve(BATCH), cost=1)
+        gate.acquire(batch)
+        gate.queue.push(GateEntry(pol.resolve(INTERACTIVE), cost=1))
+        assert not gate.should_yield(batch)  # inside the quantum
+        clk.advance(6.0)
+        assert gate.should_yield(batch)
+        gate.release(batch)
+
+    def test_yield_device_runs_interloper_then_resumes(self):
+        obs_prom.clear_histograms()
+        pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
+        gate = FleetGate(pol)
+        batch = GateEntry(pol.resolve(BATCH), cost=4)
+        gate.acquire(batch)
+        log = []
+
+        def interactive():
+            e = GateEntry(pol.resolve(INTERACTIVE), cost=1)
+            gate.acquire(e)
+            log.append("interactive-ran")
+            gate.release(e)
+
+        t = threading.Thread(target=interactive)
+        t.start()
+        while not gate.should_yield(batch):
+            time.sleep(0.005)
+        gate.yield_device(batch)  # blocks until interactive releases
+        log.append("batch-resumed")
+        t.join(timeout=10)
+        gate.release(batch)
+        assert log == ["interactive-ran", "batch-resumed"]
+        assert gate.preemption_count() == 1
+        snap = obs_prom.FLEET_COUNTERS["preemptions"].snapshot()
+        assert snap == {(BATCH,): 1.0}
+
+    def test_hook_is_thread_filtered(self):
+        pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
+        gate = FleetGate(pol)
+        batch = GateEntry(pol.resolve(BATCH), cost=1)
+        gate.acquire(batch)
+        gate.queue.push(GateEntry(pol.resolve(INTERACTIVE), cost=1))
+        hook = EnginePreemptHook(gate, batch)
+        assert hook.should_yield()  # owner thread
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(hook.should_yield()))
+        t.start()
+        t.join()
+        assert seen == [False]  # interloper thread: no-op
+        gate.release(batch)
+
+    def test_summary_shape(self):
+        gate = FleetGate(FleetPolicy())
+        s = gate.summary()
+        assert s["queue_depth"] == 0 and s["running_class"] is None
+        assert s["classes"][INTERACTIVE]["weight"] == 8.0
+
+
+# -- slice registry + autoscale ----------------------------------------------
+
+class TestSlices:
+    def test_registry_clamps_replicas(self):
+        reg = SliceRegistry()
+        reg.register(SliceInfo("s0", group="sdxl/bf16", min_replicas=1,
+                               max_replicas=3))
+        reg.set_replicas("s0", 99)
+        assert reg.get("s0").replicas == 3
+        reg.set_replicas("s0", 0)
+        assert reg.get("s0").replicas == 1
+        assert reg.for_group("sdxl/bf16")[0].name == "s0"
+
+    def test_scale_up_down_with_cooldown(self):
+        clk = FakeClock()
+        reg = SliceRegistry()
+        reg.register(SliceInfo("s0", max_replicas=2))
+        p95 = [10.0]
+        seen = []
+        eng = AutoscaleEngine(reg, quantile_source=lambda: p95[0],
+                              up_p95_s=5.0, down_p95_s=0.5,
+                              cooldown_s=60.0, clock=clk)
+        eng.add_hook(seen.append)
+
+        d = eng.decide()
+        assert [x.direction for x in d] == ["up"]
+        assert reg.get("s0").replicas == 2
+        assert eng.decide() == []  # cooldown
+        clk.advance(61.0)
+        assert eng.decide() == []  # at max_replicas
+        p95[0] = 0.1
+        clk.advance(61.0)
+        d = eng.decide()
+        assert [x.direction for x in d] == ["down"]
+        assert reg.get("s0").replicas == 1
+        assert len(seen) == 2 and len(eng.history()) == 2
+        assert len(eng.summary()["decisions"]) == 2
+
+    def test_default_signal_reads_fleet_histograms(self):
+        obs_prom.clear_histograms()
+        assert AutoscaleEngine(SliceRegistry(),
+                               up_p95_s=5.0, down_p95_s=0.5,
+                               cooldown_s=0.0).quantile_source
+        obs_prom.fleet_observe_queue_wait("batch", 8.0)
+        assert obs_prom.fleet_queue_wait_p95() > 5.0
+        obs_prom.clear_histograms()
+        assert obs_prom.fleet_queue_wait_p95() == 0.0
+
+
+# -- engine preempt-resume (device work on the TINY model) -------------------
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY  # noqa: E402
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine  # noqa: E402
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (  # noqa: E402
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (  # noqa: E402
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (  # noqa: E402
+    ServingDispatcher,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS  # noqa: E402
+from test_pipeline import init_params  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState())
+
+
+@pytest.fixture(scope="module")
+def bucketer():
+    return ShapeBucketer(shapes=[(32, 32), (48, 48)], batches=[4])
+
+
+def tiny_payload(**kw):
+    defaults = dict(prompt="a cow", steps=4, width=32, height=32,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+class OneShotHook:
+    """Deterministic stand-in for the fleet gate's EnginePreemptHook:
+    fires at the second chunk boundary, runs a full interactive request
+    re-entrantly on the same engine (exactly what a device yield does —
+    the interloper executes while the batch loop's state sleeps in its
+    stack frame), then never fires again."""
+
+    def __init__(self, engine, interloper):
+        self.engine = engine
+        self.interloper = interloper
+        self.polls = 0
+        self.fired = 0
+        self.result = None
+
+    def should_yield(self):
+        self.polls += 1
+        return self.fired == 0 and self.polls >= 2
+
+    def yield_device(self):
+        self.fired += 1
+        self.result = self.engine.generate_range(
+            self.interloper, 0, None, "txt2img")
+
+
+class TestEnginePreemptResume:
+    def test_resume_is_byte_identical_with_zero_new_compiles(self, engine):
+        batch_p = tiny_payload(steps=8, seed=70)
+        inter_p = tiny_payload(steps=4, seed=71)
+
+        # warmup: build both executables and pin the baseline bytes
+        baseline = engine.generate_range(batch_p, 0, None, "txt2img")
+        warm_inter = engine.generate_range(inter_p, 0, None, "txt2img")
+        assert baseline.images and warm_inter.images
+
+        METRICS.clear()
+        hook = OneShotHook(engine, inter_p)
+        engine.preempt_hook = hook
+        try:
+            preempted = engine.generate_range(batch_p, 0, None, "txt2img")
+        finally:
+            engine.preempt_hook = None
+
+        assert hook.fired == 1
+        # the interloper that ran INSIDE the yield is itself intact
+        assert hook.result.images == warm_inter.images
+        # tentpole acceptance: resumed output is byte-identical and the
+        # resumed chunks reused the warmed executables (zero compiles)
+        assert preempted.images == baseline.images
+        assert preempted.seeds == baseline.seeds
+        assert preempted.infotexts == baseline.infotexts
+        assert METRICS.compile_count("chunk") == 0
+
+    def test_hook_cleared_between_requests(self, engine):
+        assert engine.preempt_hook is None
+
+
+# -- dispatcher integration --------------------------------------------------
+
+class TestDispatcherFleet:
+    def test_fleet_off_by_default(self, engine, bucketer, monkeypatch):
+        monkeypatch.delenv("SDTPU_FLEET", raising=False)
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        assert disp.fleet is None and disp.quotas is None
+        assert disp.admission is None
+        assert disp.fleet_summary() is None
+
+    def test_fleet_on_submit_and_summary(self, engine, bucketer,
+                                         monkeypatch):
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        obs_prom.clear_histograms()
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        assert disp.fleet is not None
+        r = disp.submit(tiny_payload(seed=30))
+        assert len(r.images) == 1
+        # class resolved (empty -> interactive) and counted per tenant
+        snap = obs_prom.FLEET_COUNTERS["requests"].snapshot()
+        assert snap == {("default", INTERACTIVE): 1.0}
+        s = disp.fleet_summary()
+        assert s["queue_depth"] == 0 and s["running_class"] is None
+        assert s["quotas"]["enabled"] is False
+        assert s["admission"]["calibrated"] is False
+
+    def test_quota_throttle_raises_429_material(self, engine, bucketer,
+                                                monkeypatch):
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        monkeypatch.setenv("SDTPU_QUOTA_IPM", "60")
+        monkeypatch.setenv("SDTPU_QUOTA_BURST", "1")
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        assert disp.submit(tiny_payload(seed=31)).images
+        with pytest.raises(FleetRejected) as exc:
+            disp.submit(tiny_payload(seed=32))
+        assert exc.value.reason == "quota"
+        assert exc.value.retry_after >= 1.0
+        assert disp.fleet_summary()["quotas"]["throttled"] == 1
+
+    def test_slo_degrade_marks_result(self, engine, bucketer, monkeypatch):
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        METRICS.clear()  # empty wait history -> queue_wait floor = 0
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        disp.set_calibration(
+            EtaCalibration(avg_ipm=6.0, eta_percent_error=[0.0]))
+        # 20 steps at 32x32 predicts 10 * (32*32)/(512*512) = 0.0390625s;
+        # an SLO of 0.03s fits at cadence 2 (x0.725 = 0.0283s)
+        r = disp.submit(tiny_payload(steps=20, seed=33, slo_s=0.03))
+        ov = r.parameters["override_settings"]
+        assert ov["deepcache"] == 2
+        assert "cadence 2" in ov["fleet_degraded"]
+        assert len(r.images) == 1
+
+    def test_slo_reject_feeds_no_metrics(self, engine, bucketer,
+                                         monkeypatch):
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        METRICS.clear()
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        disp.set_calibration(
+            EtaCalibration(avg_ipm=6.0, eta_percent_error=[0.0]))
+        with pytest.raises(FleetRejected) as exc:
+            disp.submit(tiny_payload(steps=20, seed=34, slo_s=0.001))
+        assert exc.value.reason == "slo"
+        # never admitted: nothing reached the request/queue-wait metrics
+        s = METRICS.summary()
+        assert s["requests"] == 0 and s["dispatches"] == 0
+        assert METRICS.avg_queue_wait() == 0.0
+
+    def test_cancelled_ticket_records_no_queue_wait(self, engine, bucketer,
+                                                    monkeypatch):
+        # satellite fix: a cancelled-before-dispatch request must not
+        # inflate the queue-wait histogram or the ETA calibration
+        monkeypatch.delenv("SDTPU_FLEET", raising=False)
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        METRICS.clear()
+        rid = "cancel-me"
+        # batch 5 > ladder top 4 -> solo path; hold the exec lock so the
+        # ticket is still queued when cancel() lands
+        p = tiny_payload(batch_size=5, seed=35, request_id=rid)
+        results = {}
+        disp._exec_lock.acquire()
+        try:
+            t = threading.Thread(
+                target=lambda: results.update(r=disp.submit(p)))
+            t.start()
+            while not disp.cancel(rid):
+                time.sleep(0.005)
+        finally:
+            disp._exec_lock.release()
+        t.join(timeout=30)
+        r = results["r"]
+        assert r.images == [] and r.parameters.get("cancelled") is True
+        s = METRICS.summary()
+        assert s["requests"] == 1  # admitted and counted...
+        assert s["dispatches"] == 0  # ...but never dispatched
+        assert METRICS.avg_queue_wait() == 0.0  # and no wait recorded
+
+
+@pytest.mark.slow
+class TestDispatcherPreemption:
+    def test_preempted_batch_byte_identical_and_recompile_free(
+            self, engine, bucketer, monkeypatch):
+        """End-to-end tentpole run: a long preemptible batch job yields
+        the device to interactive traffic at a chunk boundary and its
+        output is byte-identical to an unpreempted run, with zero new
+        compiles after warmup."""
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        monkeypatch.setenv("SDTPU_FLEET_QUANTUM_S", "0")
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        # batch 5 > ladder top -> solo preemptible run; 32 steps at
+        # chunk_size 4 gives 8 yield points
+        batch_p = dict(steps=32, batch_size=5, seed=40,
+                       priority_class=BATCH, tenant="batch-tenant")
+        inter_p = dict(steps=4, seed=41)
+
+        baseline = disp.submit(tiny_payload(**batch_p))
+        disp.submit(tiny_payload(**inter_p))  # warm the interactive shape
+
+        METRICS.clear()
+        results = {}
+        t = threading.Thread(target=lambda: results.update(
+            batch=disp.submit(tiny_payload(**batch_p))))
+        t.start()
+        deadline = time.monotonic() + 60
+        while disp.fleet.summary()["running_class"] != BATCH:
+            assert time.monotonic() < deadline, "batch job never started"
+            time.sleep(0.002)
+        results["inter"] = disp.submit(tiny_payload(**inter_p))
+        t.join(timeout=120)
+
+        assert disp.fleet.preemption_count() >= 1
+        assert results["batch"].images == baseline.images
+        assert results["batch"].seeds == baseline.seeds
+        assert results["inter"].images  # interloper completed
+        assert METRICS.compile_count("chunk") == 0

@@ -70,7 +70,7 @@ class TestRepoGate:
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
                      "EV001", "OB001", "LK001", "LK002", "LK003",
-                     "AL001", "AL002"):
+                     "FL001", "AL001", "AL002"):
             assert rule in RULES and RULES[rule]
 
 
@@ -130,6 +130,25 @@ class TestFixtures:
         # the same file under its real tests/lint_fixtures/ path is out of
         # the serving/pipeline/obs scope: zero findings
         assert not _fixture_findings("timing_bad.py")
+
+    def test_fleet_family(self):
+        # FL001 is path-scoped to fleet/ modules: load the fixture under a
+        # spoofed fleet/ rel path so the unguarded-container checks fire
+        rel = "stable_diffusion_webui_distributed_tpu/fleet/fleet_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "fleet_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert found == {
+            ("FL001", 16),  # self._entries = [] without guarded-by
+            ("FL001", 17),  # self._tags = {}
+            ("FL001", 18),  # collections.deque()
+        }
+        # GoodQueue (annotated) and PolicyTable (no lock) stay clean
+
+    def test_fleet_rule_is_path_scoped(self):
+        # the same file under its real tests/lint_fixtures/ path is outside
+        # the fleet/ scope: zero FL001 findings (LK001 on the unannotated
+        # attrs cannot fire either — they were never declared guarded)
+        assert not _fixture_findings("fleet_bad.py")
 
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")

@@ -348,6 +348,86 @@ class TestTraceReport:
         assert trace_report.main([str(tmp_path / "missing.json")]) == 2
 
 
+class TestFleetReport:
+    """tools/fleet_report.py: the BENCH_fleet.json digest — per-class
+    rows, the FIFO-vs-fleet p95 delta, and the exit-code contract."""
+
+    @staticmethod
+    def _doc(**over):
+        doc = {
+            "metric": "tiny_fleet_interactive_p95_s",
+            "device": "cpu",
+            "classes": {
+                "interactive": {"requests": 6, "completed": 6,
+                                "throttled": 0, "rejected": 0,
+                                "p50_s": 2.0, "p95_s": 4.0,
+                                "slo_s": 10.0, "slo_attainment": 1.0},
+                "batch": {"requests": 3, "completed": 3, "throttled": 0,
+                          "rejected": 0, "p50_s": 20.0, "p95_s": 30.0},
+                "best_effort": {"requests": 10, "completed": 8,
+                                "throttled": 2, "rejected": 0,
+                                "p50_s": 12.0, "p95_s": 16.0},
+            },
+            "baseline_fifo": {
+                "interactive": {"p95_s": 16.0, "slo_attainment": 0.5},
+                "batch": {"p95_s": 24.0},
+                "best_effort": {"p95_s": 20.0},
+            },
+            "preemptions": 2,
+            "quota_throttle_rate": 0.105,
+            "queue_wait_p95_s": 12.5,
+            "errors": [],
+        }
+        doc.update(over)
+        return doc
+
+    def test_summary_rows_and_delta(self):
+        import fleet_report
+
+        s = fleet_report.build_summary(self._doc())
+        by_cls = {r["class"]: r for r in s["rows"]}
+        assert list(by_cls) == ["interactive", "batch", "best_effort"]
+        # fleet p95 4.0 vs FIFO 16.0: a 75% cut, signed negative
+        assert by_cls["interactive"]["p95_delta_pct"] == -75.0
+        # batch pays for the interactive win: positive delta
+        assert by_cls["batch"]["p95_delta_pct"] == 25.0
+        assert s["completed"] == 17
+        assert s["slo_attainment"] == 1.0
+        assert s["fifo_slo_attainment"] == 0.5
+        assert s["preemptions"] == 2
+
+    def test_missing_baseline_renders_dashes(self):
+        import fleet_report
+
+        s = fleet_report.build_summary(self._doc(baseline_fifo={}))
+        assert all(r["p95_delta_pct"] is None for r in s["rows"])
+        text = fleet_report.render(s)
+        assert "interactive" in text and "-" in text
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        import fleet_report
+
+        p = tmp_path / "BENCH_fleet.json"
+        p.write_text(json.dumps(self._doc()))
+        assert fleet_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "interactive SLO" in out and "preemptions: 2" in out
+
+        assert fleet_report.main([str(p), "--json"]) == 0
+        digest = json.loads(capsys.readouterr().out)
+        assert digest["completed"] == 17
+
+        dead = self._doc()
+        for cls in dead["classes"].values():
+            cls["completed"] = 0
+        (tmp_path / "dead.json").write_text(json.dumps(dead))
+        assert fleet_report.main([str(tmp_path / "dead.json")]) == 1
+
+        (tmp_path / "garbage.json").write_text("{not json")
+        assert fleet_report.main([str(tmp_path / "garbage.json")]) == 2
+        assert fleet_report.main([str(tmp_path / "missing.json")]) == 2
+
+
 class TestClassifyTriage:
     def test_rules(self):
         c = tpu_claim_probe.classify_triage

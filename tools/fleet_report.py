@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Summarize BENCH_fleet.json (bench.py --fleet) as a per-class table.
+
+The bench replays one mixed-tenant open-loop workload twice — FIFO
+baseline, then the weighted-fair fleet gate — and this report renders the
+comparison: per-class p50/p95 completion latency, interactive SLO
+attainment, chunk-boundary preemption count and the quota-throttle rate.
+
+    python tools/fleet_report.py                    # ./BENCH_fleet.json
+    python tools/fleet_report.py path/to/BENCH_fleet.json
+    python tools/fleet_report.py --json             # machine-readable
+
+Exit codes: 0 report rendered; 1 artifact is degenerate (no completed
+requests — the bench died mid-workload); 2 artifact missing/unparseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+CLASSES = ("interactive", "batch", "best_effort")
+
+
+def _fmt(v, suffix=""):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def _delta_pct(fleet, fifo):
+    """Signed percent change fleet vs FIFO (negative = fleet faster)."""
+    if not fifo or fleet is None or fifo is None:
+        return None
+    return round((fleet - fifo) / fifo * 100.0, 1)
+
+
+def build_summary(doc):
+    """Digest the BENCH_fleet.json document into the report rows."""
+    classes = doc.get("classes", {}) or {}
+    fifo = doc.get("baseline_fifo", {}) or {}
+    rows = []
+    for cls in CLASSES:
+        c = classes.get(cls, {}) or {}
+        f = fifo.get(cls, {}) or {}
+        rows.append({
+            "class": cls,
+            "requests": c.get("requests", 0),
+            "completed": c.get("completed", 0),
+            "throttled": c.get("throttled", 0),
+            "rejected": c.get("rejected", 0),
+            "p50_s": c.get("p50_s"),
+            "p95_s": c.get("p95_s"),
+            "fifo_p95_s": f.get("p95_s"),
+            "p95_delta_pct": _delta_pct(c.get("p95_s"), f.get("p95_s")),
+        })
+    inter = classes.get("interactive", {}) or {}
+    fifo_inter = fifo.get("interactive", {}) or {}
+    completed = sum(r["completed"] for r in rows)
+    return {
+        "metric": doc.get("metric"),
+        "device": doc.get("device"),
+        "rows": rows,
+        "completed": completed,
+        "slo_s": inter.get("slo_s"),
+        "slo_attainment": inter.get("slo_attainment"),
+        "fifo_slo_attainment": fifo_inter.get("slo_attainment"),
+        "preemptions": doc.get("preemptions", 0),
+        "quota_throttle_rate": doc.get("quota_throttle_rate"),
+        "queue_wait_p95_s": doc.get("queue_wait_p95_s"),
+        "errors": doc.get("errors", []),
+    }
+
+
+def render(summary):
+    lines = [f"fleet scheduling report — {summary['metric']} "
+             f"on {summary['device']}",
+             "",
+             f"{'class':<12} {'req':>4} {'done':>5} {'thrtl':>6} "
+             f"{'rej':>4} {'p50':>9} {'p95':>9} {'fifo p95':>9} "
+             f"{'Δp95':>8}"]
+    for r in summary["rows"]:
+        lines.append(
+            f"{r['class']:<12} {r['requests']:>4} {r['completed']:>5} "
+            f"{r['throttled']:>6} {r['rejected']:>4} "
+            f"{_fmt(r['p50_s'], 's'):>9} {_fmt(r['p95_s'], 's'):>9} "
+            f"{_fmt(r['fifo_p95_s'], 's'):>9} "
+            f"{_fmt(r['p95_delta_pct'], '%'):>8}")
+    lines.append("")
+    lines.append(f"interactive SLO ({_fmt(summary['slo_s'], 's')}): "
+                 f"{_fmt(summary['slo_attainment'])} attainment under the "
+                 f"fleet gate vs {_fmt(summary['fifo_slo_attainment'])} "
+                 f"FIFO")
+    lines.append(f"preemptions: {summary['preemptions']}   "
+                 f"quota-throttle rate: "
+                 f"{_fmt(summary['quota_throttle_rate'])}   "
+                 f"queue-wait p95: "
+                 f"{_fmt(summary['queue_wait_p95_s'], 's')}")
+    if summary["errors"]:
+        lines.append(f"errors ({len(summary['errors'])}): "
+                     + "; ".join(str(e) for e in summary["errors"][:4]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default="BENCH_fleet.json",
+                    help="bench.py --fleet artifact "
+                         "(default ./BENCH_fleet.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digested summary as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"fleet_report: {args.path} not found "
+              f"(run: python bench.py --fleet)", file=sys.stderr)
+        return 2
+    try:
+        with open(args.path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"fleet_report: cannot parse {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    summary = build_summary(doc)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    if summary["completed"] <= 0:
+        print("fleet_report: no completed requests — the bench died "
+              "mid-workload", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
