@@ -272,15 +272,28 @@ class FleetGate:
 
     def acquire(self, entry: GateEntry, recost: bool = True) -> None:
         self.queue.push(entry, recost=recost)
-        with self._cv:
-            while self._running is not None \
-                    or self.queue.select() is not entry:
-                # timeout: aging promotions change the selection without a
-                # release event; a bounded wait keeps the bound live
-                self._cv.wait(0.25)
+        try:
+            with self._cv:
+                while self._running is not None \
+                        or self.queue.select() is not entry:
+                    # timeout: aging promotions change the selection
+                    # without a release event; a bounded wait keeps the
+                    # bound live
+                    self._cv.wait(0.25)
+                self.queue.remove(entry)
+                self._running = entry
+                self._run_started = self._clock()
+        except BaseException:
+            # a dying waiter (e.g. KeyboardInterrupt inside cv.wait) must
+            # not leave its entry queued: select() would keep returning
+            # the orphan — oldest entry wins the aging branch — and every
+            # other waiter would deadlock permanently
             self.queue.remove(entry)
-            self._running = entry
-            self._run_started = self._clock()
+            with self._cv:
+                if self._running is entry:
+                    self._running = None
+                self._cv.notify_all()
+            raise
 
     def release(self, entry: GateEntry) -> None:
         with self._cv:

@@ -44,6 +44,12 @@ class TokenBucket:
                 return True
             return False
 
+    def refund(self, n: float) -> None:
+        """Return ``n`` previously-taken tokens (capped at the burst
+        ceiling) — for withdrawals whose request was never admitted."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + max(0.0, n))
+
     def retry_after(self, n: float) -> float:
         """Seconds until ``n`` tokens will be available (0 if now)."""
         with self._lock:
@@ -110,6 +116,15 @@ class QuotaLedger:
         with self._lock:
             self._throttled += 1
         return max(1.0, b.retry_after(float(images)))
+
+    def refund(self, tenant: str, images: int) -> None:
+        """Give back tokens withdrawn for a request that was rejected
+        after the quota check (e.g. by SLO admission): tenants are charged
+        only for work the fleet actually accepted, and cannot be
+        quota-throttled by their own rejected requests."""
+        if not self.enabled:
+            return
+        self._bucket(tenant).refund(float(images))
 
     def summary(self) -> Dict[str, object]:
         with self._lock:

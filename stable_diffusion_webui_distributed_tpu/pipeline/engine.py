@@ -1331,24 +1331,38 @@ class Engine:
         while pos < end:
             if self.state.flag.interrupted:
                 break
-            hook = self.preempt_hook
+            # preemption is a dispatcher(sync)-only protocol: the
+            # non-sync path (parallel/stage_pipeline) shares the progress
+            # record across device groups and never paces on fences, so a
+            # yield there would hand over the device with work in flight
+            hook = self.preempt_hook if sync else None
             if hook is not None and hook.should_yield():
                 # chunk-boundary yield: drain the in-flight chunk so the
                 # device is quiet, then block in the gate until the fleet
                 # hands it back. Everything the loop needs (carry, cache,
                 # valid, pos) lives in this frame — resumption is
                 # byte-identical and reuses the same executables.
-                if sync and pending is not None:
+                if pending is not None:
                     pending[0].block_until_ready()
                     done += pending[1]
                     self.state.step(done)
                     pending = None
+                interrupted_before_yield = self.state.flag.interrupted
                 hook.yield_device()
-                # the interloper drove the shared progress record; restore
-                # this range's view before continuing
+                # an interloper that carried <lora:...> tags patched the
+                # live params during the yield; re-resolve THIS payload's
+                # adapter set so the remaining chunks run on the weights
+                # the request started with (tagless -> pristine base)
+                self._apply_prompt_loras(payload)
+                # the interloper also drove the shared progress record and
+                # interrupt latch (its begin_request clears the flag, and
+                # an interrupt aimed at IT may still be latched); restore
+                # this range's view of both
                 self.state.begin(job, end - start_step)
                 if done:
                     self.state.step(done)
+                self.state.restore_interrupt(interrupted_before_yield)
+                continue  # re-check the restored latch at the loop top
             length = min(self.chunk_size, end - pos)
             # drop units whose guidance window misses this chunk entirely —
             # a gated-off ControlNet forward is ~half a UNet of wasted MXU

@@ -258,6 +258,7 @@ class ServingDispatcher:
         tenant = str(getattr(payload, "tenant", "") or "default")
         obs_prom.fleet_count("requests", tenant=tenant,
                              **{"class": pol.name})
+        metered = 0
         if self.quotas is not None and self.quotas.enabled:
             retry = self.quotas.admit(tenant, payload.total_images)
             if retry is not None:
@@ -266,11 +267,17 @@ class ServingDispatcher:
                     "quota",
                     f"tenant {tenant!r} image quota exhausted",
                     retry_after=retry)
+            metered = payload.total_images
         decision = self.admission.decide(payload, pol,
                                          self.eta_overhead(payload))
         obs_prom.fleet_count("admissions", decision=decision.action,
                              **{"class": pol.name})
         if decision.action == "reject":
+            if metered:
+                # the quota withdrawal preceded the SLO verdict; a
+                # rejected request performed no work, so its tokens
+                # go back
+                self.quotas.refund(tenant, metered)
             raise fleet_admission.FleetRejected(
                 "slo", decision.detail,
                 retry_after=max(1.0, (decision.predicted_s or 0.0)

@@ -206,6 +206,18 @@ class TestQuotas:
         assert s["admitted"] == 2 and s["throttled"] == 1
         assert set(s["tenants"]) == {"a", "b"}
 
+    def test_refund_restores_tokens(self):
+        # REVIEW fix: a request rejected AFTER the quota withdrawal (SLO)
+        # must not leave its tenant charged for work never performed
+        clk = FakeClock()
+        led = QuotaLedger(images_per_minute=60.0, burst=2.0, clock=clk)
+        assert led.admit("a", 2) is None
+        led.refund("a", 2)
+        assert led.admit("a", 2) is None  # tokens are back, no refill used
+        led.refund("a", 100)
+        assert led._bucket("a").available() == pytest.approx(2.0)  # capped
+        QuotaLedger(images_per_minute=0.0).refund("x", 5)  # disabled: no-op
+
     def test_disabled_ledger_admits_everything(self):
         led = QuotaLedger(images_per_minute=0.0)
         assert not led.enabled
@@ -379,6 +391,28 @@ class TestGate:
         snap = obs_prom.FLEET_COUNTERS["preemptions"].snapshot()
         assert snap == {(BATCH,): 1.0}
 
+    def test_acquire_cleans_up_on_wait_exception(self, monkeypatch):
+        # REVIEW fix: a waiter that dies inside cv.wait must remove its
+        # queue entry — an orphan wins the aging branch forever and
+        # deadlocks every later waiter
+        pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
+        gate = FleetGate(pol)
+        holder = GateEntry(pol.resolve(BATCH), cost=1)
+        gate.acquire(holder)
+
+        def dying_wait(*a, **k):
+            raise KeyboardInterrupt
+
+        monkeypatch.setattr(gate._cv, "wait", dying_wait)
+        with pytest.raises(KeyboardInterrupt):
+            gate.acquire(GateEntry(pol.resolve(INTERACTIVE), cost=1))
+        assert gate.queue.depth() == 0  # no orphan left behind
+        monkeypatch.undo()
+        gate.release(holder)
+        nxt = GateEntry(pol.resolve(INTERACTIVE), cost=1)
+        gate.acquire(nxt)  # the gate still serves later waiters
+        gate.release(nxt)
+
     def test_hook_is_thread_filtered(self):
         pol = FleetPolicy(aging_s=1e9, quantum_s=0.0)
         gate = FleetGate(pol)
@@ -538,6 +572,97 @@ class TestEnginePreemptResume:
         assert preempted.infotexts == baseline.infotexts
         assert METRICS.compile_count("chunk") == 0
 
+    def test_resume_restores_pristine_params_after_lora_interloper(
+            self, engine, monkeypatch):
+        # REVIEW high fix: an interloper whose prompt carries <lora:...>
+        # patches engine.params during the yield; the preempted (tagless)
+        # job's remaining chunks must re-run on pristine weights
+        from test_adapters import make_lora_sd
+        loras = {"style": make_lora_sd(scale=2.0)}
+        monkeypatch.setattr(engine, "lora_provider", loras.get)
+        batch_p = tiny_payload(steps=8, seed=72)
+        inter_p = tiny_payload(steps=4, seed=73,
+                               prompt="a cow <lora:style:1.0>")
+
+        baseline = engine.generate_range(batch_p, 0, None, "txt2img")
+        warm_inter = engine.generate_range(inter_p, 0, None, "txt2img")
+        engine.set_loras(())  # back to pristine before the preempted run
+
+        hook = OneShotHook(engine, inter_p)
+        engine.preempt_hook = hook
+        try:
+            preempted = engine.generate_range(batch_p, 0, None, "txt2img")
+        finally:
+            engine.preempt_hook = None
+        assert hook.fired == 1
+        assert hook.result.images == warm_inter.images  # interloper intact
+        # the interloper's adapter merge did not leak into the resume
+        assert preempted.images == baseline.images
+        engine.set_loras(())
+
+    def test_interloper_interrupt_does_not_truncate_resumed_job(
+            self, engine):
+        # REVIEW medium fix, direction 1: an interrupt raised while the
+        # interloper holds the device targets the interloper — the
+        # preempted job must resume with a clear latch
+        batch_p = tiny_payload(steps=8, seed=74)
+        inter_p = tiny_payload(steps=4, seed=75)
+        baseline = engine.generate_range(batch_p, 0, None, "txt2img")
+
+        class InterruptingHook(OneShotHook):
+            def yield_device(self):
+                super().yield_device()
+                # the latch is still set when the yielded job reacquires
+                self.engine.state.flag.interrupt()
+
+        hook = InterruptingHook(engine, inter_p)
+        engine.preempt_hook = hook
+        try:
+            resumed = engine.generate_range(batch_p, 0, None, "txt2img")
+        finally:
+            engine.preempt_hook = None
+            engine.state.flag.clear()
+        assert hook.fired == 1
+        assert resumed.images == baseline.images  # ran to completion
+        assert resumed.seeds == baseline.seeds
+
+    def test_pre_yield_interrupt_survives_interloper(self, engine):
+        # REVIEW medium fix, direction 2: an interrupt that lands between
+        # the loop-top latch check and the yield must survive the
+        # interloper's begin_request and stop the resumed job
+        batch_p = tiny_payload(steps=8, seed=76)
+        inter_p = tiny_payload(steps=4, seed=77)
+        warm_inter = engine.generate_range(inter_p, 0, None, "txt2img")
+
+        class LatchThenYieldHook(OneShotHook):
+            def should_yield(self):
+                fire = super().should_yield()
+                if fire:
+                    self.engine.state.flag.interrupt()
+                return fire
+
+            def yield_device(self):
+                self.fired += 1
+                # the interloper is a top-level request: its
+                # begin_request clears the process-global latch
+                self.engine.state.begin_request()
+                self.result = self.engine.generate_range(
+                    self.interloper, 0, None, "txt2img")
+
+        hook = LatchThenYieldHook(engine, inter_p)
+        engine.preempt_hook = hook
+        try:
+            engine.state.begin_request()
+            engine.generate_range(batch_p, 0, None, "txt2img")
+        finally:
+            engine.preempt_hook = None
+            engine.state.flag.clear()
+        assert hook.fired == 1
+        assert hook.result.images == warm_inter.images  # interloper intact
+        # the saved latch was restored on resume: the preempted job
+        # stopped at the yield boundary instead of running to completion
+        assert engine.state.progress.interrupted
+
     def test_hook_cleared_between_requests(self, engine):
         assert engine.preempt_hook is None
 
@@ -609,6 +734,21 @@ class TestDispatcherFleet:
         s = METRICS.summary()
         assert s["requests"] == 0 and s["dispatches"] == 0
         assert METRICS.avg_queue_wait() == 0.0
+
+    def test_slo_reject_refunds_quota(self, engine, bucketer, monkeypatch):
+        # REVIEW fix: an SLO-rejected request must hand its quota tokens
+        # back — a 1-token bucket still admits the next fitting request
+        monkeypatch.setenv("SDTPU_FLEET", "1")
+        monkeypatch.setenv("SDTPU_QUOTA_IPM", "60")
+        monkeypatch.setenv("SDTPU_QUOTA_BURST", "1")
+        METRICS.clear()
+        disp = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        disp.set_calibration(
+            EtaCalibration(avg_ipm=6.0, eta_percent_error=[0.0]))
+        with pytest.raises(FleetRejected) as exc:
+            disp.submit(tiny_payload(steps=20, seed=36, slo_s=0.001))
+        assert exc.value.reason == "slo"
+        assert disp.submit(tiny_payload(seed=37)).images
 
     def test_cancelled_ticket_records_no_queue_wait(self, engine, bucketer,
                                                     monkeypatch):
