@@ -586,6 +586,34 @@ def _psnr_b64(imgs_a, imgs_b):
     return sum(vals) / max(1, len(vals))
 
 
+def _ssim_b64(imgs_a, imgs_b, window=7):
+    """Mean SSIM across paired base64-PNG image lists (luma, uniform
+    window — same metric as tests/quality.py)."""
+    import numpy as np
+
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        b64png_to_array,
+    )
+
+    def gray(img):
+        img = np.asarray(img, dtype=np.float64)
+        return img @ np.array([0.299, 0.587, 0.114]) if img.ndim == 3 else img
+
+    vals = []
+    for a64, b64 in zip(imgs_a, imgs_b):
+        ga, gb = gray(b64png_to_array(a64)), gray(b64png_to_array(b64))
+        wa = np.lib.stride_tricks.sliding_window_view(ga, (window, window))
+        wb = np.lib.stride_tricks.sliding_window_view(gb, (window, window))
+        mu_a, mu_b = wa.mean(axis=(-1, -2)), wb.mean(axis=(-1, -2))
+        var_a, var_b = wa.var(axis=(-1, -2)), wb.var(axis=(-1, -2))
+        cov = (wa * wb).mean(axis=(-1, -2)) - mu_a * mu_b
+        c1, c2 = (0.01 * 255.0) ** 2, (0.03 * 255.0) ** 2
+        s = ((2 * mu_a * mu_b + c1) * (2 * cov + c2)) / (
+            (mu_a**2 + mu_b**2 + c1) * (var_a + var_b + c2))
+        vals.append(float(s.mean()))
+    return sum(vals) / max(1, len(vals))
+
+
 def _random_params(family):
     """Flax-init (random) params for the quality cell: the zero-init bench
     weights produce identical images on ANY compute path, so PSNR against
@@ -732,6 +760,109 @@ def run_deepcache(tiny):
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_deepcache.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
+def run_int8(tiny):
+    """Int8 x step-cache grid (ISSUE 7): ONE random-weights tiny engine
+    serves every cell through the per-request ``precision`` override
+    (pipeline/precision.py) — the same engine/variant-module path
+    production dispatch uses. Each int8 cell reports UNet FLOPs/image
+    (XLA cost analysis over the dispatched schedule), chunk compile
+    counts, and PSNR/SSIM against the bf16 cell at the SAME cadence, so
+    quantization error is isolated from step-cache error. Quality is the
+    platform-independent part; the 2x MXU rate is stated as peak basis,
+    not measured on CPU. Writes BENCH_int8.json."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+        GenerationState,
+    )
+    from stable_diffusion_webui_distributed_tpu.samplers import (
+        kdiffusion as kd,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    engine = Engine(C.TINY, _random_params(C.TINY), chunk_size=4,
+                    state=GenerationState())
+    p = GenerationPayload(prompt="a herd of cows", steps=8, width=32,
+                          height=32, batch_size=2, seed=42)
+    spec = kd.resolve_sampler(p.sampler_name)
+    cutoff = float(kd.build_sigmas(spec, engine.schedule,
+                                   p.steps)[p.steps // 2])
+
+    def cell(precision, cadence):
+        q = p.model_copy()
+        q.precision = precision
+        if cadence > 1:
+            q.override_settings = {"deepcache": cadence,
+                                   "cfg_cutoff": cutoff}
+        METRICS.clear()
+        r = engine.txt2img(q)
+        s = METRICS.summary()
+        return r, {
+            "cell": f"c{cadence}-{precision or 'bf16'}",
+            "precision": precision or "bf16",
+            "cadence": cadence,
+            "unet_flops_per_image": s["unet_flops_per_image"],
+            "chunk_executables": s["compiles"].get("chunk", 0),
+        }
+
+    cells = []
+    bf16_by_cadence = {}
+    for cadence in (1, 3):
+        base_r, base_c = cell("", cadence)  # bf16 control
+        bf16_by_cadence[cadence] = base_r
+        cells.append(base_c)
+        for precision in ("int8", "int8+conv"):
+            r, c = cell(precision, cadence)
+            c["psnr_db_vs_bf16"] = round(
+                _psnr_b64(r.images, base_r.images), 2)
+            c["ssim_vs_bf16"] = round(
+                _ssim_b64(r.images, base_r.images), 4)
+            cells.append(c)
+            print(f"bench: int8 {c['cell']}: flops/image "
+                  f"{c['unet_flops_per_image']:.3e}, "
+                  f"psnr {c['psnr_db_vs_bf16']} dB, "
+                  f"ssim {c['ssim_vs_bf16']}", file=sys.stderr)
+
+    quantized = [c for c in cells if c["precision"] != "bf16"]
+    min_psnr = min(c["psnr_db_vs_bf16"] for c in quantized)
+    min_ssim = min(c["ssim_vs_bf16"] for c in quantized)
+    out = {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "int8_min_psnr_db",
+        "value": min_psnr,
+        "unit": "db_vs_bf16_same_cadence",
+        "vs_baseline": None,
+        # the tier-1 floors (tests/test_quality_int8.py); the grid must
+        # clear them at every step-cache rung or the fleet's int8 degrade
+        # rung is trading SLO misses for broken images
+        "psnr_floor_db": 20.0,
+        "ssim_floor": 0.6,
+        "min_ssim": min_ssim,
+        "pass": bool(min_psnr >= 20.0 and min_ssim >= 0.6),
+        # why int8 at all: the MXU int8 rate is 2x bf16 on v5e/v4 — the
+        # FLOPs/image above run against the doubled peak (bench --config
+        # MFU cells state the same basis)
+        "mxu_peak_ratio_int8_vs_bf16": 2.0,
+        "steps": p.steps,
+        "cfg_cutoff_sigma": round(cutoff, 4),
+        "family": C.TINY.name,
+        "cells": cells,
+        "device": dev.device_kind,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_int8.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
@@ -1065,6 +1196,10 @@ def main() -> None:
                     help="fleet-scheduler comparison: mixed-tenant "
                          "open-loop workload, FIFO vs WFQ gate; writes "
                          "BENCH_fleet.json (CPU-safe)")
+    ap.add_argument("--int8", action="store_true",
+                    help="int8 x step-cache grid: FLOPs/image, compile "
+                         "counts, PSNR/SSIM vs bf16 per cell; writes "
+                         "BENCH_int8.json (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -1105,6 +1240,8 @@ def main() -> None:
             print(json.dumps(run_fleet(tiny)))
         elif args.deepcache:
             print(json.dumps(run_deepcache(tiny)))
+        elif args.int8:
+            print(json.dumps(run_int8(tiny)))
         else:
             print(json.dumps(run_config(args.config, tiny)))
     except BaseException:

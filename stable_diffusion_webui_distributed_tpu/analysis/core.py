@@ -35,6 +35,7 @@ RULES = {
     "TP003": "mutation of closed-over Python state inside a traced function",
     "RC001": "request/env-derived value in a static jit argument",
     "RC002": "traced function closes over a request/env-derived scalar",
+    "RC003": "raw precision read outside pipeline/precision.py resolution",
     "EV001": "raw os.environ read outside runtime/config.py",
     "OB001": "time.time() used for a duration on a serving/pipeline/obs path",
     "LK001": "guarded attribute accessed without holding its lock",

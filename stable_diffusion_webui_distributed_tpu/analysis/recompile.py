@@ -1,4 +1,4 @@
-"""Recompile-hazard rules (RC001/RC002).
+"""Recompile-hazard rules (RC001/RC002/RC003).
 
 Every distinct value of a static jit argument — and every distinct value a
 traced function closes over at trace time — is a new entry in XLA's compile
@@ -26,6 +26,15 @@ Sinks:
   ``# sdtpu-lint: jitted(static=N[,M...])``.
 - RC002: a function passed to jit/scan in this scope whose free variables
   include a tainted name (a closed-over trace-time constant).
+- RC003: a raw serving-precision read outside the sanctioned resolution
+  modules — ``SDTPU_UNET_INT8[_CONV]`` env reads, ``.get("precision")``
+  on an override dict, or ``payload.precision`` attribute reads. The
+  precision name is a STATIC compile-key and serving-group-key axis
+  (pipeline/engine.py / serving/dispatcher.py), so every consumer must go
+  through ``pipeline/precision.py``'s ``resolve``/``bucket_precision``
+  (which bounds the value domain to the 3-rung ladder); a raw read is
+  either an unbounded key or a group-key bypass that would coalesce
+  int8 and bf16 requests into one executable.
 """
 
 from __future__ import annotations
@@ -280,10 +289,72 @@ def _check_function(mod: ModuleInfo, info: FuncInfo,
     return findings
 
 
+#: Modules allowed to read the raw precision knobs/fields — the policy
+#: env defaults (runtime/dtypes.py) and the resolution ladder itself
+#: (pipeline/precision.py). Everyone else goes through resolve().
+RC003_SANCTIONED = ("runtime/dtypes.py", "pipeline/precision.py")
+
+#: env knobs whose raw value is a precision static
+RC003_ENV_PREFIX = "SDTPU_UNET_INT8"
+
+
+def _rc003_offense(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """Why ``node`` is a raw precision read, or None."""
+    if isinstance(node, ast.Call):
+        if _is_env_read(mod, node) and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str) and \
+                node.args[0].value.startswith(RC003_ENV_PREFIX):
+            return f"raw {node.args[0].value} env read"
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value == "precision":
+            return 'raw .get("precision") override read'
+    if isinstance(node, ast.Attribute) and node.attr == "precision" and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in (PAYLOAD_PARAMS | {"run"}):
+        return f"raw {node.value.id}.precision attribute read"
+    return None
+
+
+def _check_precision_reads(mod: ModuleInfo) -> List[Finding]:
+    """RC003: module-wide scan (module level included); a read nested
+    inside a bucket*/clamp call is sanitized like RC001 taint."""
+    from .envrules import _enclosing_symbol
+
+    if mod.path.endswith(RC003_SANCTIONED):
+        return []
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, sanitized: bool) -> None:
+        if isinstance(node, ast.Call) and _sanitized(mod, node):
+            sanitized = True
+        if not sanitized:
+            why = _rc003_offense(mod, node)
+            if why is not None:
+                findings.append(Finding(
+                    "RC003", mod.path, node.lineno,
+                    _enclosing_symbol(mod, node.lineno),
+                    f"{why}: the serving precision is a static compile-key "
+                    f"and group-key axis — resolve it through "
+                    f"pipeline/precision.py (resolve/bucket_precision) so "
+                    f"the value domain stays on the 3-rung ladder and "
+                    f"dispatch grouping sees the same name the engine "
+                    f"compiles"))
+                return  # one finding per offending expression
+        for child in ast.iter_child_nodes(node):
+            walk(child, sanitized)
+
+    walk(mod.tree, False)
+    return findings
+
+
 def check(modules: List[ModuleInfo]) -> List[Finding]:
     findings: List[Finding] = []
     for mod in modules:
         memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]] = {}
         for info in mod.funcs.values():
             findings.extend(_check_function(mod, info, memo))
+        findings.extend(_check_precision_reads(mod))
     return findings

@@ -96,10 +96,19 @@ class AdmissionController:
         wait = float(overhead.get("queue_wait", 0.0))
         pad = float(overhead.get("padding_overhead", 1.0))
 
+        # a request that already asks for a reduced precision is predicted
+        # at that precision's speed (payload channel; pipeline/precision.py)
+        from stable_diffusion_webui_distributed_tpu.pipeline import (
+            precision as precision_mod,
+        )
+
+        requested_prec = precision_mod.resolve(payload).name
+
         def predict(steps: Optional[int] = None) -> float:
             return eta.admission_eta(
                 cal, payload, benchmark=self.benchmark, steps=steps,
-                queue_wait=wait, padding_overhead=pad)
+                queue_wait=wait, padding_overhead=pad,
+                precision=requested_prec)
 
         predicted = predict()
         if predicted <= slo:
@@ -119,7 +128,7 @@ class AdmissionController:
                     overrides={"deepcache": cadence},
                     detail=f"step-cache cadence {cadence} applied to meet "
                            f"{slo:.1f}s SLO")
-        # last rung: deepest cadence + the few-step budget
+        # next rung: deepest cadence + the few-step budget
         cadence = CADENCE_RUNGS[-1]
         few = self.fewstep
         if few and 0 < few < payload.steps:
@@ -131,6 +140,27 @@ class AdmissionController:
                     overrides={"deepcache": cadence}, steps=few,
                     detail=f"few-step budget {few} + cadence {cadence} "
                            f"applied to meet {slo:.1f}s SLO")
+
+        # final rung before reject: the int8 serving precision stacked on
+        # cadence + few-step (pipeline/precision.py). The compute part
+        # scales by the calibration's per-precision factor (learned from
+        # int8's OWN samples, prior ~0.55); a request already asking for
+        # a non-bf16 precision has nothing left to give here. Quality
+        # stays inside the tier-1 PSNR/SSIM floors (test_quality_int8).
+        int8_factor = cal.precision_factor("int8")
+        if requested_prec == "bf16" and int8_factor < 1.0:
+            steps_arg = few if few and 0 < few < payload.steps else None
+            scaled = max(0.0, predict(steps=steps_arg) - wait) \
+                * cadence_speedup(cadence) * int8_factor + wait
+            if scaled <= slo:
+                overrides = {"deepcache": cadence, "precision": "int8"}
+                return AdmissionDecision(
+                    "degrade", scaled, slo,
+                    overrides=overrides, steps=steps_arg,
+                    detail=f"int8 precision + cadence {cadence}"
+                           + (f" + few-step budget {steps_arg}"
+                              if steps_arg else "")
+                           + f" applied to meet {slo:.1f}s SLO")
 
         return AdmissionDecision(
             "reject", predicted, slo,

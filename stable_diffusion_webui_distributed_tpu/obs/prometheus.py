@@ -167,6 +167,7 @@ def clear_histograms() -> None:
         _FLEET_QUEUE_WAIT.clear()
     for c in FLEET_COUNTERS.values():
         c.clear()
+    PRECISION_COUNTER.clear()
 
 
 # -- fleet tier (fleet/ package) --------------------------------------------
@@ -236,6 +237,14 @@ FLEET_COUNTERS: Dict[str, LabeledCounter] = {
         ("tenant", "class")),
 }
 
+#: Device dispatches by resolved serving precision (pipeline/precision.py;
+#: the dispatcher counts one increment per device batch, weighted by the
+#: requests it carried via :func:`count_precision`).
+PRECISION_COUNTER = LabeledCounter(
+    "sdtpu_dispatch_precision_total",
+    "Requests dispatched to the device by resolved serving precision.",
+    ("precision",))
+
 _FLEET_LOCK = threading.Lock()
 #: per-class queue-wait histograms, created on first observation
 _FLEET_QUEUE_WAIT: Dict[str, Histogram] = {}  # guarded-by: _FLEET_LOCK
@@ -245,6 +254,12 @@ def fleet_count(name: str, n: float = 1.0, **labels: Any) -> None:
     c = FLEET_COUNTERS.get(name)
     if c is not None:
         c.inc(n, **labels)
+
+
+def count_precision(precision: str, n: float = 1.0) -> None:
+    """One device dispatch carrying ``n`` requests at ``precision``."""
+    if precision:
+        PRECISION_COUNTER.inc(n, precision=precision)
 
 
 def fleet_observe_queue_wait(cls: str, seconds: float) -> None:
@@ -420,6 +435,7 @@ def render() -> str:
         lines.append(f'sdtpu_stage_samples{{stage="{_label(stage)}"}} '
                      f'{_fmt(st["count"])}')
 
+    lines.extend(PRECISION_COUNTER.render())
     for c in FLEET_COUNTERS.values():
         lines.extend(c.render())
     with _FLEET_LOCK:

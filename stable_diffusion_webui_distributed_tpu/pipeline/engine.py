@@ -43,6 +43,9 @@ from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
     channel_concat,
 )
 from stable_diffusion_webui_distributed_tpu.models.tokenizer import load_tokenizer
+from stable_diffusion_webui_distributed_tpu.pipeline import (
+    precision as precision_mod,
+)
 from stable_diffusion_webui_distributed_tpu.pipeline import stepcache
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
@@ -168,6 +171,21 @@ class Engine:
             attention_impl=attn_impl, mesh=attn_mesh,
             quant_linears=getattr(policy, "unet_int8", False),
             quant_convs=getattr(policy, "unet_int8_conv", False))
+        # Per-request serving precision (pipeline/precision.py): module
+        # variants keyed by canonical precision name. Flax modules are
+        # config holders — quantization happens at apply time and params
+        # are jit ARGUMENTS — so every variant shares the ONE param tree;
+        # only the traced computation differs. The policy-default name is
+        # seeded with the EXACT modules built above, so requests that
+        # specify nothing route to the unchanged executables byte-for-byte.
+        self._attn_impl = attn_impl
+        self._attn_mesh = attn_mesh
+        self._default_precision = precision_mod.policy_default(policy)
+        self._module_variants: Dict[str, Tuple[Any, Any]] = {
+            self._default_precision.name:
+                (self.unet, self.controlnet_module),
+        }  # guarded-by: _module_lock
+        self._module_lock = threading.Lock()
         vae_cfg = family.vae
         if getattr(policy, "decode_in_bf16", False) and \
                 vae_cfg.force_decoder_f32:
@@ -242,6 +260,42 @@ class Engine:
                 and k[3] == width and k[4] == height and k[5] == batch
                 for k in self._cache)
 
+    def _modules_for(self, precision_name: str) -> Tuple[Any, Any]:
+        """(UNet, ControlNet) module pair for a resolved precision name.
+
+        The policy-default name returns the EXACT constructor-built pair
+        (so the default path keeps its executables); other ladder rungs
+        are built lazily and cached per engine. Building a variant is
+        host-side module construction only — no params, no compile; the
+        compile happens when a chunk executable for that precision is
+        first dispatched (and is counted by METRICS like any other)."""
+        from stable_diffusion_webui_distributed_tpu.models.controlnet import (
+            ControlNet,
+        )
+
+        name = precision_mod.bucket_precision(
+            precision_name, self._default_precision.name)
+        with self._module_lock:
+            pair = self._module_variants.get(name)
+            if pair is None:
+                spec = precision_mod.from_name(name)
+                cd = self.policy.compute_dtype
+                unet = UNet(self.family.unet, dtype=cd,
+                            attention_impl=self._attn_impl,
+                            use_remat=self.policy.use_remat,
+                            mesh=self._attn_mesh,
+                            quant_linears=spec.quant_linears,
+                            quant_convs=spec.quant_convs)
+                cn = ControlNet(self.family.unet, dtype=cd,
+                                use_remat=self.policy.use_remat,
+                                attention_impl=self._attn_impl,
+                                mesh=self._attn_mesh,
+                                quant_linears=spec.quant_linears,
+                                quant_convs=spec.quant_convs)
+                pair = (unet, cn)
+                self._module_variants[name] = pair
+        return pair
+
     # sdtpu-lint: jitted(static=4)
     def _encode_fn(self) -> Callable:
         """(te_params, te2_params, ids, weights, clip_skip static) ->
@@ -294,14 +348,20 @@ class Engine:
 
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
                          added_u, added_c, controls=(), total_steps=1,
-                         inpaint_cond=None):
+                         inpaint_cond=None, unet=None, controlnet=None):
         """Closure: x0-prediction denoiser with classifier-free guidance and
         optional ControlNet residual injection.
 
         ``controls``: tuple of (cn_params, hint(B,H,W,3), weight, g_start,
         g_end) — residuals from every unit are summed, each gated by its
         guidance step-fraction window (webui unit semantics; the reference
-        serializes exactly these fields, control_net.py:20-79)."""
+        serializes exactly these fields, control_net.py:20-79).
+
+        ``unet``/``controlnet`` select a precision module variant
+        (:meth:`_modules_for`); None keeps the policy-default modules."""
+        unet = unet if unet is not None else self.unet
+        controlnet = (controlnet if controlnet is not None
+                      else self.controlnet_module)
         unet_params = {"params": unet_tree}
         v_pred = self.schedule.prediction_type == "v_prediction"
 
@@ -334,7 +394,7 @@ class Engine:
                 ).astype(jnp.float32)
                 hint_b = jnp.broadcast_to(hint, (B,) + hint.shape[1:])
                 hint2 = batch_concat([hint_b, hint_b])
-                rs = self.controlnet_module.apply(
+                rs = controlnet.apply(
                     {"params": cn_params}, both, tb, ctx, hint2, added)
                 rs = tuple(r.astype(jnp.float32) * gate for r in rs)
                 residuals = rs if residuals is None else tuple(
@@ -348,8 +408,8 @@ class Engine:
                 cond2 = batch_concat(
                     [inpaint_cond, inpaint_cond]).astype(both.dtype)
                 unet_in = channel_concat([both, cond2])
-            out = self.unet.apply(unet_params, unet_in, tb, ctx, added,
-                                  control_residuals=residuals)
+            out = unet.apply(unet_params, unet_in, tb, ctx, added,
+                             control_residuals=residuals)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
             guided = out_u + cfg_scale * (out_c - out_u)
             if v_pred:
@@ -364,15 +424,20 @@ class Engine:
                   height: int, batch: int, length: int,
                   masked: bool, n_controls: int = 0,
                   inpaint: bool = False,
-                  step_cache: bool = False) -> Callable:
+                  step_cache: bool = False,
+                  precision: str = "") -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
         index. Cache key excludes prompt/seed/cfg — those are data.
 
         ``step_cache`` selects the step-cache variant (deep-feature reuse
-        + CFG truncation, pipeline/stepcache.py): it is the ONLY static
-        bit the levers add to the compile key — the refresh cadence and
-        the cutoff step index travel as traced data — so a shape bucket
-        mints at most two chunk executables (plain + step-cache).
+        + CFG truncation, pipeline/stepcache.py): the refresh cadence and
+        the cutoff step index travel as traced data, so the on/off bit is
+        its only static key component. ``precision`` is the resolved
+        serving precision name (pipeline/precision.py) — necessarily
+        static (int8 is different HLO) but bounded to the 3-rung ladder,
+        and the int8 activation scales are traced data inside the
+        executable (dynamic per-tensor, ops/quant.py), so a shape bucket
+        mints at most 2 step-cache × 3 precision chunk executables.
         ControlNet chunks never take the cached path (the chunk loop
         routes active-CN windows to the plain executable).
 
@@ -382,11 +447,15 @@ class Engine:
         chunk (dead after each dispatch — donating halves peak latent
         HBM) and must not be touched once a later chunk is in flight."""
         spec = kd.resolve_sampler(sampler_name)
+        prec = precision_mod.bucket_precision(
+            precision, self._default_precision.name)
+        unet, cn_module = self._modules_for(prec)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
-               masked, n_controls, inpaint, self.family.name, step_cache)
+               masked, n_controls, inpaint, self.family.name, step_cache,
+               prec)
         if step_cache:
             return self._cached(key, lambda: self._build_stepcache_chunk(
-                spec, steps, batch, length, masked, inpaint))
+                spec, steps, batch, length, masked, inpaint, unet=unet))
 
         def build():
             sigmas = kd.build_sigmas(spec, self.schedule, steps)
@@ -397,7 +466,8 @@ class Engine:
                 denoise = self._make_denoise_fn(
                     unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
                     controls=controls, total_steps=steps,
-                    inpaint_cond=inpaint_cond if inpaint else None)
+                    inpaint_cond=inpaint_cond if inpaint else None,
+                    unet=unet, controlnet=cn_module)
                 base_step = kd.make_sampler_step(
                     spec, denoise, sigmas, image_keys)
 
@@ -427,7 +497,7 @@ class Engine:
 
     def _build_stepcache_chunk(self, spec, steps: int, batch: int,
                                length: int, masked: bool,
-                               inpaint: bool) -> Callable:
+                               inpaint: bool, unet=None) -> Callable:
         """Step-cache chunk executable (see _chunk_fn / stepcache.py).
 
         Scan state is (sampler carry, deep-feature cache, valid bit). The
@@ -441,6 +511,7 @@ class Engine:
         so crossing the cutoff never changes buffer shapes. Cadence and
         cutoff are traced int32 scalars (``lax.cond`` picks the variant
         per step); carry and cache are donated — dead after each chunk."""
+        unet = unet if unet is not None else self.unet
         sigmas = kd.build_sigmas(spec, self.schedule, steps)
         v_pred = self.schedule.prediction_type == "v_prediction"
         B = batch
@@ -501,13 +572,13 @@ class Engine:
                 def do_refresh(_):
                     def deep_full(_):
                         xi, tb, ctx, added = full_inputs(xin, t)
-                        return self.unet.apply(params, xi, tb, ctx, added,
-                                               cache_mode="deep")
+                        return unet.apply(params, xi, tb, ctx, added,
+                                          cache_mode="deep")
 
                     def deep_trunc(_):
                         xi, tb, ctx, added = cond_inputs(xin, t)
-                        d = self.unet.apply(params, xi, tb, ctx, added,
-                                            cache_mode="deep")
+                        d = unet.apply(params, xi, tb, ctx, added,
+                                       cache_mode="deep")
                         return batch_concat([d, d])
 
                     return jax.lax.cond(i >= cfg_stop, deep_trunc,
@@ -521,7 +592,7 @@ class Engine:
 
                     def eval_full(_):
                         xi, tb, ctx, added = full_inputs(xe, te)
-                        out = self.unet.apply(
+                        out = unet.apply(
                             params, xi, tb, ctx, added,
                             cache=new_cache, cache_mode="reuse")
                         out_u, out_c = jnp.split(
@@ -530,7 +601,7 @@ class Engine:
 
                     def eval_trunc(_):
                         xi, tb, ctx, added = cond_inputs(xe, te)
-                        out = self.unet.apply(
+                        out = unet.apply(
                             params, xi, tb, ctx, added,
                             cache=new_cache[B:], cache_mode="reuse")
                         return out.astype(jnp.float32)
@@ -569,13 +640,17 @@ class Engine:
 
     def _adaptive_attempt_fn(self, width: int, height: int, batch: int,
                              n_controls: int = 0,
-                             inpaint: bool = False) -> Callable:
+                             inpaint: bool = False,
+                             precision: str = "") -> Callable:
         """Compiled DPM-adaptive attempt (kd.make_adaptive_attempt): 3 CFG
         UNet evals + embedded-pair error norm in ONE dispatch, with the
         log-sigma position/step (s, h) as traced data — the whole adaptive
-        trajectory reuses a single executable."""
+        trajectory reuses a single executable (per resolved precision)."""
+        prec = precision_mod.bucket_precision(
+            precision, self._default_precision.name)
+        unet, cn_module = self._modules_for(prec)
         key = ("adaptive", width, height, batch, n_controls, inpaint,
-               self.family.name)
+               self.family.name, prec)
 
         def build():
             def run(unet_params, x, x_prev, s, h, rtol, atol, ctx_u, ctx_c,
@@ -583,7 +658,8 @@ class Engine:
                 denoise = self._make_denoise_fn(
                     unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
                     controls=controls, total_steps=1,
-                    inpaint_cond=inpaint_cond if inpaint else None)
+                    inpaint_cond=inpaint_cond if inpaint else None,
+                    unet=unet, controlnet=cn_module)
                 return kd.make_adaptive_attempt(denoise)(
                     x, x_prev, s, h, rtol, atol)
 
@@ -686,9 +762,10 @@ class Engine:
                 (p, h, float(w) if gs <= frac <= ge else 0.0, lo, hi)
                 for (p, h, w, lo, hi), (gs, ge) in zip(wide, windows))
 
-        fn = self._adaptive_attempt_fn(width, height, batch,
-                                       n_controls=len(controls),
-                                       inpaint=inpainting)
+        fn = self._adaptive_attempt_fn(
+            width, height, batch, n_controls=len(controls),
+            inpaint=inpainting,
+            precision=precision_mod.resolve(payload, self.policy).name)
 
         def attempt_fn(xx, x_prev, s, h, rtol, atol):
             with trace.STATS.timer("denoise_chunk"), \
@@ -1301,6 +1378,14 @@ class Engine:
         # as a traced step index.
         spec = kd.resolve_sampler(payload.sampler_name)
         sc = stepcache.resolve(payload)
+        # Serving precision (pipeline/precision.py): resolved once per
+        # range, static in the chunk executable key. A request that
+        # specifies nothing resolves to the policy default, whose module
+        # pair IS the constructor-built one — the default path routes to
+        # the unchanged executables byte-for-byte. The int8 activation
+        # scales are computed inside the traced fn per call (dynamic
+        # per-tensor, ops/quant.py), so they never recompile anything.
+        prec = precision_mod.resolve(payload, self.policy)
         cfg_stop = stepcache.cutoff_step(
             np.asarray(kd.build_sigmas(spec, self.schedule, steps)),
             sc.cutoff_sigma)
@@ -1376,7 +1461,8 @@ class Engine:
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
                                 batch, length, masked=masked,
                                 n_controls=len(active), inpaint=inpainting,
-                                step_cache=cached_chunk)
+                                step_cache=cached_chunk,
+                                precision=prec.name)
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate(f"denoise[{pos}:{pos + length}]"):
                 if cached_chunk:
@@ -1408,12 +1494,13 @@ class Engine:
         self.state.finish()
         self._record_unet_flops(dispatched, sc.cadence if use_cache else 1,
                                 cfg_stop, spec.evals_per_step, steps, batch,
-                                x.shape[1], x.shape[2], ctx_c.shape[1])
+                                x.shape[1], x.shape[2], ctx_c.shape[1],
+                                precision=prec.name)
         return carry.x
 
     def _record_unet_flops(self, dispatched, cadence, cfg_stop,
                            evals_per_step, steps, batch, lat_h, lat_w,
-                           ctx_len) -> None:
+                           ctx_len, precision: str = "") -> None:
         """Price a denoise range's dispatched chunk schedule with XLA
         cost_analysis (stepcache.FlopsAccountant) and fold the total into
         DispatchMetrics — the numerator of ``unet_flops_per_image`` on
@@ -1432,7 +1519,7 @@ class Engine:
             counts = stepcache.plan_schedule(
                 dispatched, cadence, cfg_stop, evals_per_step, steps)
             total = self._flops.request_flops(
-                counts, batch, lat_h, lat_w, ctx_len)
+                counts, batch, lat_h, lat_w, ctx_len, precision=precision)
             if total is not None:
                 METRICS.record_unet_flops(total)
         except Exception:

@@ -95,6 +95,14 @@ class GenerationPayload(BaseModel):
     priority_class: str = ""
     slo_s: float = 0.0
 
+    # serving precision (pipeline/precision.py): "bf16" | "int8" |
+    # "int8+conv"; also accepted as override_settings["precision"] (the
+    # field wins). Empty = the engine policy's env default
+    # (SDTPU_UNET_INT8[_CONV]) — so a request that says nothing is
+    # byte-identical to pre-precision behavior. Unknown values bucket to
+    # the default host-side rather than failing the request.
+    precision: str = ""
+
     # model / misc
     override_settings: Dict[str, Any] = Field(default_factory=dict)
     styles: List[str] = Field(default_factory=list)

@@ -1,0 +1,136 @@
+"""Serving precision policy: W8A8 int8 as a per-request dispatch axis.
+
+PERF.md's round-5 roofline pins the ≥8 img/s SDXL north star at 104% of
+bf16 MXU peak — unreachable in bf16 at any MFU — while the int8 MXU
+(394 TFLOP/s on v5e, 2× the bf16 peak) puts it back inside the roofline
+with margin. The quantized kernels already exist (``ops/quant.py``:
+dynamic per-token activation scales × per-channel weight scales,
+int8×int8→int32 MXU accumulation) but were only reachable through the
+process-wide ``SDTPU_UNET_INT8[_CONV]`` policy statics. This module
+promotes them to a serving-tier decision ("Speed Is All You Need" and
+the Gemma-on-TPU serving comparison both show quantized precision paying
+off only when it is per-request, not build-time):
+
+- ``GenerationPayload.precision`` / ``override_settings["precision"]``
+  select ``bf16`` | ``int8`` | ``int8+conv`` per request; the env flags
+  become defaults only.
+- The serving group key gains the resolved precision name so int8 and
+  bf16 requests coalesce separately (:func:`bucket_precision` quantizes
+  arbitrary inputs onto the bounded :data:`PRECISIONS` ladder — the
+  RC001/RC003 bucket rule: every distinct static value mints an XLA
+  executable, so ≤2 step-cache × ≤3 precision per shape bucket).
+- Activation scales are traced data inside the chunk executable (they
+  ride with the activations through ``int8_dot``), so switching between
+  two int8 requests never recompiles; only the precision *name* is
+  static.
+
+Quality is gated, not assumed: tier-1 holds int8 to the PSNR ≥ 20 dB /
+SSIM ≥ 0.6 floors (``tests/test_quality_int8.py``) and ``bench.py
+--int8`` measures the int8 × step-cache grid into BENCH_int8.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+#: Sanctioned precision modes, cheapest-compute last. Each name is a
+#: static compile-key component (different HLO per mode), so the ladder
+#: is deliberately tiny: 3 rungs × ≤2 step-cache executables bounds the
+#: per-bucket executable count at 6.
+PRECISIONS = ("bf16", "int8", "int8+conv")
+
+#: Aliases accepted from payloads/env for each canonical name.
+_ALIASES = {
+    "": "",
+    "bf16": "bf16",
+    "bfloat16": "bf16",
+    "default": "bf16",
+    "int8": "int8",
+    "w8a8": "int8",
+    "int8+conv": "int8+conv",
+    "int8-conv": "int8+conv",
+    "int8_conv": "int8+conv",
+}
+
+#: Canonical name → (quant_linears, quant_convs) module flags.
+_FLAGS = {
+    "bf16": (False, False),
+    "int8": (True, False),
+    "int8+conv": (True, True),
+}
+
+
+def bucket_precision(value, default: str = "bf16") -> str:
+    """Quantize a requested precision onto the :data:`PRECISIONS` ladder.
+
+    This is the RC003 bucket rule for the precision compile key: the
+    resolved name is static in the chunk executable and the serving
+    group key, so request/env-derived values must pass through here
+    before they can influence either. Unknown or empty values fall back
+    to ``default`` host-side (never raise — a typo'd precision should
+    degrade to the default mode, not fail the request)."""
+    try:
+        name = str(value or "").strip().lower()
+    except Exception:
+        return default
+    return _ALIASES.get(name, default) or default
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionSpec:
+    """Resolved serving precision for one request."""
+
+    name: str = "bf16"           # canonical ladder name (group-key axis)
+    quant_linears: bool = False  # W8A8 the transformer linears
+    quant_convs: bool = False    # ...and the ResBlock/Down/Up convs
+
+    @property
+    def active(self) -> bool:
+        return self.quant_linears or self.quant_convs
+
+    @property
+    def flags(self) -> Tuple[bool, bool]:
+        return (self.quant_linears, self.quant_convs)
+
+
+def policy_default(policy=None) -> PrecisionSpec:
+    """The engine policy's build-time precision as a spec.
+
+    Carries the policy's EXACT flags (a hand-built ``Policy`` with only
+    ``unet_int8_conv`` set keeps that odd combination) while naming it
+    with the nearest ladder rung for the group key."""
+    ql = bool(getattr(policy, "unet_int8", False))
+    qc = bool(getattr(policy, "unet_int8_conv", False))
+    name = "int8+conv" if qc else ("int8" if ql else "bf16")
+    return PrecisionSpec(name=name, quant_linears=ql, quant_convs=qc)
+
+
+def from_name(name: str) -> PrecisionSpec:
+    """Spec for a canonical ladder name (callers bucket first)."""
+    canonical = bucket_precision(name)
+    ql, qc = _FLAGS[canonical]
+    return PrecisionSpec(name=canonical, quant_linears=ql, quant_convs=qc)
+
+
+def resolve(payload=None, policy=None) -> PrecisionSpec:
+    """Resolve one request's serving precision.
+
+    Order: the payload's ``precision`` field, then
+    ``override_settings["precision"]`` (the channel webui options — and
+    the fleet degrade ladder — ride in), then the engine policy's env
+    defaults (``SDTPU_UNET_INT8[_CONV]``). A request that specifies
+    nothing lands EXACTLY on the policy-default spec, so the default
+    path routes to the unchanged policy-built modules byte-for-byte."""
+    requested: Optional[str] = None
+    field = getattr(payload, "precision", "") or ""
+    if str(field).strip():
+        requested = str(field)
+    else:
+        ov = getattr(payload, "override_settings", None) or {}
+        if str(ov.get("precision") or "").strip():
+            requested = str(ov.get("precision"))
+    if requested is None:
+        return policy_default(policy)
+    return from_name(bucket_precision(requested,
+                                      policy_default(policy).name))

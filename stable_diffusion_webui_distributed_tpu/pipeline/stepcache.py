@@ -186,20 +186,23 @@ class FlopsAccountant:
         self._lock = threading.Lock()
 
     def eval_flops(self, rows: int, lat_h: int, lat_w: int,
-                   ctx_len: int, mode: Optional[str]) -> Optional[float]:
+                   ctx_len: int, mode: Optional[str],
+                   precision: str = "") -> Optional[float]:
         """FLOPs of one UNet apply at the given batch rows / mode
-        (None = full forward, "deep", "reuse"); None when the lowering
-        or cost analysis is unavailable (never raises)."""
-        key = (rows, lat_h, lat_w, ctx_len, mode)
+        (None = full forward, "deep", "reuse") / serving precision name
+        ("" = the engine's policy default, pipeline/precision.py); None
+        when the lowering or cost analysis is unavailable (never
+        raises)."""
+        key = (rows, lat_h, lat_w, ctx_len, mode, precision)
         with self._lock:
             if key in self._cache:
                 return self._cache[key]
-        flops = self._measure(rows, lat_h, lat_w, ctx_len, mode)
+        flops = self._measure(rows, lat_h, lat_w, ctx_len, mode, precision)
         with self._lock:
             self._cache[key] = flops
         return flops
 
-    def _measure(self, rows, lat_h, lat_w, ctx_len, mode):
+    def _measure(self, rows, lat_h, lat_w, ctx_len, mode, precision=""):
         import jax
         import jax.numpy as jnp
 
@@ -211,6 +214,11 @@ class FlopsAccountant:
         ucfg = eng.family.unet
         if mode is not None and not unet_mod.cache_supported(ucfg):
             return None
+        # precision variant module (pipeline/precision.py): same param
+        # tree, different traced computation — int8 cells price their own
+        # HLO. "" keeps the policy-default module (legacy callers).
+        unet = (eng._modules_for(precision)[0]
+                if precision and hasattr(eng, "_modules_for") else eng.unet)
         try:
             struct = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -229,8 +237,8 @@ class FlopsAccountant:
                 if mode == "reuse" else None)
 
             def call(p, xx, tt, cc, aa, ca):
-                return eng.unet.apply({"params": p}, xx, tt, cc, aa,
-                                      cache=ca, cache_mode=mode)
+                return unet.apply({"params": p}, xx, tt, cc, aa,
+                                  cache=ca, cache_mode=mode)
 
             lowered = jax.jit(call).lower(struct, x, tb, ctx, added, cache)
             cost = lowered.cost_analysis()
@@ -242,8 +250,8 @@ class FlopsAccountant:
             return None
 
     def request_flops(self, counts: Dict[str, int], batch: int,
-                      lat_h: int, lat_w: int,
-                      ctx_len: int) -> Optional[float]:
+                      lat_h: int, lat_w: int, ctx_len: int,
+                      precision: str = "") -> Optional[float]:
         """Total UNet FLOPs for a denoise range priced from its
         :func:`plan_schedule` counts; None when any needed eval price is
         unavailable."""
@@ -259,7 +267,8 @@ class FlopsAccountant:
             n = counts.get(key, 0)
             if not n:
                 continue
-            price = self.eval_flops(rows, lat_h, lat_w, ctx_len, mode)
+            price = self.eval_flops(rows, lat_h, lat_w, ctx_len, mode,
+                                    precision)
             if price is None:
                 return None
             total += n * price

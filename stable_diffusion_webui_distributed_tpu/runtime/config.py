@@ -60,6 +60,18 @@ def _logger():
 # Defaults keep both levers off: generation stays byte-identical to the
 # plain executable unless a deployment opts into the FLOP/quality trade.
 #
+# Precision knobs (pipeline/precision.py; README "Precision modes"):
+#
+# - ``SDTPU_UNET_INT8`` / ``SDTPU_UNET_INT8_CONV`` (flags, default off):
+#   the server's DEFAULT serving precision ("int8" / "int8+conv").
+#   Defaults only — every request resolves its own precision through the
+#   3-rung ladder (``override_settings.precision`` or the payload
+#   ``precision`` field wins), so these flags never pin a deployment to
+#   one rung.
+# - ``SDTPU_WARMUP_PRECISIONS`` (comma list, default "" = policy default
+#   only): extra precision rungs the AOT warmup sweep pre-builds per
+#   bucket (serving/warmup.py) — precision is a static compile-key axis.
+#
 # Observability knobs (obs/ package; README "Observability"):
 #
 # - ``SDTPU_OBS`` (flag, default on): per-request span tracing. Spans are

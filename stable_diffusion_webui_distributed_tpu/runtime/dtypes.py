@@ -33,16 +33,20 @@ class Policy:
     # (README "numerical-parity status"); measure via sweep cell
     # c2-decodebf16 before promoting.
     decode_in_bf16: bool = False
-    # Experimental dynamic W8A8 int8 for the UNet transformer linears
+    # Dynamic W8A8 int8 for the UNet transformer linears
     # (SDTPU_UNET_INT8=1; ops/quant.py). The int8 MXU path is the only
     # single-chip lever above the bf16 roofline (PERF.md round-5
-    # analysis: 0.96 vs 0.48 img/s/chip ceiling on SDXL b8). Image
-    # fidelity under dynamic quantization is UNVALIDATED without real
-    # weights — strictly opt-in, measured by sweep cells c2-int8/c4-int8.
+    # analysis: 0.96 vs 0.48 img/s/chip ceiling on SDXL b8). Since the
+    # serving-precision ladder (pipeline/precision.py) this flag sets
+    # only the server's DEFAULT precision — a per-request ``precision``
+    # override ("bf16"/"int8"/"int8+conv") always wins, and the engine
+    # keeps one module variant per rung over the SAME param tree.
+    # Quality is gated by the tier-1 floors (tests/test_quality_int8.py);
+    # throughput by bench.py --int8 / sweep cells c2-int8/c4-int8.
     unet_int8: bool = False
     # ...and the same lever for the ResBlock/Down/Up convs
-    # (SDTPU_UNET_INT8_CONV=1) — configs #1/#3 are conv-dominated, so
-    # int8 linears alone barely move them. Same opt-in caveats.
+    # (SDTPU_UNET_INT8_CONV=1, the "int8+conv" rung) — configs #1/#3 are
+    # conv-dominated, so int8 linears alone barely move them.
     unet_int8_conv: bool = False
 
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from stable_diffusion_webui_distributed_tpu.runtime.config import (
     BenchmarkPayload,
@@ -56,6 +56,23 @@ SAMPLER_SPEED_VS_EULER_A = {
 MPE_WINDOW = 5
 MPE_REJECT_ABS_PERCENT = 500.0
 
+#: Compute-time priors per serving precision (pipeline/precision.py),
+#: relative to the bf16 baseline the benchmark ipm was measured at. int8
+#: MXU peak is 2x bf16 on v5e (394 vs 197 TFLOP/s, PERF.md) but a UNet
+#: eval is not 100% MXU, so the prior is deliberately conservative; live
+#: samples refine it per backend (:func:`record_eta_error`).
+PRECISION_PRIOR: Dict[str, float] = {
+    "bf16": 1.0,
+    "int8": 0.55,
+    "int8+conv": 0.5,
+}
+#: EWMA blend + clamp for the learned per-precision factor. The clamp
+#: keeps one wild sample from collapsing the factor to ~0 (which would
+#: make admission accept anything "because int8 is free").
+PRECISION_EWMA_ALPHA = 0.3
+PRECISION_FACTOR_MIN = 0.1
+PRECISION_FACTOR_MAX = 1.5
+
 
 @dataclasses.dataclass
 class EtaCalibration:
@@ -63,6 +80,13 @@ class EtaCalibration:
 
     avg_ipm: Optional[float] = None
     eta_percent_error: List[float] = dataclasses.field(default_factory=list)
+    #: learned compute-time factor per non-bf16 serving precision
+    #: (actual/predicted EWMA over that precision's OWN samples; bf16
+    #: samples never touch it, and non-bf16 samples never touch
+    #: ``eta_percent_error`` — the two calibrations are isolated so a
+    #: fleet-degraded int8 burst cannot skew bf16 ETAs)
+    precision_scale: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def benchmarked(self) -> bool:
@@ -72,6 +96,17 @@ class EtaCalibration:
         if not self.eta_percent_error:
             return 0.0
         return sum(self.eta_percent_error) / len(self.eta_percent_error)
+
+    def precision_factor(self, precision: str) -> float:
+        """Compute-time multiplier for a resolved precision name:
+        the learned per-backend factor when samples exist, else the
+        :data:`PRECISION_PRIOR`; bf16/empty is always 1.0."""
+        if not precision or precision == "bf16":
+            return 1.0
+        learned = self.precision_scale.get(precision)
+        if learned is not None:
+            return learned
+        return PRECISION_PRIOR.get(precision, 1.0)
 
 
 def predict_eta(
@@ -83,6 +118,7 @@ def predict_eta(
     _include_hr: bool = True,
     queue_wait: float = 0.0,
     padding_overhead: float = 1.0,
+    precision: str = "",
 ) -> float:
     """Seconds to complete ``payload`` on a backend calibrated as ``cal``.
 
@@ -97,6 +133,11 @@ def predict_eta(
     queue, typically ``ServingDispatcher.eta_overhead()``'s observed
     average) is added on top — wait is latency, not compute, so the MPE
     feedback never rescales it.
+
+    ``precision``: resolved serving precision name — scales the COMPUTE
+    part by :meth:`EtaCalibration.precision_factor` (int8's ~2x shows up
+    here instead of skewing the bf16 calibration); the wait stays
+    additive.
     """
     if not cal.benchmarked:
         raise ValueError("backend not benchmarked; run the benchmark first")
@@ -120,6 +161,7 @@ def predict_eta(
         eta -= eta * (delta / 100.0) if delta > 0 else -eta * abs(delta) / 100.0
 
     eta *= max(1.0, padding_overhead)
+    eta *= cal.precision_factor(precision)
 
     if cal.eta_percent_error:
         eta -= eta * (cal.mpe() / 100.0)
@@ -153,6 +195,7 @@ def admission_eta(
     steps: Optional[int] = None,
     queue_wait: float = 0.0,
     padding_overhead: float = 1.0,
+    precision: str = "",
 ) -> float:
     """SLO-admission variant of :func:`predict_eta` (fleet/admission.py).
 
@@ -164,7 +207,8 @@ def admission_eta(
     never rescaled by either correction (it is measured, not predicted).
     """
     eta = predict_eta(cal, payload, benchmark=benchmark, steps=steps,
-                      padding_overhead=padding_overhead)
+                      padding_overhead=padding_overhead,
+                      precision=precision)
     if not cal.eta_percent_error:
         try:
             from stable_diffusion_webui_distributed_tpu.obs import (
@@ -178,13 +222,31 @@ def admission_eta(
 
 
 def record_eta_error(cal: EtaCalibration, predicted: float,
-                     actual: float) -> None:
+                     actual: float, precision: str = "") -> None:
     """Feed one (prediction, reality) pair back into the calibration.
 
     percent error = (predicted - actual)/actual * 100; |e| >= 500% rejected,
     window capped at MPE_WINDOW most-recent samples (worker.py:476-492).
+
+    Samples from a non-bf16 ``precision`` update ONLY that precision's
+    learned compute factor (clamped EWMA on actual/predicted) — they
+    never enter ``eta_percent_error`` or the process-wide MPE gauge, so
+    a fleet-degraded int8 burst cannot skew the bf16 calibration every
+    other request admits against.
     """
     if actual <= 0 or predicted <= 0:
+        return
+    if precision and precision != "bf16":
+        error = (predicted - actual) / actual * 100.0
+        if abs(error) >= MPE_REJECT_ABS_PERCENT:
+            return
+        f_old = cal.precision_factor(precision)
+        # predicted already includes f_old, so actual/predicted is the
+        # multiplicative residual; EWMA-blend it into the factor
+        f_new = f_old * ((1.0 - PRECISION_EWMA_ALPHA)
+                         + PRECISION_EWMA_ALPHA * (actual / predicted))
+        cal.precision_scale[precision] = min(
+            PRECISION_FACTOR_MAX, max(PRECISION_FACTOR_MIN, f_new))
         return
     _note_obs(predicted, actual)
     error = (predicted - actual) / actual * 100.0

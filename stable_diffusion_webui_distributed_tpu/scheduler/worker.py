@@ -180,11 +180,26 @@ class WorkerNode:
             steps: Optional[int] = None, queue_wait: float = 0.0,
             padding_overhead: float = 1.0) -> float:
         # queue_wait/padding_overhead: serving-dispatcher additions for
-        # backends behind a coalescing front end (scheduler/eta.py)
+        # backends behind a coalescing front end (scheduler/eta.py).
+        # precision: the payload's requested serving precision scales the
+        # compute part via the per-precision factor (int8 ~2x) so mixed
+        # fleets predict each request at its own speed
         return eta_mod.predict_eta(self.cal, payload, self.benchmark_payload,
                                    batch_size=batch_size, steps=steps,
                                    queue_wait=queue_wait,
-                                   padding_overhead=padding_overhead)
+                                   padding_overhead=padding_overhead,
+                                   precision=self._payload_precision(payload))
+
+    @staticmethod
+    def _payload_precision(payload) -> str:
+        """Resolved precision name for ETA purposes (payload channel only
+        — a remote backend's env defaults are not visible here, so an
+        unspecified precision calibrates as the bf16 baseline)."""
+        from stable_diffusion_webui_distributed_tpu.pipeline import (
+            precision as precision_mod,
+        )
+
+        return precision_mod.resolve(payload).name
 
     # -- request lifecycle --------------------------------------------------
 
@@ -236,7 +251,11 @@ class WorkerNode:
             # calibration quality is readable straight off its trace
             wsp.attrs["actual_s"] = elapsed
         if predicted is not None:
-            eta_mod.record_eta_error(self.cal, predicted, elapsed)
+            # precision-scoped: an int8 sample refines the int8 factor
+            # only and never enters the bf16 MPE window (scheduler/eta.py)
+            eta_mod.record_eta_error(self.cal, predicted, elapsed,
+                                     precision=self._payload_precision(
+                                         payload))
         self.set_state(State.IDLE)
         return result
 

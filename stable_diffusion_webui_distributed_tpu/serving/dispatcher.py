@@ -366,6 +366,19 @@ class ServingDispatcher:
             return False
         return p.total_images <= self.max_batch
 
+    def _precision_name(self, run) -> str:
+        """Resolved serving precision for a request (pipeline/precision.py)
+        — the last group-key axis and the label on the dispatch span /
+        ``sdtpu_dispatch_precision_total`` counter."""
+        from stable_diffusion_webui_distributed_tpu.pipeline import (
+            precision as precision_mod,
+        )
+
+        # self may be None (tests call _group_key unbound) or hold no
+        # engine (ETA-overhead probes): bf16 default either way
+        policy = getattr(getattr(self, "engine", None), "policy", None)
+        return precision_mod.resolve(run, policy).name
+
     def _group_key(self, run) -> tuple:
         from stable_diffusion_webui_distributed_tpu.pipeline import (
             stepcache,
@@ -373,12 +386,16 @@ class ServingDispatcher:
 
         # step-cache knobs join the key: merged requests run ONE denoise
         # range, so they must agree on the resolved (bucketed) cadence and
-        # CFG cutoff or the coalesced batch would change their outputs
+        # CFG cutoff or the coalesced batch would change their outputs.
+        # The resolved precision name is the LAST axis (consumers read
+        # key[-1]): int8 and bf16 requests coalesce separately — a merged
+        # batch runs one chunk executable, and precision is static in it.
         sc = stepcache.resolve(run)
         return ("txt2img", run.sampler_name, int(run.steps),
                 int(run.width), int(run.height), float(run.cfg_scale),
                 run.negative_prompt or "", int(run.clip_skip or 0),
-                sc.cadence, sc.cutoff_sigma)
+                sc.cadence, sc.cutoff_sigma,
+                ServingDispatcher._precision_name(self, run))
 
     def _run_grouped(self, ticket: Ticket) -> None:
         key = self._group_key(ticket.run)
@@ -423,8 +440,11 @@ class ServingDispatcher:
                                    start_perf - t.enqueued_perf)
             dsp = None
             try:
+                # precision attribute rides the device span so the flight
+                # recorder shows which precision a failed request ran at
                 with obs_spans.span("dispatch.device",
-                                    requests=len(g.tickets)) as dsp:
+                                    requests=len(g.tickets),
+                                    precision=g.key[-1]) as dsp:
                     self._execute_group(g)
             except BaseException as e:  # noqa: BLE001 — delivered per ticket
                 for t in g.tickets:
@@ -465,8 +485,11 @@ class ServingDispatcher:
                                    ticket.enqueued_perf,
                                    time.perf_counter()
                                    - ticket.enqueued_perf)
-                METRICS.record_dispatch(1)
-                with obs_spans.span("dispatch.device", requests=1):
+                prec = self._precision_name(ticket.run)
+                METRICS.record_dispatch(1, precision=prec)
+                obs_prom.count_precision(prec, 1)
+                with obs_spans.span("dispatch.device", requests=1,
+                                    precision=prec):
                     result = self.engine.generate_range(
                         ticket.run, 0, None, ticket.job)
                 if ticket.bucketed:
@@ -497,7 +520,8 @@ class ServingDispatcher:
                 t.result = self._empty_result(t)
         if not live:
             return
-        METRICS.record_dispatch(len(live))
+        METRICS.record_dispatch(len(live), precision=g.key[-1])
+        obs_prom.count_precision(g.key[-1], len(live))
 
         rp = live[0].run.model_copy()
         width, height = rp.width, rp.height

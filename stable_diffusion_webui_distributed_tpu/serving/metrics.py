@@ -59,6 +59,10 @@ class DispatchMetrics:
             #: images decoded to outputs (denominator for FLOPs/image —
             #: hires/refiner FLOPs fold into the one image they produce)
             self.unet_images = 0  # guarded-by: _lock
+            #: resolved precision name -> device dispatches / requests
+            #: carried (pipeline/precision.py; "" = caller didn't say)
+            self.precision_dispatches: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+            self.precision_requests: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
 
     # -- engine-side ------------------------------------------------------
 
@@ -86,12 +90,15 @@ class DispatchMetrics:
             self.padding_ratio_total += float(padding_ratio)
             self.padding_ratio_count += 1
 
-    def record_dispatch(self, n_requests: int) -> None:
+    def record_dispatch(self, n_requests: int, precision: str = "") -> None:
         with self._lock:
             self.dispatches += 1
             self.coalesced_requests += int(n_requests)
             if n_requests >= 2:
                 self.coalesced_dispatches += 1
+            if precision:
+                self.precision_dispatches[str(precision)] += 1
+                self.precision_requests[str(precision)] += int(n_requests)
 
     def record_queue_wait(self, seconds: float) -> None:
         with self._lock:
@@ -168,6 +175,16 @@ class DispatchMetrics:
                 "unet_flops_per_image": (self.unet_flops_total
                                          / self.unet_images
                                          if self.unet_images else None),
+                # per-precision dispatch mix (flows into /internal/status
+                # under serving.precision; ISSUE 7 observability)
+                "precision": {
+                    name: {
+                        "dispatches": self.precision_dispatches.get(name, 0),
+                        "requests": self.precision_requests.get(name, 0),
+                    }
+                    for name in sorted(set(self.precision_dispatches)
+                                       | set(self.precision_requests))
+                },
             }
 
 

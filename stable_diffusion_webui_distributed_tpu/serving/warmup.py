@@ -14,12 +14,17 @@ Knobs: ``SDTPU_WARMUP`` (0 disables, default on when invoked),
 ``SDTPU_WARMUP_STEPS`` / ``SDTPU_WARMUP_SAMPLER`` pick the (steps,
 sampler) point to pre-build — warmup only pays off for the step counts
 traffic actually uses, since steps are part of the compile key.
+``SDTPU_WARMUP_PRECISIONS`` (comma-separated, default "" = policy
+default only) adds serving-precision rungs to the sweep — e.g.
+``bf16,int8`` pre-builds the int8 ladder too, so the first fleet-degraded
+or user-requested int8 request dispatches instead of compiling
+(pipeline/precision.py; precision is a static compile-key axis).
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from stable_diffusion_webui_distributed_tpu.runtime.config import (
     env_int, env_str,
@@ -30,12 +35,33 @@ from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
 from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
 
 
+def _warmup_precisions() -> List[str]:
+    """Precision rungs to sweep (bucketed onto the PRECISIONS ladder;
+    "" = the engine policy's default). Default is the single empty entry,
+    so warmup cost is unchanged unless the operator opts in."""
+    from stable_diffusion_webui_distributed_tpu.pipeline import (
+        precision as precision_mod,
+    )
+
+    raw = env_str("SDTPU_WARMUP_PRECISIONS", "")
+    if not raw.strip():
+        return [""]
+    out: List[str] = []
+    for part in raw.split(","):
+        name = precision_mod.bucket_precision(part, "")
+        entry = name if part.strip() else ""
+        if entry not in out:
+            out.append(entry)
+    return out or [""]
+
+
 def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
                   steps: Optional[int] = None,
                   sampler: Optional[str] = None,
                   cache_dir: Optional[str] = None) -> Dict:
-    """Pre-lower every (shape, batch) bucket's pipeline; returns a report
-    of how many stage builds the sweep triggered and its wall time."""
+    """Pre-lower every (shape, batch[, precision]) bucket's pipeline;
+    returns a report of how many stage builds the sweep triggered and its
+    wall time."""
     from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
         GenerationPayload,
     )
@@ -51,17 +77,21 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
     steps = steps if steps is not None else env_int("SDTPU_WARMUP_STEPS", 20)
     sampler = sampler or env_str("SDTPU_WARMUP_SAMPLER", "Euler a")
 
+    precisions = _warmup_precisions()
     before = dict(METRICS.summary()["compiles"])
     t0 = time.monotonic()
     warmed = []
     for bw, bh in bucketer.shapes:
         for nb in bucketer.batches:
-            payload = GenerationPayload(
-                prompt="", steps=steps, width=bw, height=bh,
-                batch_size=nb, sampler_name=sampler, seed=0)
-            engine.state.begin_request()
-            engine.generate_range(payload, 0, None, "warmup")
-            warmed.append((bw, bh, nb))
+            for prec in precisions:
+                payload = GenerationPayload(
+                    prompt="", steps=steps, width=bw, height=bh,
+                    batch_size=nb, sampler_name=sampler, seed=0,
+                    precision=prec)
+                engine.state.begin_request()
+                engine.generate_range(payload, 0, None, "warmup")
+                warmed.append((bw, bh, nb) if prec == ""
+                              else (bw, bh, nb, prec))
     after = METRICS.summary()["compiles"]
     built = {k: after.get(k, 0) - before.get(k, 0)
              for k in after if after.get(k, 0) != before.get(k, 0)}
@@ -70,6 +100,7 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
         "buckets": warmed,
         "steps": steps,
         "sampler": sampler,
+        "precisions": precisions,
         "stage_builds": built,
         "xla_cache_dir": active_cache,
         "wall_s": round(time.monotonic() - t0, 2),

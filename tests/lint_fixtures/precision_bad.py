@@ -1,0 +1,30 @@
+"""Known-bad serving-precision fixture (RC003).
+
+The serving precision is a STATIC compile-key and group-key axis
+(pipeline/engine.py chunk key, serving/dispatcher.py:_group_key): a raw
+``SDTPU_UNET_INT8`` env read, a raw ``override_settings.get("precision")``
+or a raw ``payload.precision`` attribute read bypasses the 3-rung ladder
+in pipeline/precision.py — either an unbounded executable key or a
+group-key bypass that coalesces int8 and bf16 requests into one
+executable. The clean variant routes through ``bucket_precision``.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+from stable_diffusion_webui_distributed_tpu.pipeline.precision import (
+    bucket_precision,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import env_flag
+
+
+def group_key_bad(payload):
+    ov = payload.override_settings or {}
+    use_int8 = env_flag("SDTPU_UNET_INT8", False)  # RC003: raw env read
+    name = ov.get("precision")  # RC003: raw override read
+    raw = payload.precision  # RC003: group-key bypass
+    return ("txt2img", use_int8, name, raw)
+
+
+def group_key_clean(payload):
+    name = bucket_precision(payload.precision, "bf16")  # clean: ladder
+    return ("txt2img", name)

@@ -288,6 +288,44 @@ class TestAdmission:
         assert d.action == "reject"
         assert "2.0s" in d.detail
 
+    def test_degrade_int8_rung(self):
+        # the final rung before reject: fewstep tops out at 6s * 0.633 =
+        # 3.8s, so a 3s SLO needs the int8 precision stacked on top
+        # (prior factor 0.55): 6 * 0.633 * 0.55 = 2.09s fits
+        pol = FleetPolicy(slo_interactive_s=3.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(), pol)
+        assert d.action == "degrade"
+        assert d.overrides == {"deepcache": 3, "precision": "int8"}
+        assert d.steps == 12
+        assert "int8" in d.detail
+        assert d.predicted_s <= 3.0
+
+    def test_int8_request_has_no_int8_rung(self):
+        # a request already asking for int8 is predicted at int8 speed
+        # (5.5s compute) but cannot degrade to int8 again: at a 2s SLO the
+        # fewstep rung lands at 6*0.55*0.633 = 2.09s and it rejects
+        pol = FleetPolicy(slo_interactive_s=2.0).resolve(INTERACTIVE)
+        d = self.controller().decide(payload(precision="int8"), pol)
+        assert d.action == "reject"
+
+    def test_int8_samples_never_skew_bf16_calibration(self):
+        # ETA isolation: a fleet-degraded int8 completion must update the
+        # per-precision factor only — the bf16 MPE history and the ETA it
+        # feeds stay bit-identical
+        from stable_diffusion_webui_distributed_tpu.scheduler import eta
+
+        cal = EtaCalibration(avg_ipm=6.0, eta_percent_error=[0.0])
+        before = eta.predict_eta(cal, payload())
+        eta.record_eta_error(cal, predicted=4.0, actual=2.0,
+                             precision="int8")
+        assert cal.eta_percent_error == [0.0]
+        assert eta.predict_eta(cal, payload()) == before
+        # the int8 factor moved from the prior toward the observed ratio
+        # (0.55 * (0.7 + 0.3 * 0.5) = 0.4675) and int8 ETAs now use it
+        assert cal.precision_scale["int8"] == pytest.approx(0.4675)
+        assert eta.predict_eta(cal, payload(), precision="int8") == \
+            pytest.approx(before * 0.4675)
+
     def test_queue_wait_is_never_rescaled(self):
         # 10s compute + 5s wait; an SLO of 12s can be met by cadence 2
         # only because the wait stays additive (10*0.725+5 = 12.25 > 12

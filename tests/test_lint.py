@@ -69,8 +69,8 @@ class TestRepoGate:
 
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
-                     "EV001", "OB001", "LK001", "LK002", "LK003",
-                     "FL001", "AL001", "AL002"):
+                     "RC003", "EV001", "OB001", "LK001", "LK002",
+                     "LK003", "FL001", "AL001", "AL002"):
             assert rule in RULES and RULES[rule]
 
 
@@ -112,6 +112,18 @@ class TestFixtures:
         # variant in the same fixture must stay clean
         found = _rule_lines(_fixture_findings("cadence_bad.py"))
         assert found == {("RC001", 24)}
+
+    def test_precision_family(self):
+        # the serving-precision discipline (RC003): raw env / override /
+        # payload-attribute precision reads bypass the 3-rung ladder in
+        # pipeline/precision.py; the bucket_precision-wrapped variant in
+        # the same fixture must stay clean
+        found = _rule_lines(_fixture_findings("precision_bad.py"))
+        assert found == {
+            ("RC003", 22),  # raw SDTPU_UNET_INT8 env read
+            ("RC003", 23),  # raw override_settings.get("precision")
+            ("RC003", 24),  # raw payload.precision attribute read
+        }
 
     def test_timing_family(self):
         # OB001 is path-scoped: load the fixture under a spoofed serving/

@@ -55,3 +55,49 @@ class TestInt8Quality:
     def test_ssim_floor(self, baseline, int8_result):
         s = quality.mean_ssim(int8_result.images, baseline.images)
         assert s >= SSIM_FLOOR, f"int8 SSIM {s:.3f} under floor"
+
+
+# -- fast tier: per-request precision on ONE default engine ------------------
+# The serving-mode contract (pipeline/precision.py): a single engine built
+# with env defaults serves ``precision="int8"`` requests from a quantized
+# module variant sharing the SAME param tree. Small payload (steps=4,
+# batch=1) keeps this in the fast tier; the slow class above keeps the
+# deeper 8-step sweep.
+
+def _fast_payload(**kw):
+    return GenerationPayload(prompt="a cow", steps=4, width=32, height=32,
+                             batch_size=1, seed=42, **kw)
+
+
+@pytest.fixture(scope="module")
+def shared_engine():
+    return quality.make_engine(TINY)
+
+
+@pytest.fixture(scope="module")
+def fast_baseline(shared_engine):
+    return shared_engine.txt2img(_fast_payload())
+
+
+@pytest.fixture(scope="module")
+def fast_int8(shared_engine):
+    return shared_engine.txt2img(_fast_payload(precision="int8"))
+
+
+class TestInt8PerRequest:
+    def test_engaged_and_default_untouched(self, shared_engine,
+                                           fast_baseline, fast_int8):
+        # the override engaged (different pixels), and re-running the
+        # default payload afterwards is byte-identical — the int8 variant
+        # never leaks into the bf16 executable
+        assert fast_int8.images != fast_baseline.images
+        again = shared_engine.txt2img(_fast_payload())
+        assert again.images == fast_baseline.images
+
+    def test_psnr_floor(self, fast_baseline, fast_int8):
+        db = quality.mean_psnr(fast_int8.images, fast_baseline.images)
+        assert db >= PSNR_FLOOR_DB, f"int8 PSNR {db:.2f} dB under floor"
+
+    def test_ssim_floor(self, fast_baseline, fast_int8):
+        s = quality.mean_ssim(fast_int8.images, fast_baseline.images)
+        assert s >= SSIM_FLOOR, f"int8 SSIM {s:.3f} under floor"

@@ -307,3 +307,55 @@ class TestContinuousBatching:
         monkeypatch.setenv("SDTPU_WARMUP", "0")
         report = warmup_engine(None)  # engine untouched when disabled
         assert report["skipped"] is True
+
+
+class TestPrecisionDispatch:
+    """Per-request serving precision (pipeline/precision.py) as a dispatch
+    group-key axis: mixed bf16/int8 traffic on ONE shape bucket must hold
+    the compile budget (one chunk executable per precision actually used —
+    never coalesce across precisions, never an unbounded key)."""
+
+    # the (48, 48) bucket at batch 2 is disjoint from every other class's
+    # chunk keys on the shared module engine, so compile counts are exact
+    # (steps stay at 4: one chunk-scan length, one executable per precision)
+    def _bucketer(self):
+        return ShapeBucketer(shapes=[(48, 48)], batches=[2])
+
+    def test_mixed_precision_compile_budget(self, engine):
+        disp = ServingDispatcher(engine, bucketer=self._bucketer(),
+                                 window=0.0)
+
+        METRICS.clear()
+        bf16 = [disp.submit(payload(seed=31)),
+                disp.submit(payload(seed=32))]
+        # one bucket, one precision -> exactly one chunk executable
+        assert METRICS.compile_count("chunk") == 1
+
+        int8 = [disp.submit(payload(
+                    seed=31, override_settings={"precision": "int8"})),
+                disp.submit(payload(seed=32, precision="int8"))]
+        s = METRICS.summary()
+        # the int8 rung adds exactly ONE more executable for the same
+        # bucket (<= 3 precisions x <= 2 step-cache variants per bucket),
+        # shared by both the override_settings and the field spelling
+        assert s["compiles"].get("chunk", 0) == 2
+        assert s["precision"]["bf16"]["requests"] == 2
+        assert s["precision"]["int8"]["requests"] == 2
+
+        # engagement: the quantized executable really ran (same seeds,
+        # different pixels); the two int8 spellings agree byte-for-byte
+        assert int8[0].images != bf16[0].images
+        assert int8[0].seeds == bf16[0].seeds
+        assert int8[1].images != bf16[1].images
+
+    def test_unknown_precision_buckets_to_default(self, engine):
+        # off-ladder names never mint a fourth executable: they resolve to
+        # the policy default and ride the existing bf16 group
+        disp = ServingDispatcher(engine, bucketer=self._bucketer(),
+                                 window=0.0)
+        base = disp.submit(payload(seed=33))
+        METRICS.clear()
+        odd = disp.submit(payload(
+            seed=33, override_settings={"precision": "fp4-turbo"}))
+        assert METRICS.compile_count("chunk") == 0
+        assert odd.images == base.images

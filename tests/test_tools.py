@@ -234,7 +234,8 @@ class TestFlopsReport:
             def __init__(self, engine):
                 pass
 
-            def eval_flops(self, rows, lat_h, lat_w, ctx_len, mode):
+            def eval_flops(self, rows, lat_h, lat_w, ctx_len, mode,
+                           precision=""):
                 scale = {None: 1.0, "reuse": 0.45, "deep": 0.55}[mode]
                 return rows * lat_h * lat_w * scale * 1e6
 
@@ -426,6 +427,82 @@ class TestFleetReport:
         (tmp_path / "garbage.json").write_text("{not json")
         assert fleet_report.main([str(tmp_path / "garbage.json")]) == 2
         assert fleet_report.main([str(tmp_path / "missing.json")]) == 2
+
+
+class TestInt8Report:
+    """tools/int8_report.py: the BENCH_int8.json digest — per-cell floor
+    verdicts and the exit-code contract (1 = floors broken)."""
+
+    @staticmethod
+    def _doc(**over):
+        doc = {
+            "metric": "tiny_int8_min_psnr_db",
+            "device": "cpu",
+            "steps": 8,
+            "psnr_floor_db": 20.0,
+            "ssim_floor": 0.6,
+            "mxu_peak_ratio_int8_vs_bf16": 2.0,
+            "cells": [
+                {"cell": "c1-bf16", "precision": "bf16", "cadence": 1,
+                 "unet_flops_per_image": 3.78e9, "chunk_executables": 1},
+                {"cell": "c1-int8", "precision": "int8", "cadence": 1,
+                 "unet_flops_per_image": 3.87e9, "chunk_executables": 1,
+                 "psnr_db_vs_bf16": 34.5, "ssim_vs_bf16": 0.997},
+                {"cell": "c3-int8+conv", "precision": "int8+conv",
+                 "cadence": 3, "unet_flops_per_image": 2.35e9,
+                 "chunk_executables": 1,
+                 "psnr_db_vs_bf16": 28.5, "ssim_vs_bf16": 0.985},
+            ],
+        }
+        doc.update(over)
+        return doc
+
+    def test_summary_floor_verdicts(self):
+        import int8_report
+
+        s = int8_report.build_summary(self._doc())
+        by_cell = {r["cell"]: r for r in s["rows"]}
+        assert by_cell["c1-bf16"]["floors_ok"] is None  # control row
+        assert by_cell["c1-int8"]["floors_ok"] is True
+        assert s["quantized_cells"] == 2
+        assert s["min_psnr_db"] == 28.5
+        assert s["min_ssim"] == 0.985
+        assert s["floors_ok"] is True
+
+    def test_broken_floor_flips_verdict(self):
+        import int8_report
+
+        doc = self._doc()
+        doc["cells"][2]["psnr_db_vs_bf16"] = 12.0
+        s = int8_report.build_summary(doc)
+        assert s["floors_ok"] is False
+        assert "BROKEN" in int8_report.render(s)
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        import int8_report
+
+        p = tmp_path / "BENCH_int8.json"
+        p.write_text(json.dumps(self._doc()))
+        assert int8_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "floors" in out and "HOLD" in out
+
+        assert int8_report.main([str(p), "--json"]) == 0
+        digest = json.loads(capsys.readouterr().out)
+        assert digest["min_psnr_db"] == 28.5
+
+        broken = self._doc()
+        broken["cells"][1]["ssim_vs_bf16"] = 0.1
+        (tmp_path / "broken.json").write_text(json.dumps(broken))
+        assert int8_report.main([str(tmp_path / "broken.json")]) == 1
+
+        empty = self._doc(cells=[])
+        (tmp_path / "empty.json").write_text(json.dumps(empty))
+        assert int8_report.main([str(tmp_path / "empty.json")]) == 1
+
+        (tmp_path / "garbage.json").write_text("{not json")
+        assert int8_report.main([str(tmp_path / "garbage.json")]) == 2
+        assert int8_report.main([str(tmp_path / "missing.json")]) == 2
 
 
 class TestClassifyTriage:

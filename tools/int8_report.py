@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Summarize BENCH_int8.json (bench.py --int8) as a per-cell table.
+
+The bench runs the int8 x step-cache grid on one random-weights tiny
+engine through the per-request ``precision`` override, and this report
+renders it: per-cell UNet FLOPs/image, chunk compile count, and the
+PSNR/SSIM of each quantized cell against the bf16 control at the same
+cadence, checked against the tier-1 quality floors
+(tests/test_quality_int8.py).
+
+    python tools/int8_report.py                    # ./BENCH_int8.json
+    python tools/int8_report.py path/to/BENCH_int8.json
+    python tools/int8_report.py --json             # machine-readable
+
+Exit codes: 0 report rendered and floors hold; 1 artifact is degenerate
+(no quantized cells) or a floor is broken — the int8 degrade rung would
+trade SLO misses for broken images; 2 artifact missing/unparseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fmt(v, suffix=""):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def build_summary(doc):
+    """Digest the BENCH_int8.json document into the report rows."""
+    psnr_floor = doc.get("psnr_floor_db", 20.0)
+    ssim_floor = doc.get("ssim_floor", 0.6)
+    rows = []
+    for c in doc.get("cells", []) or []:
+        quantized = c.get("precision") != "bf16"
+        psnr = c.get("psnr_db_vs_bf16")
+        ssim = c.get("ssim_vs_bf16")
+        ok = None
+        if quantized:
+            ok = (psnr is not None and psnr >= psnr_floor
+                  and ssim is not None and ssim >= ssim_floor)
+        rows.append({
+            "cell": c.get("cell"),
+            "precision": c.get("precision"),
+            "cadence": c.get("cadence"),
+            "unet_flops_per_image": c.get("unet_flops_per_image"),
+            "chunk_executables": c.get("chunk_executables"),
+            "psnr_db_vs_bf16": psnr,
+            "ssim_vs_bf16": ssim,
+            "floors_ok": ok,
+        })
+    quantized = [r for r in rows if r["floors_ok"] is not None]
+    return {
+        "metric": doc.get("metric"),
+        "device": doc.get("device"),
+        "steps": doc.get("steps"),
+        "rows": rows,
+        "quantized_cells": len(quantized),
+        "psnr_floor_db": psnr_floor,
+        "ssim_floor": ssim_floor,
+        "min_psnr_db": min((r["psnr_db_vs_bf16"] for r in quantized
+                            if r["psnr_db_vs_bf16"] is not None),
+                           default=None),
+        "min_ssim": min((r["ssim_vs_bf16"] for r in quantized
+                         if r["ssim_vs_bf16"] is not None), default=None),
+        "floors_ok": bool(quantized)
+        and all(r["floors_ok"] for r in quantized),
+        "mxu_peak_ratio": doc.get("mxu_peak_ratio_int8_vs_bf16"),
+    }
+
+
+def render(summary):
+    lines = [f"int8 serving precision report — {summary['metric']} "
+             f"on {summary['device']}",
+             "",
+             f"{'cell':<14} {'cadence':>7} {'flops/img':>11} "
+             f"{'chunks':>6} {'psnr':>9} {'ssim':>7} {'floors':>7}"]
+    for r in summary["rows"]:
+        flops = r["unet_flops_per_image"]
+        verdict = ("-" if r["floors_ok"] is None
+                   else "ok" if r["floors_ok"] else "BROKEN")
+        lines.append(
+            f"{r['cell']:<14} {r['cadence']:>7} "
+            f"{(f'{flops:.3e}' if flops else '-'):>11} "
+            f"{r['chunk_executables']:>6} "
+            f"{_fmt(r['psnr_db_vs_bf16'], 'dB'):>9} "
+            f"{_fmt(r['ssim_vs_bf16']):>7} {verdict:>7}")
+    lines.append("")
+    lines.append(
+        f"floors (psnr >= {_fmt(summary['psnr_floor_db'], 'dB')}, "
+        f"ssim >= {_fmt(summary['ssim_floor'])}): "
+        + ("HOLD" if summary["floors_ok"] else "BROKEN")
+        + f" — worst cell {_fmt(summary['min_psnr_db'], 'dB')} / "
+        f"{_fmt(summary['min_ssim'])}")
+    if summary["mxu_peak_ratio"]:
+        lines.append(f"int8 MXU peak ratio vs bf16: "
+                     f"{_fmt(summary['mxu_peak_ratio'])}x (the roofline "
+                     "headroom the quality floors buy)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default="BENCH_int8.json",
+                    help="bench.py --int8 artifact "
+                         "(default ./BENCH_int8.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digested summary as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"int8_report: {args.path} not found "
+              f"(run: python bench.py --int8)", file=sys.stderr)
+        return 2
+    try:
+        with open(args.path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"int8_report: cannot parse {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    summary = build_summary(doc)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    if not summary["floors_ok"]:
+        print("int8_report: quality floors broken or no quantized cells "
+              "in the artifact", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
