@@ -53,21 +53,12 @@ def tiny_env() -> bool:
     tiny mode is a CPU logic-check, never a perf claim."""
     return os.environ.get("SDTPU_BENCH_TINY", "") not in ("", "0")
 
-# bf16 peak FLOPs/s per chip, by device_kind substring (public specs).
-_PEAK_FLOPS = {
-    "v6e": 918e12, "v6": 918e12,
-    "v5p": 459e12,
-    "v5e": 197e12, "v5litepod": 197e12, "v5": 197e12,
-    "v4": 275e12,
-}
-
-
 def _peak_for(device_kind: str):
-    dk = device_kind.lower().replace(" ", "")
-    for key, val in _PEAK_FLOPS.items():
-        if key in dk:
-            return val
-    return None
+    """bf16 peak FLOPs/s for a device kind — one table, owned by the perf
+    ledger (obs/perf.py) so bench MFU and live /internal/perf MFU agree."""
+    from stable_diffusion_webui_distributed_tpu.obs import perf as obs_perf
+
+    return obs_perf.peak_flops_for(device_kind)
 
 
 def _start_init_watchdog(timeout=None):
@@ -939,6 +930,7 @@ def run_serving(tiny):
         "coalesced_dispatches": s["coalesced_dispatches"],
         "avg_queue_wait_s": round(s["avg_queue_wait_s"] or 0.0, 4),
         "avg_padding_ratio": round(s["avg_padding_ratio"] or 1.0, 4),
+        "unet_flops_per_image": s["unet_flops_per_image"],
         "requests": 8,
         "raw_shapes": len(set(shapes)),
         "bucket_ladder": [f"{w}x{h}" for w, h in bucketer.shapes],
@@ -1162,6 +1154,54 @@ def run_fleet(tiny):
     return out
 
 
+def _ledger_row(kind, metrics, device, tiny, recorded_at):
+    """One append-only BENCH_LEDGER.jsonl row. ``schema`` versions the row
+    shape; ``metrics`` holds only platform-independent structural numbers
+    (compile counts, ratios, attainment) that tools/bench_compare.py can
+    diff across machines."""
+    return {"schema": 1, "kind": kind, "recorded_at": recorded_at,
+            "device": device, "tiny": bool(tiny), "metrics": metrics}
+
+
+def run_ledger(tiny):
+    """--ledger: run the serving and fleet microbenches with the perf
+    ledger on (SDTPU_PERF=1) and append one structural row per run to
+    BENCH_LEDGER.jsonl. The ledger is append-only: every row is a point on
+    the repo's perf trajectory, and tools/bench_compare.py diffs any two
+    rows (or a row vs a BENCH_*.json) against regression thresholds."""
+    with _EnvPatch(SDTPU_PERF="1"):
+        serving = run_serving(tiny)
+        fleet = run_fleet(tiny)
+    recorded_at = time.time()
+    rows = [
+        _ledger_row("serving", {
+            "chunk_compiles": serving.get("chunk_compiles"),
+            "coalesce_factor": serving.get("value"),
+            "bucket_hit_rate": serving.get("bucket_hit_rate"),
+            "avg_padding_ratio": serving.get("avg_padding_ratio"),
+            "unet_flops_per_image": serving.get("unet_flops_per_image"),
+            "dispatches": serving.get("dispatches"),
+            "coalesced_dispatches": serving.get("coalesced_dispatches"),
+        }, serving.get("device", ""), tiny, recorded_at),
+        _ledger_row("fleet", {
+            "slo_attainment": fleet.get("slo_attainment"),
+            "preemptions": fleet.get("preemptions"),
+            "quota_throttle_rate": fleet.get("quota_throttle_rate"),
+            "queue_wait_p95_s": fleet.get("queue_wait_p95_s"),
+            "interactive_p95_s": fleet.get("value"),
+            "fifo_interactive_p95_s": fleet.get("vs_baseline"),
+        }, fleet.get("device", ""), tiny, recorded_at),
+    ]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_LEDGER.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"bench: {len(rows)} ledger rows appended to {path} "
+          f"(diff with tools/bench_compare.py)", file=sys.stderr)
+    return {"ledger_path": path, "rows": rows}
+
+
 def _dump_flightrec(tag):
     """Persist the obs flight recorder (failed/interrupted/slow requests'
     span trees + correlated log lines) next to the bench outputs so a dead
@@ -1200,6 +1240,10 @@ def main() -> None:
                     help="int8 x step-cache grid: FLOPs/image, compile "
                          "counts, PSNR/SSIM vs bf16 per cell; writes "
                          "BENCH_int8.json (CPU-safe)")
+    ap.add_argument("--ledger", action="store_true",
+                    help="run the serving + fleet microbenches with the "
+                         "perf ledger on and append structural rows to "
+                         "BENCH_LEDGER.jsonl (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -1234,7 +1278,9 @@ def main() -> None:
     enable_compilation_cache()
 
     try:
-        if args.serving:
+        if args.ledger:
+            print(json.dumps(run_ledger(tiny)))
+        elif args.serving:
             print(json.dumps(run_serving(tiny)))
         elif args.fleet:
             print(json.dumps(run_fleet(tiny)))

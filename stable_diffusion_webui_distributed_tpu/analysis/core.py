@@ -38,6 +38,7 @@ RULES = {
     "RC003": "raw precision read outside pipeline/precision.py resolution",
     "EV001": "raw os.environ read outside runtime/config.py",
     "OB001": "time.time() used for a duration on a serving/pipeline/obs path",
+    "OB002": "ad-hoc Prometheus metric name outside the central registry",
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",

@@ -22,14 +22,17 @@ asks for a replica; p95 below ``SDTPU_AUTOSCALE_DOWN_S`` with more than
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 DEFAULT_UP_P95_S = 5.0
 DEFAULT_DOWN_P95_S = 0.5
 DEFAULT_COOLDOWN_S = 60.0
+#: audit-ring capacity default (SDTPU_AUTOSCALE_AUDIT)
+DEFAULT_AUDIT_CAP = 256
 
 
 @dataclasses.dataclass
@@ -102,7 +105,7 @@ class AutoscaleEngine:
                  cooldown_s: Optional[float] = None,
                  clock=time.monotonic) -> None:
         from stable_diffusion_webui_distributed_tpu.runtime.config import (
-            env_float,
+            env_float, env_int,
         )
 
         self.registry = registry
@@ -120,7 +123,19 @@ class AutoscaleEngine:
         self._lock = threading.Lock()
         self._hooks: List[Callable[[ScaleDecision], None]] = []  # guarded-by: _lock
         self._last_decision: Dict[str, float] = {}  # guarded-by: _lock
-        self._decisions: List[ScaleDecision] = []  # guarded-by: _lock
+        #: bounded decision audit ring (ISSUE 8: /internal/autoscale) —
+        #: each entry is asdict(decision) + a wall-clock decided_at so an
+        #: operator can line decisions up against external monitoring
+        self._audit_cap = max(1, env_int("SDTPU_AUTOSCALE_AUDIT",
+                                         DEFAULT_AUDIT_CAP))
+        # guarded-by: _lock
+        self._decisions: Deque[ScaleDecision] = \
+            collections.deque(maxlen=self._audit_cap)
+        # guarded-by: _lock
+        self._audit: Deque[Dict[str, object]] = \
+            collections.deque(maxlen=self._audit_cap)
+        self._audit_total = 0  # guarded-by: _lock
+        set_autoscale(self)  # last engine created serves /internal/autoscale
 
     def add_hook(self, hook: Callable[[ScaleDecision], None]) -> None:
         with self._lock:
@@ -156,6 +171,10 @@ class AutoscaleEngine:
             with self._lock:
                 self._last_decision[name] = now
                 self._decisions.append(decision)
+                entry = dict(dataclasses.asdict(decision))
+                entry["decided_at"] = time.time()  # audit-log wall clock
+                self._audit.append(entry)
+                self._audit_total += 1
                 hooks = list(self._hooks)
             for hook in hooks:  # outside the lock: hooks may re-enter
                 hook(decision)
@@ -174,8 +193,46 @@ class AutoscaleEngine:
             "thresholds": {"up_p95_s": self.up_p95_s,
                            "down_p95_s": self.down_p95_s,
                            "cooldown_s": self.cooldown_s},
-            "decisions": [dataclasses.asdict(d) for d in decisions[-16:]],
+            "decisions": [dataclasses.asdict(d)
+                          for d in list(decisions)[-16:]],
         }
+
+    def audit(self) -> Dict[str, object]:
+        """Full bounded audit ring for ``/internal/autoscale`` — every
+        retained decision with its wall-clock timestamp, plus how many
+        were made overall so a reader can tell when the ring wrapped."""
+        with self._lock:
+            entries = list(self._audit)
+            total = self._audit_total
+        return {
+            "active": True,
+            "slices": self.registry.summary(),
+            "thresholds": {"up_p95_s": self.up_p95_s,
+                           "down_p95_s": self.down_p95_s,
+                           "cooldown_s": self.cooldown_s},
+            "capacity": self._audit_cap,
+            "decisions_total": total,
+            "decisions": entries,
+        }
+
+
+# -- module-level active engine (server/api.py reads it) -------------------
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[AutoscaleEngine] = None  # guarded-by: _ACTIVE_LOCK
+
+
+def set_autoscale(engine: Optional[AutoscaleEngine]) -> None:
+    """Install ``engine`` as the process-wide autoscaler (last one wins);
+    ``AutoscaleEngine.__init__`` calls this automatically."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = engine
+
+
+def get_autoscale() -> Optional[AutoscaleEngine]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE
 
 
 def _default_quantile_source() -> float:

@@ -37,12 +37,27 @@ class FlightRecorder:
 
     def record(self, request_id: str, reason: str, detail: str,
                events: List[Dict[str, Any]],
-               duration_s: float = 0.0) -> Dict[str, Any]:
-        """Append one failure entry; returns it (already JSON-plain)."""
+               duration_s: float = 0.0,
+               perf: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Append one failure entry; returns it (already JSON-plain).
+
+        ``perf`` carries the failing request's device-time attribution
+        (MFU / padding / compile totals); left ``None`` the recorder pulls
+        the perf ledger's last-dispatch snapshot itself, so span-layer
+        callers need no knowledge of the ledger."""
         from stable_diffusion_webui_distributed_tpu.runtime.logging import (
             lines_for_request,
         )
 
+        if perf is None:
+            try:
+                from stable_diffusion_webui_distributed_tpu.obs import (
+                    perf as obs_perf,
+                )
+
+                perf = obs_perf.LEDGER.last_dispatch()
+            except Exception:  # noqa: BLE001 — recorder must never fail
+                perf = None
         entry = {
             "request_id": str(request_id),
             "reason": str(reason),
@@ -51,6 +66,8 @@ class FlightRecorder:
             # log files and dashboards, which speak wall time
             "recorded_at": time.time(),  # sdtpu-lint: wallclock
             "duration_s": float(duration_s),
+            # None until a dispatch ran with SDTPU_PERF on
+            "perf": perf,
             "spans": list(events),
             "logs": lines_for_request(request_id),
         }

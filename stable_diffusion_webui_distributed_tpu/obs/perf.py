@@ -1,0 +1,402 @@
+"""Perf ledger: always-on device-time attribution and self-checking budgets.
+
+PERF.md's roofline was computed by hand from one-shot BENCH files; this
+module makes the same numbers *live*. The serving dispatcher reports every
+device dispatch here — host-observed seconds joined with the FLOPs that
+``FlopsAccountant`` priced for the same denoise range — and the ledger
+folds them into per-(bucket, cadence, precision) groups carrying:
+
+- **MFU**: dispatched FLOPs / device seconds / chip peak (``None`` on CPU
+  or unknown hardware, so a dev box can never fabricate an MFU claim);
+- **padding waste**: true-requested pixels vs padded-dispatched pixels —
+  the per-bucket version of BENCH_serving.json's ``avg_padding_ratio``,
+  the gauge the ragged-dispatch work will be judged against (ROADMAP);
+- **compile latency** per stage kind (``Engine._cached`` reports builds);
+- **SLO attainment + burn rate** per (tenant, class) when the fleet gate
+  is on (burn rate = windowed miss fraction / error budget, the
+  Google-SRE multi-window signal shape).
+
+Everything is gated on ``SDTPU_PERF`` (default OFF): with the knob off
+every record call is a cheap no-op and the dispatch path stays
+byte-identical to the uninstrumented build. Recording is host-side
+arithmetic under one lock — never a device sync. ``/internal/perf``
+serves :meth:`PerfLedger.summary`; ``obs/prometheus.py`` renders the same
+groups as ``sdtpu_perf_*`` families.
+
+:func:`executables_census` is the compile-budget self-check behind
+``/internal/executables``: it groups the engine's live compiled-stage
+keys by shape bucket and alarms when any bucket exceeds the contracted
+≤2 step-cache × ≤3 precision chunk executables (PR 3 / PR 7 invariants).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
+
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_flag, env_float, env_int,
+)
+
+#: Default cap on distinct (bucket, cadence, precision) ledger groups and
+#: on distinct (tenant, class) SLO rows — adversarial tenant names must
+#: not grow the ledger without bound (oldest-touched rows are evicted).
+DEFAULT_GROUPS = 64
+#: Sliding window (dispatch completions) behind the SLO burn-rate gauge.
+SLO_WINDOW = 64
+#: Default SLO attainment target: burn rate 1.0 means missing exactly the
+#: (1 - target) error budget.
+DEFAULT_SLO_TARGET = 0.95
+
+#: Contracted executable budget per shape bucket (PR 3: plain + step-cache
+#: variants; PR 7: ≤3 precision rungs over the same param tree).
+STEP_CACHE_BUDGET = 2
+PRECISION_BUDGET = 3
+
+#: bf16 peak FLOPs/s per chip by device_kind substring (public specs);
+#: bench.py's MFU estimate shares this table via :func:`peak_flops_for`.
+PEAK_FLOPS_BF16: Dict[str, float] = {
+    "v6e": 918e12, "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5": 197e12,
+    "v4": 275e12,
+}
+#: int8 MXU peak relative to bf16 (BENCH_int8.json's mxu_peak_ratio).
+INT8_PEAK_RATIO = 2.0
+
+
+def enabled() -> bool:
+    """Live read of the master knob — tests and bench phases flip the env
+    var at runtime, so this is re-read per record call (it is one dict
+    lookup; the off path must stay near-free)."""
+    return env_flag("SDTPU_PERF", False)
+
+
+def peak_flops_for(device_kind: str, precision: str = "bf16"
+                   ) -> Optional[float]:
+    """Peak FLOPs/s for a device kind at a serving precision, or ``None``
+    when the hardware is unknown (CPU dev boxes: MFU stays null rather
+    than inventing a denominator). ``SDTPU_PERF_PEAK_FLOPS`` overrides
+    the table outright — deterministic MFU in tests, and a forward knob
+    for chips the table hasn't met."""
+    override = env_float("SDTPU_PERF_PEAK_FLOPS", 0.0)
+    if override > 0:
+        return override
+    dk = str(device_kind or "").lower().replace(" ", "")
+    for key, val in PEAK_FLOPS_BF16.items():
+        if key in dk:
+            if str(precision or "").startswith("int8"):
+                return val * INT8_PEAK_RATIO
+            return val
+    return None
+
+
+def _device_kind() -> str:
+    """Best-effort device kind for the MFU denominator. jax is already
+    imported by the time anything dispatches; failure means "unknown"
+    (MFU null), never an exception on the dispatch path."""
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — telemetry must not fail dispatch
+        return ""
+
+
+class PerfLedger:
+    """Thread-safe accumulator behind ``/internal/perf``.
+
+    Group rows and SLO rows are bounded ``OrderedDict`` rings: recording
+    touches move a row to the back, and inserts beyond ``max_groups``
+    evict the least-recently-touched row (counted, so the summary can say
+    coverage was dropped rather than silently truncating)."""
+
+    def __init__(self, max_groups: Optional[int] = None,
+                 slo_target: Optional[float] = None) -> None:
+        if max_groups is None:
+            max_groups = env_int("SDTPU_PERF_GROUPS", DEFAULT_GROUPS)
+        if slo_target is None:
+            slo_target = env_float("SDTPU_PERF_SLO_TARGET",
+                                   DEFAULT_SLO_TARGET)
+        self.max_groups = max(1, int(max_groups or DEFAULT_GROUPS))
+        self.slo_target = min(0.9999, max(0.0, float(slo_target)))
+        self._lock = threading.Lock()
+        self._groups: "OrderedDict[Tuple[str, int, str], Dict[str, float]]" \
+            = OrderedDict()  # guarded-by: _lock
+        self._groups_evicted = 0  # guarded-by: _lock
+        self._compiles: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
+        self._slo: "OrderedDict[Tuple[str, str], Dict[str, Any]]" \
+            = OrderedDict()  # guarded-by: _lock
+        self._slo_evicted = 0  # guarded-by: _lock
+        self._last_dispatch: Optional[Dict[str, Any]] = None  # guarded-by: _lock
+        self._device_kind: Optional[str] = None  # guarded-by: _lock
+
+    # -- recording (dispatcher / engine side) ------------------------------
+
+    def record_dispatch(self, *, bucket: str, cadence: int, precision: str,
+                        device_s: float, flops: float, requests: int,
+                        batch_raw: int, batch_run: int, true_pixels: int,
+                        padded_pixels: int) -> None:
+        """One device dispatch: host-observed seconds + the FLOPs priced
+        for the same denoise range + true-vs-padded shape accounting.
+        No-op (and never raises) when ``SDTPU_PERF`` is off."""
+        if not enabled():
+            return
+        try:
+            key = (str(bucket), int(cadence), str(precision))
+            with self._lock:
+                if self._device_kind is None:
+                    self._device_kind = _device_kind()
+                g = self._groups.get(key)
+                if g is None:
+                    if len(self._groups) >= self.max_groups:
+                        self._groups.popitem(last=False)
+                        self._groups_evicted += 1
+                    g = {"dispatches": 0, "requests": 0, "device_s": 0.0,
+                         "flops": 0.0, "true_pixels": 0, "padded_pixels": 0,
+                         "batch_raw": 0, "batch_run": 0}
+                    self._groups[key] = g
+                else:
+                    self._groups.move_to_end(key)
+                g["dispatches"] += 1
+                g["requests"] += int(requests)
+                g["device_s"] += max(0.0, float(device_s))
+                g["flops"] += max(0.0, float(flops))
+                g["true_pixels"] += int(true_pixels)
+                g["padded_pixels"] += int(padded_pixels)
+                g["batch_raw"] += int(batch_raw)
+                g["batch_run"] += int(batch_run)
+                compiles_total = sum(int(c["count"])
+                                     for c in self._compiles.values())
+                self._last_dispatch = self._dispatch_entry(
+                    key, g, device_s, flops, self._device_kind,
+                    compiles_total)
+        except Exception:  # noqa: BLE001 — telemetry must not fail dispatch
+            pass
+
+    def record_compile(self, kind: str, seconds: float) -> None:
+        """One compiled-stage build (``Engine._cached``); also feeds the
+        per-kind Prometheus compile-latency histogram."""
+        if not enabled():
+            return
+        try:
+            with self._lock:
+                c = self._compiles.setdefault(
+                    str(kind), {"count": 0, "total_s": 0.0, "max_s": 0.0,
+                                "last_s": 0.0})
+                c["count"] += 1
+                c["total_s"] += max(0.0, float(seconds))
+                c["max_s"] = max(c["max_s"], float(seconds))
+                c["last_s"] = float(seconds)
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                prometheus as obs_prom,
+            )
+
+            obs_prom.observe_compile(str(kind), float(seconds))
+        except Exception:  # noqa: BLE001 — telemetry must not fail compiles
+            pass
+
+    def record_slo(self, *, tenant: str, cls: str, slo_s: float,
+                   latency_s: float, ok: bool = True) -> None:
+        """One fleet-gated request completion against its resolved SLO.
+        ``met`` requires both success and on-time delivery — an errored
+        request burns the same budget as a late one."""
+        if not enabled():
+            return
+        try:
+            met = bool(ok) and float(latency_s) <= float(slo_s)
+            key = (str(tenant), str(cls))
+            with self._lock:
+                row = self._slo.get(key)
+                if row is None:
+                    if len(self._slo) >= self.max_groups:
+                        self._slo.popitem(last=False)
+                        self._slo_evicted += 1
+                    row = {"total": 0, "met": 0, "slo_s": float(slo_s),
+                           "window": deque(maxlen=SLO_WINDOW)}
+                    self._slo[key] = row
+                else:
+                    self._slo.move_to_end(key)
+                row["total"] += 1
+                row["met"] += 1 if met else 0
+                row["slo_s"] = float(slo_s)
+                row["window"].append(1 if met else 0)
+        except Exception:  # noqa: BLE001 — telemetry must not fail dispatch
+            pass
+
+    # -- derivation --------------------------------------------------------
+
+    @staticmethod
+    def _dispatch_entry(key: Tuple[str, int, str],
+                        g: Dict[str, float], device_s: float,
+                        flops: float, device_kind: Optional[str],
+                        compiles_total: int) -> Dict[str, Any]:
+        # static: the caller (record_dispatch, under _lock) passes the
+        # guarded values in, so this stays pure derivation; computes the
+        # flight-recorder snapshot for THIS dispatch (instant values, not
+        # the group's running sums)
+        peak = peak_flops_for(device_kind or "", key[2])
+        mfu = None
+        if peak and device_s > 0:
+            mfu = float(flops) / float(device_s) / peak
+        true_px = g["true_pixels"]
+        padded_px = g["padded_pixels"]
+        return {
+            "bucket": key[0], "cadence": key[1], "precision": key[2],
+            "device_s": round(float(device_s), 6),
+            "flops": float(flops),
+            "mfu": mfu,
+            "padding_ratio": (padded_px / true_px) if true_px else None,
+            "compiles_total": int(compiles_total),
+        }
+
+    @staticmethod
+    def _group_row(key: Tuple[str, int, str], g: Dict[str, float],
+                   device_kind: Optional[str]) -> Dict[str, Any]:
+        # static for the same reason as _dispatch_entry (LK001 discipline)
+        peak = peak_flops_for(device_kind or "", key[2])
+        mfu = None
+        if peak and g["device_s"] > 0:
+            mfu = g["flops"] / g["device_s"] / peak
+        true_px, padded_px = g["true_pixels"], g["padded_pixels"]
+        ratio = (padded_px / true_px) if true_px else None
+        return {
+            "bucket": key[0], "cadence": key[1], "precision": key[2],
+            "dispatches": int(g["dispatches"]),
+            "requests": int(g["requests"]),
+            "device_s": g["device_s"],
+            "flops": g["flops"],
+            "mfu": mfu,
+            "padding_ratio": ratio,
+            "padding_waste": (1.0 - true_px / padded_px) if padded_px
+            else None,
+            "batch_raw": int(g["batch_raw"]),
+            "batch_run": int(g["batch_run"]),
+        }
+
+    def _slo_row(self, key: Tuple[str, str],
+                 row: Dict[str, Any]) -> Dict[str, Any]:
+        window = list(row["window"])
+        misses = window.count(0)
+        budget = 1.0 - self.slo_target
+        burn = (misses / len(window)) / budget if window and budget > 0 \
+            else 0.0
+        return {
+            "tenant": key[0], "class": key[1], "slo_s": row["slo_s"],
+            "total": row["total"], "met": row["met"],
+            "attainment": row["met"] / row["total"] if row["total"] else None,
+            "window": len(window), "window_misses": misses,
+            "burn_rate": burn,
+        }
+
+    # -- readers -----------------------------------------------------------
+
+    def last_dispatch(self) -> Optional[Dict[str, Any]]:
+        """The most recent dispatch's perf snapshot (flight recorder)."""
+        with self._lock:
+            return dict(self._last_dispatch) if self._last_dispatch else None
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``/internal/perf`` body."""
+        with self._lock:
+            groups = [self._group_row(k, g, self._device_kind)
+                      for k, g in self._groups.items()]
+            slo = [self._slo_row(k, r) for k, r in self._slo.items()]
+            compiles = {k: dict(c) for k, c in self._compiles.items()}
+            evicted, slo_evicted = self._groups_evicted, self._slo_evicted
+            device_kind = self._device_kind or ""
+        return {
+            "enabled": enabled(),
+            "device_kind": device_kind,
+            "peak_flops_bf16": peak_flops_for(device_kind, "bf16"),
+            "groups": groups,
+            "groups_evicted": evicted,
+            "compiles": compiles,
+            "slo": slo,
+            "slo_evicted": slo_evicted,
+            "slo_target": self.slo_target,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._groups.clear()
+            self._compiles.clear()
+            self._slo.clear()
+            self._groups_evicted = 0
+            self._slo_evicted = 0
+            self._last_dispatch = None
+            self._device_kind = None
+
+
+#: Process-wide ledger (mirrors METRICS / STATS / TRACER).
+LEDGER = PerfLedger()
+
+
+# -- executable census -------------------------------------------------------
+
+def census_from_keys(keys: Iterable[Tuple],
+                     step_cache_budget: int = STEP_CACHE_BUDGET,
+                     precision_budget: int = PRECISION_BUDGET
+                     ) -> Dict[str, Any]:
+    """Group compiled-stage cache keys by shape bucket and check the
+    chunk-executable budget. Chunk keys are
+    ``("chunk", sampler, steps, w, h, batch, ..., step_cache, precision)``
+    (pipeline/engine.py) — everything between the kind and the last two
+    axes identifies the bucket; the last two axes are the budgeted
+    variants."""
+    buckets: "OrderedDict[Tuple, Dict[str, Any]]" = OrderedDict()
+    other = 0
+    total_chunks = 0
+    for k in keys:
+        if not (isinstance(k, tuple) and len(k) >= 8 and k[0] == "chunk"):
+            other += 1
+            continue
+        total_chunks += 1
+        ident = k[1:-2]
+        b = buckets.get(ident)
+        if b is None:
+            b = {
+                "bucket": f"{k[1]}/{k[2]}st {k[3]}x{k[4]} b{k[5]}",
+                "executables": 0,
+                "step_cache_variants": set(),
+                "precision_variants": set(),
+            }
+            buckets[ident] = b
+        b["executables"] += 1
+        b["step_cache_variants"].add(k[-2])
+        b["precision_variants"].add(str(k[-1]))
+    rows: List[Dict[str, Any]] = []
+    over: List[str] = []
+    for b in buckets.values():
+        sc, prec = b["step_cache_variants"], b["precision_variants"]
+        over_budget = (len(sc) > step_cache_budget
+                       or len(prec) > precision_budget
+                       or b["executables"] > step_cache_budget
+                       * precision_budget)
+        rows.append({
+            "bucket": b["bucket"],
+            "executables": b["executables"],
+            "step_cache_variants": len(sc),
+            "precisions": sorted(prec),
+            "over_budget": over_budget,
+        })
+        if over_budget:
+            over.append(b["bucket"])
+    return {
+        "buckets": rows,
+        "chunk_executables": total_chunks,
+        "other_executables": other,
+        "budget": {"step_cache": step_cache_budget,
+                   "precision": precision_budget,
+                   "per_bucket": step_cache_budget * precision_budget},
+        "over_budget": over,
+        "alarm": bool(over),
+    }
+
+
+def executables_census(engine: Any) -> Dict[str, Any]:
+    """Live census over an engine's compiled-stage cache (the
+    ``/internal/executables`` body). Pure read — no compiles, no device
+    work."""
+    return census_from_keys(engine.executable_keys())

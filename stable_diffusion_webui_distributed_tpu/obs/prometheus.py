@@ -21,6 +21,7 @@ numbers in scrapeable form.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 from collections import deque
 from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
@@ -43,9 +44,73 @@ def _fmt(v: Any) -> str:
     return repr(f)
 
 
-def _label(v: Any) -> str:
+#: Longest label value exposed; tenant/class names are user-supplied and a
+#: kilobyte tenant string must not bloat every scrape.
+_MAX_LABEL_LEN = 100
+
+
+def sanitize_label_value(v: Any) -> str:
+    """User-supplied label values (tenant names, fleet classes, precision
+    aliases) made exposition-safe: C0 control characters and DEL are
+    dropped (``\\n`` survives — it escapes losslessly), then the value is
+    truncated. Escaping alone is NOT enough: a ``\\r`` would survive the
+    0.0.4 escape rules verbatim and split the sample line."""
     s = str(v)
+    s = "".join(ch for ch in s if (ord(ch) >= 32 or ch == "\n")
+                and ord(ch) != 127)
+    return s[:_MAX_LABEL_LEN]
+
+
+def _label(v: Any) -> str:
+    s = sanitize_label_value(v)
     return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# -- metric registry ---------------------------------------------------------
+
+#: Legal metric-family name: the Prometheus exposition grammar. The
+#: ``sdtpu_`` prefix discipline is lexical (OB002 flags prefixed literals
+#: outside this module), not a registry constraint — tests register
+#: throwaway families under other names.
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+_REGISTRY_LOCK = threading.Lock()
+#: family name -> (type, help). Every family this module exposes is
+#: declared here; lint rule OB002 (analysis/metricrules.py) forbids ad-hoc
+#: ``sdtpu_``-prefixed metric-name strings anywhere else in the package,
+#: so this registry IS the metric namespace.
+_REGISTRY: Dict[str, Tuple[str, str]] = {}  # guarded-by: _REGISTRY_LOCK
+
+
+class MetricRegistrationError(ValueError):
+    """Bad metric name, bad type, or a name re-registered as a different
+    type (two families colliding on one name corrupts the exposition)."""
+
+
+def register_metric(name: str, mtype: str, help_text: str) -> str:
+    """Declare (idempotently) a metric family; returns the name so call
+    sites can use it inline. The single sanctioned way to mint a
+    ``sdtpu_*`` metric name (OB002)."""
+    if not _NAME_RE.match(name):
+        raise MetricRegistrationError(
+            f"metric name {name!r} must match {_NAME_RE.pattern}")
+    if mtype not in ("counter", "gauge", "histogram"):
+        raise MetricRegistrationError(
+            f"metric type {mtype!r} must be counter/gauge/histogram")
+    with _REGISTRY_LOCK:
+        prev = _REGISTRY.get(name)
+        if prev is not None and prev[0] != mtype:
+            raise MetricRegistrationError(
+                f"metric {name} already registered as {prev[0]}, "
+                f"not {mtype}")
+        _REGISTRY[name] = (mtype, help_text)
+    return name
+
+
+def registered_metrics() -> Dict[str, Tuple[str, str]]:
+    """Snapshot of the declared families (name -> (type, help))."""
+    with _REGISTRY_LOCK:
+        return dict(_REGISTRY)
 
 
 def _bucket_label(b: float) -> str:
@@ -58,7 +123,7 @@ class Histogram:
     def __init__(self, name: str, help_text: str,
                  buckets: Iterable[float] = BUCKETS,
                  labels: str = "") -> None:
-        self.name = name
+        self.name = register_metric(name, "histogram", help_text)
         self.help = help_text
         #: pre-rendered label body (e.g. ``class="interactive"``) merged
         #: into every sample; HELP/TYPE are emitted by the caller when a
@@ -165,9 +230,32 @@ def clear_histograms() -> None:
         h.clear()
     with _FLEET_LOCK:
         _FLEET_QUEUE_WAIT.clear()
+    with _COMPILE_LOCK:
+        _COMPILE_LAT.clear()
     for c in FLEET_COUNTERS.values():
         c.clear()
     PRECISION_COUNTER.clear()
+
+
+# -- compile latency (pipeline/engine.py via obs/perf.py) --------------------
+
+_COMPILE_LOCK = threading.Lock()
+#: per-stage-kind compile-latency histograms, created on first build
+_COMPILE_LAT: Dict[str, Histogram] = {}  # guarded-by: _COMPILE_LOCK
+
+
+def observe_compile(kind: str, seconds: float) -> None:
+    """One compiled-stage build's latency (``Engine._cached`` reports it
+    through the perf ledger; gated there on ``SDTPU_PERF``)."""
+    with _COMPILE_LOCK:
+        h = _COMPILE_LAT.get(kind)
+        if h is None:
+            h = Histogram(
+                "sdtpu_compile_seconds",
+                "XLA stage-build (compile) latency by stage kind.",
+                labels=f'kind="{_label(kind)}"')
+            _COMPILE_LAT[kind] = h
+    h.observe(seconds)
 
 
 # -- fleet tier (fleet/ package) --------------------------------------------
@@ -177,7 +265,7 @@ class LabeledCounter:
 
     def __init__(self, name: str, help_text: str,
                  label_names: Tuple[str, ...]) -> None:
-        self.name = name
+        self.name = register_metric(name, "counter", help_text)
         self.help = help_text
         self.label_names = label_names
         self._lock = threading.Lock()
@@ -354,9 +442,80 @@ ETA_GAUGE = EtaGauge()
 
 def _scalar(lines: List[str], name: str, mtype: str, help_text: str,
             value: Any, labels: str = "") -> None:
+    register_metric(name, mtype, help_text)
     lines.append(f"# HELP {name} {help_text}")
     lines.append(f"# TYPE {name} {mtype}")
     lines.append(f"{name}{labels} {_fmt(value)}")
+
+
+def _labeled_family(lines: List[str], name: str, mtype: str,
+                    help_text: str,
+                    samples: List[Tuple[str, Any]]) -> None:
+    """One HELP/TYPE header + one sample per (label-body, value) pair;
+    families with no samples are omitted entirely."""
+    if not samples:
+        return
+    register_metric(name, mtype, help_text)
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} {mtype}")
+    for body, value in samples:
+        lines.append(f"{name}{{{body}}} {_fmt(value)}")
+
+
+def _render_perf(lines: List[str]) -> None:
+    """The perf-ledger families: per-(bucket, cadence, precision) MFU /
+    padding / device-time attribution and per-(tenant, class) SLO gauges.
+    All pulled live from obs/perf.py's LEDGER — empty (and absent from
+    the exposition) until SDTPU_PERF turns recording on."""
+    from stable_diffusion_webui_distributed_tpu.obs import perf as obs_perf
+
+    s = obs_perf.LEDGER.summary()
+
+    def body(g):
+        return (f'bucket="{_label(g["bucket"])}",'
+                f'cadence="{g["cadence"]}",'
+                f'precision="{_label(g["precision"])}"')
+
+    groups = s["groups"]
+    _labeled_family(
+        lines, "sdtpu_perf_dispatches_total", "counter",
+        "Device dispatches by serving group (perf ledger).",
+        [(body(g), g["dispatches"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_device_seconds_total", "counter",
+        "Host-observed device-dispatch seconds by serving group.",
+        [(body(g), g["device_s"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_flops_total", "counter",
+        "Dispatched UNet FLOPs by serving group (cost_analysis priced).",
+        [(body(g), g["flops"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_mfu", "gauge",
+        "Live MFU: dispatched FLOPs / device seconds / chip peak "
+        "(NaN when the peak is unknown, e.g. CPU).",
+        [(body(g), g["mfu"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_padding_ratio", "gauge",
+        "Padded-dispatched pixels / true-requested pixels by group.",
+        [(body(g), g["padding_ratio"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_padding_waste", "gauge",
+        "Fraction of dispatched pixels that were bucket padding.",
+        [(body(g), g["padding_waste"]) for g in groups])
+
+    def slo_body(r):
+        return (f'tenant="{_label(r["tenant"])}",'
+                f'class="{_label(r["class"])}"')
+
+    slo = s["slo"]
+    _labeled_family(
+        lines, "sdtpu_fleet_slo_attainment", "gauge",
+        "Fraction of fleet-gated requests meeting their SLO, by tenant "
+        "and class.", [(slo_body(r), r["attainment"]) for r in slo])
+    _labeled_family(
+        lines, "sdtpu_fleet_slo_burn_rate", "gauge",
+        "Windowed SLO miss fraction over the error budget (1.0 = burning "
+        "exactly the budget).", [(slo_body(r), r["burn_rate"]) for r in slo])
 
 
 def render() -> str:
@@ -443,6 +602,11 @@ def render() -> str:
                        for k in sorted(_FLEET_QUEUE_WAIT)]
     for i, h in enumerate(fleet_hists):
         lines.extend(h.render(header=(i == 0)))
+    with _COMPILE_LOCK:
+        compile_hists = [_COMPILE_LAT[k] for k in sorted(_COMPILE_LAT)]
+    for i, h in enumerate(compile_hists):
+        lines.extend(h.render(header=(i == 0)))
+    _render_perf(lines)
 
     eta = ETA_GAUGE.summary()
     _scalar(lines, "sdtpu_eta_mpe_percent", "gauge",

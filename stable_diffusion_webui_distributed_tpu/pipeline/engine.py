@@ -23,6 +23,7 @@ Key properties:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -231,7 +232,7 @@ class Engine:
         )
 
         from stable_diffusion_webui_distributed_tpu.obs import (
-            spans as obs_spans,
+            perf as obs_perf, spans as obs_spans,
         )
 
         with self._cache_lock:
@@ -242,13 +243,24 @@ class Engine:
                 # serving layer asserts on this counter (compile count,
                 # bucket hit rate) instead of wall-clock
                 METRICS.record_compile(key[0])
+                t0 = time.perf_counter()
                 with obs_spans.span("compile", kind=str(key[0]),
                                     key=str(key)):
                     fn = build()
+                # perf ledger: compile count + latency histogram per kind
+                # (no-op unless SDTPU_PERF; perf_counter is passive)
+                obs_perf.LEDGER.record_compile(
+                    str(key[0]), time.perf_counter() - t0)
                 self._cache[key] = fn
             else:
                 METRICS.record_cache_hit(key[0])
         return fn
+
+    def executable_keys(self) -> list:
+        """Snapshot of the live compiled-stage cache keys — the input to
+        the /internal/executables budget census (obs/perf.py)."""
+        with self._cache_lock:
+            return list(self._cache)
 
     def _has_batch_bucket(self, sampler: str, steps: int, width: int,
                           height: int, batch: int) -> bool:

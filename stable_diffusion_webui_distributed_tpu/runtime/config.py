@@ -117,6 +117,27 @@ def _logger():
 #   per-class queue-wait p95 crosses UP_S, down when it falls below
 #   DOWN_S, with at most one decision per slice per cooldown
 #   (fleet/slices.py; decision engine + hooks only, no provisioning).
+# - ``SDTPU_AUTOSCALE_AUDIT`` (int, default 256): autoscale decision
+#   audit-ring capacity behind ``/internal/autoscale`` — every retained
+#   decision with its wall-clock timestamp (fleet/slices.py).
+# - ``SDTPU_PERF`` (flag, default off): the perf ledger (obs/perf.py).
+#   On, every device dispatch reports host-observed seconds + accounted
+#   FLOPs into per-(bucket, cadence, precision) MFU / padding-waste
+#   groups served at ``/internal/perf`` and as ``sdtpu_perf_*``
+#   Prometheus families; compile builds and fleet SLO outcomes feed the
+#   same ledger. Off (the default), every record call is a no-op and
+#   the dispatch path is byte-identical to the uninstrumented build.
+# - ``SDTPU_PERF_GROUPS`` (int, default 64): bounded ledger width —
+#   distinct (bucket, cadence, precision) rows and distinct (tenant,
+#   class) SLO rows each; least-recently-touched rows are evicted (and
+#   counted) so adversarial tenant names cannot grow the ledger.
+# - ``SDTPU_PERF_PEAK_FLOPS`` (float FLOP/s, default 0 = auto): MFU
+#   denominator override. 0 resolves the chip's bf16 peak from the
+#   built-in table (int8 counts double); unknown hardware (CPU dev
+#   boxes) reports MFU null rather than inventing a denominator.
+# - ``SDTPU_PERF_SLO_TARGET`` (float, default 0.95): SLO attainment
+#   target behind the burn-rate gauge — burn 1.0 means consuming the
+#   (1 - target) error budget exactly.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -540,6 +540,61 @@ class ApiServer:
             return {"stopped_dir": trace.stop_trace()}
         raise ApiError(422, "action must be 'start' or 'stop'")
 
+    def handle_profile_get(self, query: Dict[str, str]) -> Dict[str, Any]:
+        """One-shot jax.profiler capture: ``GET /internal/profile?seconds=N``
+        starts a trace, sleeps N seconds, stops it and returns the capture
+        directory. Same basename jail as the POST start/stop surface."""
+        import os
+        import time as _time
+
+        from stable_diffusion_webui_distributed_tpu.runtime import trace
+
+        try:
+            seconds = float(query.get("seconds", "1"))
+        except ValueError:
+            raise ApiError(422, "seconds must be a number")
+        seconds = min(60.0, max(0.1, seconds))
+        name = os.path.basename(str(query.get("dir", "trace")))
+        if name in ("", ".", ".."):
+            name = "trace"
+        log_dir = os.path.join("profile-traces", name)
+        if not trace.start_trace(log_dir):
+            raise ApiError(409, "a profiler capture is already running")
+        _time.sleep(seconds)
+        return {"captured_dir": trace.stop_trace(), "seconds": seconds}
+
+    def handle_perf(self) -> Dict[str, Any]:
+        """Perf-ledger summary (obs/perf.py): per-(bucket, cadence,
+        precision) MFU / padding-waste rows, compile latencies, and
+        per-(tenant, class) SLO attainment. Empty until SDTPU_PERF=1."""
+        from stable_diffusion_webui_distributed_tpu.obs import perf
+
+        return perf.LEDGER.summary()
+
+    def handle_executables(self) -> Dict[str, Any]:
+        """Live compiled-executable census against the serving budget of
+        <=2 step-cache x <=3 precision variants per shape bucket; the
+        ``alarm`` flag trips when any bucket exceeds it."""
+        from stable_diffusion_webui_distributed_tpu.obs import perf
+
+        engine = getattr(self.dispatcher, "engine", None) \
+            if self.dispatcher is not None else None
+        if engine is None or not hasattr(engine, "executable_keys"):
+            return {"available": False}
+        census = perf.executables_census(engine)
+        census["available"] = True
+        return census
+
+    def handle_autoscale(self) -> Dict[str, Any]:
+        """Autoscale decision audit (fleet/slices.py): the bounded ring of
+        every scale decision with wall-clock timestamps."""
+        from stable_diffusion_webui_distributed_tpu.fleet import slices
+
+        engine = slices.get_autoscale()
+        if engine is None:
+            return {"active": False}
+        return engine.audit()
+
     def handle_reset_mpe(self) -> Dict[str, Any]:
         """Clear every worker's ETA error history (the reference's
         debug-mode 'reset mpe' button, ui.py:282-287)."""
@@ -766,6 +821,10 @@ class ApiServer:
             ("GET", "/internal/trace.json"): self.handle_trace_json,
             ("GET", "/internal/metrics"): self.handle_metrics,
             ("GET", "/internal/flightrec"): self.handle_flightrec,
+            ("GET", "/internal/perf"): self.handle_perf,
+            ("GET", "/internal/executables"): self.handle_executables,
+            ("GET", "/internal/autoscale"): self.handle_autoscale,
+            ("GET", "/internal/profile"): self.handle_profile_get,
             ("POST", "/internal/profile"): self.handle_profile,
             ("POST", "/internal/reset-mpe"): self.handle_reset_mpe,
             ("POST", "/internal/restart-all"): self.handle_restart_all,
@@ -828,6 +887,14 @@ class ApiServer:
                         body = json.loads(raw or b"{}")
                         result = fn(body) if fn.__code__.co_argcount > 1 \
                             else fn()
+                    elif fn.__code__.co_argcount > 1:
+                        # GET handlers that declare a parameter receive the
+                        # query string as a flat single-value dict
+                        from urllib.parse import parse_qs
+
+                        query = {k: v[-1] for k, v in parse_qs(
+                            self.path.partition("?")[2]).items()}
+                        result = fn(query)
                     else:
                         result = fn()
                     if isinstance(result, TextResponse):

@@ -57,7 +57,7 @@ from stable_diffusion_webui_distributed_tpu.fleet import (
     quotas as fleet_quotas,
 )
 from stable_diffusion_webui_distributed_tpu.obs import (
-    prometheus as obs_prom,
+    perf as obs_perf, prometheus as obs_prom,
 )
 from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
 from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
@@ -463,7 +463,31 @@ class ServingDispatcher:
                                 leader_request_id=leader_req.request_id,
                                 leader_span_id=dsp.span_id)
                 for t in g.tickets:
+                    self._record_slo(t)
                     t.done.set()
+
+    def _record_slo(self, ticket: Ticket) -> None:
+        """Feed the perf ledger's per-(tenant, class) SLO attainment and
+        burn-rate rows (fleet + SDTPU_PERF on; never raises — observability
+        must not fail a finished request)."""
+        if self.fleet is None or not obs_perf.enabled():
+            return
+        try:
+            if ticket.cancelled.is_set():
+                return  # never dispatched / abandoned: not an SLO sample
+            pol = self.fleet.policy.resolve(ticket.fleet_class)
+            slo = float(getattr(ticket.payload, "slo_s", 0.0) or 0.0) \
+                or float(pol.slo_s or 0.0)
+            if slo <= 0:
+                return  # best-effort class with no target: nothing to meet
+            obs_perf.LEDGER.record_slo(
+                tenant=str(getattr(ticket.payload, "tenant", "")
+                           or "default"),
+                cls=pol.name, slo_s=slo,
+                latency_s=time.monotonic() - ticket.enqueued,
+                ok=ticket.error is None)
+        except Exception:  # noqa: BLE001 — observability stays best-effort
+            pass
 
     def _run_solo(self, ticket: Ticket) -> None:
         with self._device([ticket], ticket.run.total_images):
@@ -488,16 +512,38 @@ class ServingDispatcher:
                 prec = self._precision_name(ticket.run)
                 METRICS.record_dispatch(1, precision=prec)
                 obs_prom.count_precision(prec, 1)
+                # perf ledger (SDTPU_PERF): same passive attribution as
+                # the grouped path — no-op with the knob off
+                perf_on = obs_perf.enabled()
+                if perf_on:
+                    flops0 = METRICS.unet_flops_snapshot()
+                    t0_dev = time.perf_counter()
                 with obs_spans.span("dispatch.device", requests=1,
                                     precision=prec):
                     result = self.engine.generate_range(
                         ticket.run, 0, None, ticket.job)
+                if perf_on:
+                    from stable_diffusion_webui_distributed_tpu.pipeline \
+                        import stepcache
+                    n_img = ticket.run.total_images
+                    obs_perf.LEDGER.record_dispatch(
+                        bucket=f"{ticket.run.width}x{ticket.run.height}",
+                        cadence=int(stepcache.resolve(ticket.run).cadence),
+                        precision=prec,
+                        device_s=time.perf_counter() - t0_dev,
+                        flops=METRICS.unet_flops_snapshot() - flops0,
+                        requests=1, batch_raw=n_img, batch_run=n_img,
+                        true_pixels=ticket.payload.width
+                        * ticket.payload.height * n_img,
+                        padded_pixels=ticket.run.width
+                        * ticket.run.height * n_img)
                 if ticket.bucketed:
                     result = self._restore_solo(result, ticket)
                 ticket.result = result
             except BaseException as e:  # noqa: BLE001
                 ticket.error = e
             finally:
+                self._record_slo(ticket)
                 ticket.done.set()
 
     # -- merged execution --------------------------------------------------
@@ -577,9 +623,28 @@ class ServingDispatcher:
             ctx_c, pooled_c = _pad(ctx_c), _pad(pooled_c)
 
         x = engine._place_batch(noise.astype(jnp.float32) * sigmas[0])
+        # perf ledger (SDTPU_PERF): host-observed denoise seconds joined
+        # with the FLOPs delta the engine prices for this exact range —
+        # passive perf_counter reads, no extra device syncs, and with the
+        # knob off record_dispatch is a no-op (dispatch stays byte-
+        # identical to the uninstrumented path)
+        perf_on = obs_perf.enabled()
+        if perf_on:
+            flops0 = METRICS.unet_flops_snapshot()
+            t0_dev = time.perf_counter()
         latents = engine._denoise_range(
             rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
             width, height, 0, rp.steps, "txt2img", None, None, ())
+        if perf_on:
+            obs_perf.LEDGER.record_dispatch(
+                bucket=f"{width}x{height}", cadence=int(g.key[8]),
+                precision=str(g.key[-1]),
+                device_s=time.perf_counter() - t0_dev,
+                flops=METRICS.unet_flops_snapshot() - flops0,
+                requests=len(live), batch_raw=b_raw, batch_run=b_run,
+                true_pixels=sum(t.payload.width * t.payload.height * n_p
+                                for t, n_p in zip(live, counts)),
+                padded_pixels=width * height * b_run)
         entries = engine._queue_decoded(latents, 0, b_raw, width, height)
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)

@@ -120,6 +120,12 @@ class DispatchMetrics:
         with self._lock:
             return self.compiles.get(kind, 0)
 
+    def unet_flops_snapshot(self) -> float:
+        """Current dispatched-FLOPs total; the perf ledger takes a delta
+        around each device dispatch to attribute FLOPs per group."""
+        with self._lock:
+            return self.unet_flops_total
+
     def coalesce_factor(self) -> float:
         """Mean requests per device dispatch (1.0 = no coalescing yet)."""
         with self._lock:

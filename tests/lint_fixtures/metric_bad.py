@@ -1,0 +1,25 @@
+"""OB002 fixture: ad-hoc metric-name strings outside obs/prometheus.py.
+
+Loaded by tests/test_lint.py under a spoofed package-relative path so the
+metricrules pass sees it as package code.
+"""
+
+from stable_diffusion_webui_distributed_tpu.obs.prometheus import (
+    register_metric,
+)
+
+# BAD (line 12): metric-name literal rendered by hand, never registered
+LINE = "sdtpu_rogue_total"
+
+
+def render_adhoc(lines):
+    # BAD (line 17): second ad-hoc name, inside a function scope
+    lines.append("sdtpu_sneaky_gauge" + " 0")
+    return lines
+
+
+# OK: handed straight to the registry helper
+GOOD = register_metric("sdtpu_sanctioned_total", "counter", "fine")
+
+# OK: non-metric identifier opted out with the marker
+TOKEN = "sdtpu_not_a_metric"  # sdtpu-lint: metric

@@ -69,7 +69,7 @@ class TestRepoGate:
 
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
-                     "RC003", "EV001", "OB001", "LK001", "LK002",
+                     "RC003", "EV001", "OB001", "OB002", "LK001", "LK002",
                      "LK003", "FL001", "AL001", "AL002"):
             assert rule in RULES and RULES[rule]
 
@@ -161,6 +161,27 @@ class TestFixtures:
         # the fleet/ scope: zero FL001 findings (LK001 on the unannotated
         # attrs cannot fire either — they were never declared guarded)
         assert not _fixture_findings("fleet_bad.py")
+
+    def test_metric_family(self):
+        # OB002 is package-wide (minus the registry module itself): ad-hoc
+        # sdtpu_* metric-name literals must go through register_metric
+        rel = "stable_diffusion_webui_distributed_tpu/serving/metric_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "metric_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert found == {
+            ("OB002", 12),  # hand-rolled metric-name literal
+            ("OB002", 17),  # second ad-hoc name inside a function
+        }
+        # the register_metric() call and the '# sdtpu-lint: metric'
+        # marker (non-metric identifier) stay clean
+
+    def test_metric_rule_exempts_registry_module(self):
+        # the same literals inside obs/prometheus.py are the registry's
+        # own definitions: zero OB002 findings
+        rel = "stable_diffusion_webui_distributed_tpu/obs/prometheus.py"
+        mod = load_module(os.path.join(FIXTURES, "metric_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert not {f for f in found if f[0] == "OB002"}
 
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")

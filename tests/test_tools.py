@@ -518,3 +518,175 @@ class TestClassifyTriage:
                   2: {"connect": True, "instant_eof": False}}) == "alive"
         assert c({1: {"connect": False},
                   2: {"connect": True, "instant_eof": True}}) == "relay-dead"
+
+
+class TestBenchJson:
+    """tools/benchjson.py: the shared bench-artifact I/O contract every
+    report CLI loads through."""
+
+    def test_load_bench_roundtrip(self, tmp_path):
+        import benchjson
+
+        p = tmp_path / "BENCH_x.json"
+        p.write_text(json.dumps({"metric": "m", "value": 1.5}))
+        assert benchjson.load_bench(str(p), "t")["value"] == 1.5
+
+    def test_load_bench_errors_are_operator_ready(self, tmp_path):
+        import benchjson
+
+        with pytest.raises(benchjson.BenchJsonError) as e:
+            benchjson.load_bench(str(tmp_path / "nope.json"), "mytool",
+                                 hint="python bench.py --fleet")
+        assert "mytool:" in str(e.value)
+        assert "python bench.py --fleet" in str(e.value)
+
+        garbage = tmp_path / "g.json"
+        garbage.write_text("{not json")
+        with pytest.raises(benchjson.BenchJsonError):
+            benchjson.load_bench(str(garbage), "t")
+
+        arr = tmp_path / "a.json"
+        arr.write_text("[1, 2]")
+        with pytest.raises(benchjson.BenchJsonError) as e:
+            benchjson.load_bench(str(arr), "t")
+        assert "not a JSON object" in str(e.value)
+
+    def test_load_ledger_skips_blanks_keeps_order(self, tmp_path):
+        import benchjson
+
+        p = tmp_path / "L.jsonl"
+        p.write_text('{"kind": "serving"}\n\n{"kind": "fleet"}\n')
+        rows = benchjson.load_ledger(str(p), "t")
+        assert [r["kind"] for r in rows] == ["serving", "fleet"]
+
+    def test_load_ledger_errors(self, tmp_path):
+        import benchjson
+
+        with pytest.raises(benchjson.BenchJsonError):
+            benchjson.load_ledger(str(tmp_path / "nope.jsonl"), "t")
+        empty = tmp_path / "e.jsonl"
+        empty.write_text("\n\n")
+        with pytest.raises(benchjson.BenchJsonError) as e:
+            benchjson.load_ledger(str(empty), "t")
+        assert "no ledger rows" in str(e.value)
+        bad = tmp_path / "b.jsonl"
+        bad.write_text('{"ok": 1}\n[1]\n')
+        with pytest.raises(benchjson.BenchJsonError) as e:
+            benchjson.load_ledger(str(bad), "t")
+        assert "line 2" in str(e.value)
+
+    def test_fmt_placeholder_and_precision(self):
+        import benchjson
+
+        assert benchjson.fmt(None) == "-"
+        assert benchjson.fmt(0.5) == "0.500"
+        assert benchjson.fmt(3) == "3"
+        assert benchjson.fmt(2.0, suffix="x") == "2.000x"
+
+    def test_write_json_file_and_stdout(self, tmp_path, capsys):
+        import benchjson
+
+        out = tmp_path / "r.json"
+        benchjson.write_json({"a": 1}, str(out))
+        assert json.loads(out.read_text()) == {"a": 1}
+        assert out.read_text().endswith("\n")
+        benchjson.write_json({"b": 2})
+        assert json.loads(capsys.readouterr().out) == {"b": 2}
+
+
+class TestBenchCompare:
+    """tools/bench_compare.py: the regression gate over ledger rows and
+    BENCH artifacts — exit 0 clean, 1 regressed, 2 unusable input."""
+
+    @staticmethod
+    def _row(kind="serving", **metrics):
+        base = {"chunk_compiles": 2, "coalesce_factor": 4.0,
+                "bucket_hit_rate": 0.5, "avg_padding_ratio": 1.19,
+                "unet_flops_per_image": 1.0e10}
+        base.update(metrics)
+        return {"schema": 1, "kind": kind, "device": "cpu", "tiny": True,
+                "metrics": base}
+
+    def test_identical_rows_are_clean(self):
+        import bench_compare
+
+        v = bench_compare.compare(self._row(), self._row())
+        assert v["ok"] is True and v["regressions"] == []
+        assert v["compared"] == 5
+
+    def test_compile_count_regression_has_zero_tolerance(self):
+        import bench_compare
+
+        v = bench_compare.compare(self._row(),
+                                  self._row(chunk_compiles=3))
+        assert v["ok"] is False
+        assert v["regressions"] == ["chunk_compiles"]
+
+    def test_relative_threshold_allows_noise(self):
+        import bench_compare
+
+        # coalesce_factor tolerance is 10% relative: a 5% dip is noise,
+        # a 25% dip is a regression
+        ok = bench_compare.compare(self._row(),
+                                   self._row(coalesce_factor=3.8))
+        assert ok["ok"] is True
+        bad = bench_compare.compare(self._row(),
+                                    self._row(coalesce_factor=3.0))
+        assert bad["regressions"] == ["coalesce_factor"]
+
+    def test_improvements_never_fail(self):
+        import bench_compare
+
+        v = bench_compare.compare(
+            self._row(),
+            self._row(chunk_compiles=1, coalesce_factor=8.0,
+                      avg_padding_ratio=1.0, bucket_hit_rate=1.0,
+                      unet_flops_per_image=5.0e9))
+        assert v["ok"] is True
+
+    def test_value_alias_maps_bench_headline(self):
+        import bench_compare
+
+        base = {"metric": "tiny_serving_coalesce_factor", "value": 4.0}
+        head = {"metric": "tiny_serving_coalesce_factor", "value": 1.0}
+        v = bench_compare.compare(base, head)
+        assert v["regressions"] == ["coalesce_factor"]
+
+    def test_ledger_mode_oldest_vs_newest(self, tmp_path):
+        import bench_compare
+
+        p = tmp_path / "L.jsonl"
+        rows = [self._row(), {"schema": 1, "kind": "fleet",
+                              "metrics": {"slo_attainment": 1.0}},
+                self._row(coalesce_factor=4.2)]
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        assert bench_compare.main([str(p), "--kind", "serving"]) == 0
+
+        rows.append(self._row(chunk_compiles=4))    # seeded regression
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        assert bench_compare.main([str(p), "--kind", "serving"]) == 1
+
+    def test_unusable_input_exits_2(self, tmp_path, capsys):
+        import bench_compare
+
+        assert bench_compare.main([str(tmp_path / "nope.jsonl")]) == 2
+        one = tmp_path / "one.jsonl"
+        one.write_text(json.dumps(self._row()) + "\n")
+        assert bench_compare.main([str(one)]) == 2       # need 2 rows
+        assert bench_compare.main([str(one), "--base-row", "5"]) == 2
+
+        # artifact mode: nothing watched on either side
+        a = tmp_path / "a.json"
+        a.write_text(json.dumps({"foo": 1}))
+        assert bench_compare.main([str(a), str(a)]) == 2
+        assert "nothing" in capsys.readouterr().err
+
+    def test_json_verdict_and_current_artifacts(self, capsys):
+        import bench_compare
+
+        # the committed BENCH files must compare clean against themselves
+        # (wrapper artifacts unwrap through "parsed")
+        for name in ("BENCH_serving.json", "BENCH_fleet.json"):
+            assert bench_compare.main([name, name, "--json"]) == 0
+            v = json.loads(capsys.readouterr().out)
+            assert v["ok"] is True and v["compared"] >= 2

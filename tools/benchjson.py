@@ -1,0 +1,93 @@
+"""Shared bench-artifact I/O for the tools/ reports.
+
+Every report CLI in this directory consumes a ``bench.py`` JSON artifact
+(``BENCH_fleet.json``, ``BENCH_int8.json``, ...) or the append-only
+``BENCH_LEDGER.jsonl`` trajectory, and they all share the same contract:
+a missing or unparseable artifact is exit code 2 with a one-line stderr
+hint, and report values render with the same ``-`` placeholder for
+absent numbers. This module is that contract in one place —
+``fleet_report``, ``int8_report``, ``bench_compare`` load through
+:func:`load_bench` / :func:`load_ledger`, and ``flops_report`` writes
+through :func:`write_json`.
+
+tools/ is not a package: siblings import this as ``import benchjson``
+(the script directory is on ``sys.path`` when a tool runs directly, and
+tests insert ``tools/`` explicitly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+class BenchJsonError(Exception):
+    """A bench artifact is missing or unparseable. The message is already
+    operator-ready; CLI callers print it to stderr and return exit code 2."""
+
+
+def load_bench(path: str, tool: str, hint: str = "") -> Dict[str, Any]:
+    """Load one bench JSON document or raise :class:`BenchJsonError`.
+
+    ``tool`` prefixes the error message (the reporting CLI's name);
+    ``hint`` suggests the bench command that produces the artifact."""
+    extra = f" (run: {hint})" if hint else ""
+    if not os.path.exists(path):
+        raise BenchJsonError(f"{tool}: {path} not found{extra}")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise BenchJsonError(f"{tool}: cannot parse {path}: {e}")
+    if not isinstance(doc, dict):
+        raise BenchJsonError(
+            f"{tool}: {path} is not a JSON object (got "
+            f"{type(doc).__name__})")
+    return doc
+
+
+def load_ledger(path: str, tool: str) -> List[Dict[str, Any]]:
+    """Load BENCH_LEDGER.jsonl (one JSON object per line, blank lines
+    ignored) or raise :class:`BenchJsonError`. Row order is file order —
+    the ledger is append-only, so later rows are newer."""
+    if not os.path.exists(path):
+        raise BenchJsonError(
+            f"{tool}: {path} not found (run: python bench.py --ledger)")
+    rows: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for n, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if not isinstance(row, dict):
+                    raise ValueError(f"line {n} is not a JSON object")
+                rows.append(row)
+    except (OSError, ValueError) as e:
+        raise BenchJsonError(f"{tool}: cannot parse {path}: {e}")
+    if not rows:
+        raise BenchJsonError(f"{tool}: {path} holds no ledger rows")
+    return rows
+
+
+def fmt(v: Any, suffix: str = "") -> str:
+    """Render one report value: ``-`` for None, 3 decimals for floats."""
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def write_json(doc: Any, output: Optional[str] = None) -> None:
+    """Write a report document as indented JSON to ``output`` or stdout
+    (the flops_report generation path)."""
+    text = json.dumps(doc, indent=2) + "\n"
+    if output:
+        with open(output, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)

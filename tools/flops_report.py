@@ -24,7 +24,6 @@ Exit code is always 0; pricing failures surface as null cells.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -35,6 +34,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
 
+import benchjson  # noqa: E402  (tools/ sibling; shared bench-JSON I/O)
 from stable_diffusion_webui_distributed_tpu.models import (  # noqa: E402
     configs as C,
 )
@@ -132,13 +132,9 @@ def main(argv=None) -> int:
     report = build_report(steps=args.steps, width=args.width,
                           height=args.height, batch=args.batch,
                           sampler=args.sampler)
-    text = json.dumps(report, indent=2) + "\n"
+    benchjson.write_json(report, args.output)
     if args.output:
-        with open(args.output, "w", encoding="utf-8") as fh:
-            fh.write(text)
         print(f"wrote {args.output}", file=sys.stderr)
-    else:
-        sys.stdout.write(text)
     return 0
 
 

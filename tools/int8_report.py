@@ -21,16 +21,11 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 
+import benchjson
 
-def _fmt(v, suffix=""):
-    if v is None:
-        return "-"
-    if isinstance(v, float):
-        return f"{v:.3f}{suffix}"
-    return f"{v}{suffix}"
+_fmt = benchjson.fmt
 
 
 def build_summary(doc):
@@ -115,16 +110,11 @@ def main(argv=None) -> int:
                     help="emit the digested summary as JSON")
     args = ap.parse_args(argv)
 
-    if not os.path.exists(args.path):
-        print(f"int8_report: {args.path} not found "
-              f"(run: python bench.py --int8)", file=sys.stderr)
-        return 2
     try:
-        with open(args.path, "r", encoding="utf-8") as fh:
-            doc = json.load(fh)
-    except (OSError, ValueError) as e:
-        print(f"int8_report: cannot parse {args.path}: {e}",
-              file=sys.stderr)
+        doc = benchjson.load_bench(args.path, "int8_report",
+                                   hint="python bench.py --int8")
+    except benchjson.BenchJsonError as e:
+        print(e, file=sys.stderr)
         return 2
 
     summary = build_summary(doc)
