@@ -1154,6 +1154,73 @@ def run_fleet(tiny):
     return out
 
 
+def run_watchdog(tiny):
+    """--watchdog: structural hang-watchdog/requeue microbench — stub
+    workers only, no device. One worker is benchmarked fast but actually
+    ~20x slower than its ETA; with a tight SDTPU_WATCHDOG_FACTOR the hang
+    watchdog must latch the stall, the scheduler must requeue the stalled
+    range onto the healthy survivor, and the request must still deliver
+    every image. All reported numbers are structural (counts/ratios) so
+    tools/bench_compare.py can diff them across machines."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        prometheus as obs_prom,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend, StubBehavior, WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+
+    with _EnvPatch(SDTPU_WATCHDOG_FACTOR="2.0"):
+        w = World(ConfigModel())
+        w.add_worker(WorkerNode(
+            "survivor", StubBackend(StubBehavior(seconds_per_image=0.001)),
+            avg_ipm=2400.0))
+        # claims 2400 ipm (ETA 0.025 s/image) but delivers 0.5 s/image:
+        # its share blows through factor x ETA and must be requeued
+        w.add_worker(WorkerNode(
+            "staller", StubBackend(StubBehavior(seconds_per_image=0.5)),
+            avg_ipm=2400.0))
+        stalls0 = obs_prom.watchdog_stalls_total()
+        p = GenerationPayload(prompt="p", steps=20, width=512, height=512,
+                              batch_size=4, seed=10)
+        t0 = time.perf_counter()
+        result = w.execute(p)
+        wall = time.perf_counter() - t0
+        stalls = obs_prom.watchdog_stalls_total() - stalls0
+        health = w.health_summary()
+    requeued = sum(s.get("requeued_images", 0) for s in health.values())
+    delivered = len(result.images)
+    out = {
+        "metric": "watchdog_requeue_recovery_rate",
+        "value": round(delivered / p.total_images, 4),
+        "unit": "ratio",
+        "watchdog_stalls": stalls,
+        "requeued_images": requeued,
+        "delivered_images": delivered,
+        "total_images": p.total_images,
+        "wall_s": round(wall, 3),
+        "worker_health": {
+            label: {"failures": s.get("failures", 0),
+                    "consecutive_failures": s.get("consecutive_failures", 0),
+                    "requeued_images": s.get("requeued_images", 0),
+                    "state": s.get("state", "")}
+            for label, s in health.items()},
+        "device": "stub",
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_watchdog.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+    print(f"bench: watchdog microbench written to {path}", file=sys.stderr)
+    return out
+
+
 def _ledger_row(kind, metrics, device, tiny, recorded_at):
     """One append-only BENCH_LEDGER.jsonl row. ``schema`` versions the row
     shape; ``metrics`` holds only platform-independent structural numbers
@@ -1172,6 +1239,7 @@ def run_ledger(tiny):
     with _EnvPatch(SDTPU_PERF="1"):
         serving = run_serving(tiny)
         fleet = run_fleet(tiny)
+        watchdog = run_watchdog(tiny)
     recorded_at = time.time()
     rows = [
         _ledger_row("serving", {
@@ -1191,6 +1259,11 @@ def run_ledger(tiny):
             "interactive_p95_s": fleet.get("value"),
             "fifo_interactive_p95_s": fleet.get("vs_baseline"),
         }, fleet.get("device", ""), tiny, recorded_at),
+        _ledger_row("watchdog", {
+            "watchdog_stalls": watchdog.get("watchdog_stalls"),
+            "requeued_images": watchdog.get("requeued_images"),
+            "requeue_recovery_rate": watchdog.get("value"),
+        }, watchdog.get("device", ""), tiny, recorded_at),
     ]
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_LEDGER.jsonl")
@@ -1240,10 +1313,14 @@ def main() -> None:
                     help="int8 x step-cache grid: FLOPs/image, compile "
                          "counts, PSNR/SSIM vs bf16 per cell; writes "
                          "BENCH_int8.json (CPU-safe)")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="hang-watchdog/requeue structural microbench "
+                         "(stub workers, no device); writes "
+                         "BENCH_watchdog.json (CPU-safe)")
     ap.add_argument("--ledger", action="store_true",
-                    help="run the serving + fleet microbenches with the "
-                         "perf ledger on and append structural rows to "
-                         "BENCH_LEDGER.jsonl (CPU-safe)")
+                    help="run the serving, fleet and watchdog microbenches "
+                         "with the perf ledger on and append structural "
+                         "rows to BENCH_LEDGER.jsonl (CPU-safe)")
     args = ap.parse_args()
 
     # SDTPU_BENCH_TINY=1: logic-validation mode for CPU-only environments
@@ -1284,6 +1361,8 @@ def main() -> None:
             print(json.dumps(run_serving(tiny)))
         elif args.fleet:
             print(json.dumps(run_fleet(tiny)))
+        elif args.watchdog:
+            print(json.dumps(run_watchdog(tiny)))
         elif args.deepcache:
             print(json.dumps(run_deepcache(tiny)))
         elif args.int8:

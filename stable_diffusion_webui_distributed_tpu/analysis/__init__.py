@@ -17,8 +17,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from . import allowlist as allowlist_mod
-from . import (envrules, fleetrules, locks, metricrules, purity, recompile,
-               timerules)
+from . import (envrules, fleetrules, journalrules, locks, metricrules,
+               purity, recompile, timerules)
 from .core import RULES, Finding, ModuleInfo, walk_package
 
 __all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis"]
@@ -43,6 +43,7 @@ def analyze_modules(modules: List[ModuleInfo]) -> List[Finding]:
     findings.extend(envrules.check(modules))
     findings.extend(timerules.check(modules))
     findings.extend(metricrules.check(modules))
+    findings.extend(journalrules.check(modules))
     findings.extend(locks.check(modules))
     findings.extend(fleetrules.check(modules))
     # rule passes may re-walk nested statements; dedupe identical findings

@@ -39,6 +39,7 @@ RULES = {
     "EV001": "raw os.environ read outside runtime/config.py",
     "OB001": "time.time() used for a duration on a serving/pipeline/obs path",
     "OB002": "ad-hoc Prometheus metric name outside the central registry",
+    "OB003": "journal event literal outside the registered event set",
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",

@@ -317,6 +317,9 @@ class FleetGate:
         """Give the device up and re-queue without re-charging cost; the
         call returns when the queue hands the device back."""
         from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+        )
+        from stable_diffusion_webui_distributed_tpu.obs import (
             prometheus as obs_prom,
         )
 
@@ -326,7 +329,13 @@ class FleetGate:
                 self._running = None
             self._cv.notify_all()
         obs_prom.fleet_count("preemptions", **{"class": entry.policy.name})
+        if obs_journal.enabled() and entry.request_id:
+            obs_journal.emit("preempted", entry.request_id,
+                             **{"class": entry.policy.name})
         self.acquire(entry, recost=False)
+        if obs_journal.enabled() and entry.request_id:
+            obs_journal.emit("resumed", entry.request_id,
+                             **{"class": entry.policy.name})
 
     # -- introspection ------------------------------------------------------
 

@@ -103,7 +103,9 @@ class AutoscaleEngine:
                  up_p95_s: Optional[float] = None,
                  down_p95_s: Optional[float] = None,
                  cooldown_s: Optional[float] = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 health_source: Optional[Callable[[], Dict[str, Dict]]]
+                 = None) -> None:
         from stable_diffusion_webui_distributed_tpu.runtime.config import (
             env_float, env_int,
         )
@@ -120,6 +122,10 @@ class AutoscaleEngine:
                                     DEFAULT_COOLDOWN_S) \
             if cooldown_s is None else cooldown_s
         self._clock = clock
+        #: optional worker-health feed (World.health_summary) — scale-down
+        #: is vetoed while any worker looks unhealthy, since the apparent
+        #: headroom may just be capacity the fleet already lost
+        self.health_source = health_source
         self._lock = threading.Lock()
         self._hooks: List[Callable[[ScaleDecision], None]] = []  # guarded-by: _lock
         self._last_decision: Dict[str, float] = {}  # guarded-by: _lock
@@ -141,11 +147,30 @@ class AutoscaleEngine:
         with self._lock:
             self._hooks.append(hook)
 
+    def unhealthy_workers(self) -> List[str]:
+        """Labels the health feed currently considers unhealthy (3+
+        consecutive failures, >=50% rolling error rate, or UNAVAILABLE);
+        empty when no ``health_source`` is attached."""
+        if self.health_source is None:
+            return []
+        try:
+            summaries = self.health_source() or {}
+        except Exception:  # noqa: BLE001 — advisory feed, never fatal
+            return []
+        bad = []
+        for label, s in summaries.items():
+            if int(s.get("consecutive_failures", 0)) >= 3 \
+                    or float(s.get("error_rate", 0.0)) >= 0.5 \
+                    or s.get("state") == "UNAVAILABLE":
+                bad.append(label)
+        return sorted(bad)
+
     def decide(self) -> List[ScaleDecision]:
         """One evaluation pass over every registered slice; returns (and
         dispatches to hooks) the decisions made this pass."""
         p95 = float(self.quantile_source())
         now = self._clock()
+        unhealthy = self.unhealthy_workers()
         out: List[ScaleDecision] = []
         for name, info in self.registry.summary().items():
             with self._lock:
@@ -161,6 +186,10 @@ class AutoscaleEngine:
                     f"queue-wait p95 {p95:.2f}s >= {self.up_p95_s:.2f}s",
                     p95, replicas + 1)
             elif p95 <= self.down_p95_s and replicas > info["min_replicas"]:
+                if unhealthy:
+                    # low queue wait with sick workers is not surplus
+                    # capacity — hold replicas until the fleet heals
+                    continue
                 decision = ScaleDecision(
                     name, "down",
                     f"queue-wait p95 {p95:.2f}s <= {self.down_p95_s:.2f}s",
@@ -213,6 +242,7 @@ class AutoscaleEngine:
             "capacity": self._audit_cap,
             "decisions_total": total,
             "decisions": entries,
+            "unhealthy_workers": self.unhealthy_workers(),
         }
 
 

@@ -1,0 +1,176 @@
+"""Request lifecycle journal: bounded append-only event log + replay source.
+
+The span tree (:mod:`.spans`) answers "where did this request's time go";
+it cannot answer "what *decisions* were made about it" — was it throttled,
+which bucket did it land in, did it ride a coalesced group as leader or
+follower, which worker got which slice, was a failed slice requeued and
+where. This module records that decision trail as a bounded, append-only
+sequence of structured events so a failed request can be reconstructed
+and re-executed deterministically (``tools/replay.py``).
+
+Every event carries a monotonically increasing ``seq``, a monotonic
+timestamp, the request id, a causal ``parent`` seq (the previous event of
+the same request unless overridden — e.g. a coalesce follower points at
+the leader's event), and free-form attrs. The "received" event embeds the
+full post-``fix_seed`` payload dump plus a fingerprint, which is what
+makes replay byte-deterministic.
+
+Gated off by default: ``SDTPU_JOURNAL=1`` enables, ``SDTPU_JOURNAL_MAX``
+bounds retention (events, not requests). ``emit()`` is a no-op returning
+``None`` when disabled, so call sites that build expensive attrs (payload
+dumps) guard on :func:`enabled` first. Event *types* are a closed enum:
+emitting an unregistered type raises, and lint rule OB003 enforces at the
+AST level that literals passed to ``emit()`` outside this module are
+members of :data:`EVENTS`.
+
+Served at ``GET /internal/journal[?request_id=]``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from ..runtime.config import env_flag, env_int
+
+#: The closed set of journal event types. Serving-tier lifecycle first,
+#: then the scheduler/worker tier, then the health/watchdog plane.
+EVENTS = frozenset({
+    # serving tier (dispatcher)
+    "received",
+    "admitted",
+    "throttled",
+    "degraded",
+    "bucketed",
+    "coalesced_leader",
+    "coalesced_follower",
+    "dispatched",
+    "preempted",
+    "resumed",
+    "decoded",
+    "merged",
+    "completed",
+    "failed",
+    # scheduler tier (World/Job)
+    "planned",
+    "job_dispatched",
+    "job_completed",
+    "job_failed",
+    "requeued",
+    # health / watchdog plane
+    "watchdog_stall",
+    "worker_state",
+})
+
+DEFAULT_CAPACITY = 4096
+
+#: How many distinct request ids keep a live causal-parent pointer.
+_PARENT_INDEX_CAP = 256
+
+
+def enabled() -> bool:
+    """Journal gate — re-read per call so tests can flip the env var."""
+    return env_flag("SDTPU_JOURNAL", False)
+
+
+def fingerprint(obj: Any) -> str:
+    """Stable short hash of a JSON-able object (payload dumps)."""
+    data = json.dumps(obj, sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+class EventJournal:
+    """Bounded, lock-disciplined, append-only structured event log."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = env_int("SDTPU_JOURNAL_MAX", DEFAULT_CAPACITY)
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = 0                                      # guarded-by: _lock
+        # request_id -> seq of its latest event, for causal chaining
+        self._last_by_rid: OrderedDict = OrderedDict()     # guarded-by: _lock
+
+    def emit(self, event: str, request_id: str,
+             parent: Optional[int] = None,
+             **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Append one event; no-op (returns None) when the journal is off.
+
+        ``parent`` defaults to the request's previous event seq; pass it
+        explicitly to splice causality across requests (e.g. a coalesce
+        follower pointing at the leader's event).
+        """
+        if not enabled():
+            return None
+        if event not in EVENTS:
+            raise ValueError(f"unregistered journal event {event!r}; "
+                             f"add it to obs.journal.EVENTS")
+        rid = str(request_id)
+        t_mono = time.monotonic()
+        with self._lock:
+            self._seq += 1
+            if parent is None:
+                parent = self._last_by_rid.get(rid)
+            entry = {
+                "seq": self._seq,
+                "event": event,
+                "request_id": rid,
+                "t_mono": t_mono,
+                "parent": parent,
+                "attrs": dict(attrs),
+            }
+            self._events.append(entry)
+            self._last_by_rid[rid] = self._seq
+            self._last_by_rid.move_to_end(rid)
+            while len(self._last_by_rid) > _PARENT_INDEX_CAP:
+                self._last_by_rid.popitem(last=False)
+        return entry
+
+    def events_for(self, request_id: str) -> List[Dict[str, Any]]:
+        """The journal slice for one request, in seq order."""
+        rid = str(request_id)
+        with self._lock:
+            return [dict(e) for e in self._events if e["request_id"] == rid]
+
+    def snapshot(self, request_id: Optional[str] = None) -> Dict[str, Any]:
+        """The ``/internal/journal`` document."""
+        with self._lock:
+            if request_id:
+                events = [dict(e) for e in self._events
+                          if e["request_id"] == str(request_id)]
+            else:
+                events = [dict(e) for e in self._events]
+            total = self._seq
+        return {
+            "enabled": enabled(),
+            "capacity": self.capacity,
+            "count": len(events),
+            "total_emitted": total,
+            "events": events,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._last_by_rid.clear()
+            self._seq = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+#: Process-wide journal. Capacity is re-resolved only at construction;
+#: tests that need a different bound construct their own EventJournal.
+JOURNAL = EventJournal()
+
+
+def emit(event: str, request_id: str, parent: Optional[int] = None,
+         **attrs: Any) -> Optional[Dict[str, Any]]:
+    """Module-level convenience for :meth:`EventJournal.emit`."""
+    return JOURNAL.emit(event, request_id, parent=parent, **attrs)

@@ -235,6 +235,11 @@ def clear_histograms() -> None:
     for c in FLEET_COUNTERS.values():
         c.clear()
     PRECISION_COUNTER.clear()
+    for c in WORKER_COUNTERS.values():
+        c.clear()
+    WATCHDOG_COUNTER.clear()
+    with _WORKER_LOCK:
+        _WORKER_LATENCY_EWMA.clear()
 
 
 # -- compile latency (pipeline/engine.py via obs/perf.py) --------------------
@@ -332,6 +337,57 @@ PRECISION_COUNTER = LabeledCounter(
     "sdtpu_dispatch_precision_total",
     "Requests dispatched to the device by resolved serving precision.",
     ("precision",))
+
+# -- scheduler tier (scheduler/worker.py health + obs/watchdog.py) -----------
+
+#: Worker-health counter families (WorkerNode.health and World._requeue
+#: feed these; /internal/metrics renders them).
+WORKER_COUNTERS: Dict[str, LabeledCounter] = {
+    "requests": LabeledCounter(
+        "sdtpu_worker_requests_total",
+        "Generation requests sent to each worker backend.", ("worker",)),
+    "failures": LabeledCounter(
+        "sdtpu_worker_failures_total",
+        "Failed generation requests per worker.", ("worker",)),
+    "requeued_images": LabeledCounter(
+        "sdtpu_worker_requeued_images_total",
+        "Images requeued away from a failed worker.", ("worker",)),
+    "transitions": LabeledCounter(
+        "sdtpu_worker_state_transitions_total",
+        "Worker state-machine transitions by destination state.",
+        ("worker", "to")),
+}
+
+#: Stall detections by the hang watchdog (obs/watchdog.py), labeled with
+#: the watched operation's name (job-<worker> / dispatch.device).
+WATCHDOG_COUNTER = LabeledCounter(
+    "sdtpu_watchdog_stalls_total",
+    "Dispatches or remote jobs that exceeded k x their ETA "
+    "(SDTPU_WATCHDOG_FACTOR).", ("name",))
+
+_WORKER_LOCK = threading.Lock()
+#: per-worker generate-latency EWMA gauge values
+_WORKER_LATENCY_EWMA: Dict[str, float] = {}  # guarded-by: _WORKER_LOCK
+
+
+def worker_count(name: str, n: float = 1.0, **labels: Any) -> None:
+    c = WORKER_COUNTERS.get(name)
+    if c is not None:
+        c.inc(n, **labels)
+
+
+def set_worker_latency(worker: str, ewma_s: float) -> None:
+    with _WORKER_LOCK:
+        _WORKER_LATENCY_EWMA[str(worker)] = float(ewma_s)
+
+
+def count_watchdog_stall(name: str) -> None:
+    WATCHDOG_COUNTER.inc(name=name)
+
+
+def watchdog_stalls_total() -> float:
+    return WATCHDOG_COUNTER.total()
+
 
 _FLEET_LOCK = threading.Lock()
 #: per-class queue-wait histograms, created on first observation
@@ -597,6 +653,16 @@ def render() -> str:
     lines.extend(PRECISION_COUNTER.render())
     for c in FLEET_COUNTERS.values():
         lines.extend(c.render())
+    for c in WORKER_COUNTERS.values():
+        lines.extend(c.render())
+    lines.extend(WATCHDOG_COUNTER.render())
+    with _WORKER_LOCK:
+        worker_lat = dict(_WORKER_LATENCY_EWMA)
+    _labeled_family(
+        lines, "sdtpu_worker_latency_ewma_seconds", "gauge",
+        "EWMA of per-worker generate latency (WorkerHealth window).",
+        [(f'worker="{_label(k)}"', v)
+         for k, v in sorted(worker_lat.items())])
     with _FLEET_LOCK:
         fleet_hists = [_FLEET_QUEUE_WAIT[k]
                        for k in sorted(_FLEET_QUEUE_WAIT)]

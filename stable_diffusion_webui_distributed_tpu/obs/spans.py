@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import hashlib
 import itertools
 import os
 import threading
@@ -169,7 +170,10 @@ class SpanTracer:
             for req in reqs:
                 for sp in req.spans:
                     events.append(_span_event(req, sp))
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        # clock_us lets a remote puller (obs/stitch.py) estimate this
+        # process's trace-clock offset from one RTT-bracketed fetch.
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "clock_us": now_us()}
 
     def events_for(self, req: RequestTrace) -> List[Dict[str, Any]]:
         with self._lock:
@@ -278,6 +282,24 @@ def maybe_request(request_id: Optional[str] = None, name: str = "request",
 
 
 # -- cross-thread / cross-request recording ----------------------------------
+
+def now_us() -> float:
+    """Current trace-clock reading (µs on the same base as event ``ts``)."""
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def traceparent() -> Optional[str]:
+    """W3C traceparent for the active request (trace id derived from the
+    request id so every hop agrees without coordination), or None outside
+    a request context."""
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return None
+    req, parent = ctx
+    trace_id = hashlib.sha256(req.request_id.encode("utf-8")).hexdigest()[:32]
+    span_id = f"{parent & ((1 << 64) - 1):016x}"
+    return f"00-{trace_id}-{span_id}-01"
+
 
 def current() -> Optional[RequestTrace]:
     ctx = _CURRENT.get()

@@ -1,0 +1,113 @@
+"""Cross-node trace stitching: one timeline for a fan-out request.
+
+Each node's span store timestamps events against its own private
+``perf_counter`` epoch (obs/spans.py ``_EPOCH``), so a master trace and a
+remote worker's trace cannot be overlaid directly. This module pulls each
+remote's ``/internal/trace.json`` through the worker's existing HTTP
+session, estimates the remote trace clock's offset from the fetch RTT
+(NTP-style: the remote's ``clock_us`` sample is assumed to land at the
+midpoint of the request), shifts every remote event onto the master
+clock, retags its ``pid`` with the worker label, and merges everything
+into one Chrome trace — a single Perfetto timeline showing the master's
+dispatch spans above each worker's generate spans.
+
+Correlation across nodes is free: outbound jobs carry
+``X-SDTPU-Request-Id`` (scheduler/worker.py ``HTTPBackend.generate``), so
+the remote roots its trace under the same request id and the merged
+events share ``args.request_id``.
+
+Pull-based and on-demand (``GET /internal/stitched-trace.json``) — no
+background threads, nothing on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import spans
+
+#: Per-remote fetch timeout (seconds); a dead worker must not hang the
+#: stitched export.
+FETCH_TIMEOUT_S = 5.0
+
+
+def _workers_of(source: Any) -> List[Any]:
+    """Accept a World (``.workers``) or a plain iterable of workers."""
+    ws = getattr(source, "workers", None)
+    if ws is None:
+        ws = source or []
+    return list(ws)
+
+
+def fetch_remote_trace(backend: Any,
+                       timeout: float = FETCH_TIMEOUT_S,
+                       ) -> Tuple[Dict[str, Any], float, float]:
+    """GET a remote's /internal/trace.json through its session; returns
+    (document, t0_us, t1_us) with the local trace-clock fetch bracket."""
+    scheme = "https" if getattr(backend, "tls", False) else "http"
+    url = (f"{scheme}://{backend.address}:{backend.port}"
+           f"/internal/trace.json")
+    t0 = spans.now_us()
+    resp = backend.session.get(url, timeout=timeout)
+    t1 = spans.now_us()
+    resp.raise_for_status()
+    return resp.json(), t0, t1
+
+
+def clock_offset_us(doc: Dict[str, Any], t0_us: float,
+                    t1_us: float) -> Tuple[float, float]:
+    """(offset, rtt) in µs: add ``offset`` to a remote ``ts`` to place it
+    on the local trace clock. The remote's ``clock_us`` sample is taken to
+    correspond to the RTT midpoint."""
+    remote = float(doc.get("clock_us") or 0.0)
+    rtt = max(0.0, t1_us - t0_us)
+    midpoint = t0_us + rtt / 2.0
+    return midpoint - remote, rtt
+
+
+def merge_remote(events: List[Dict[str, Any]], doc: Dict[str, Any],
+                 label: str, offset_us: float) -> int:
+    """Shift one remote document's events onto the local clock and append
+    them, retagged with ``pid="worker:<label>"``; returns how many."""
+    remote_events = doc.get("traceEvents") or []
+    for ev in remote_events:
+        ev = dict(ev)
+        ev["ts"] = float(ev.get("ts", 0.0)) + offset_us
+        ev["pid"] = f"worker:{label}"
+        events.append(ev)
+    return len(remote_events)
+
+
+def stitch(source: Any,
+           tracer: Optional[spans.SpanTracer] = None) -> Dict[str, Any]:
+    """The merged master+remotes Chrome trace document. ``source`` is a
+    World (or any iterable of workers); workers without an HTTP backend
+    (stubs, in-process) contribute nothing, unreachable remotes are
+    reported in ``nodes`` rather than failing the export."""
+    tracer = tracer or spans.TRACER
+    base = tracer.export_chrome()
+    events: List[Dict[str, Any]] = list(base.get("traceEvents") or [])
+    nodes: List[Dict[str, Any]] = [{
+        "node": "master", "events": len(events),
+        "offset_us": 0.0, "rtt_us": 0.0, "error": None,
+    }]
+    for w in _workers_of(source):
+        backend = getattr(w, "backend", None)
+        label = getattr(w, "label", "?")
+        if backend is None or not hasattr(backend, "session") \
+                or not getattr(backend, "address", None):
+            continue
+        node = {"node": f"worker:{label}", "events": 0,
+                "offset_us": 0.0, "rtt_us": 0.0, "error": None}
+        try:
+            doc, t0, t1 = fetch_remote_trace(backend)
+            offset, rtt = clock_offset_us(doc, t0, t1)
+            node["offset_us"] = offset
+            node["rtt_us"] = rtt
+            node["events"] = merge_remote(events, doc, label, offset)
+        except Exception as e:  # noqa: BLE001 — per-node fault isolation
+            node["error"] = f"{type(e).__name__}: {e}"
+        nodes.append(node)
+    events.sort(key=lambda ev: float(ev.get("ts", 0.0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "clock_us": spans.now_us(), "nodes": nodes}

@@ -1,0 +1,106 @@
+"""Hang watchdog: stall detection for dispatches and remote jobs.
+
+The scheduler already *predicts* how long a job should take (the paper's
+benchmark/ETA loop, scheduler/eta.py); nothing watches whether reality
+agrees. A wedged remote worker or a device dispatch stuck in a collective
+just sits there until the 3600s HTTP timeout. This module arms a small
+daemon timer around any operation with a known ETA: if the operation has
+not disarmed the timer after ``SDTPU_WATCHDOG_FACTOR`` x ETA seconds, the
+watchdog
+
+- captures a full thread-stack dump into the flight recorder
+  (:mod:`.flightrec`) so the hang site is diagnosable post-mortem,
+- bumps ``sdtpu_watchdog_stalls_total`` (:mod:`.prometheus`),
+- journals a ``watchdog_stall`` event (:mod:`.journal`, when on), and
+- invokes the caller's ``on_stall`` hook — ``World.execute`` uses it to
+  abandon the stalled job thread so the slice falls into the existing
+  ``_requeue_failed`` path.
+
+Gated off by default: ``SDTPU_WATCHDOG_FACTOR`` <= 0 (the default 0)
+means :func:`arm` returns ``None`` and nothing is spawned, keeping the
+default serving path byte-identical. The arm/disarm shape mirrors
+``WorkerNode._start_interrupt_watchdog``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Callable, Optional
+
+from ..runtime.config import env_float
+
+
+def factor() -> float:
+    """Stall threshold as a multiple of the operation's ETA; <= 0 = off.
+    Re-read per call so tests can flip the env var."""
+    return env_float("SDTPU_WATCHDOG_FACTOR", 0.0) or 0.0
+
+
+def enabled() -> bool:
+    return factor() > 0.0
+
+
+def dump_stacks(max_frames: int = 40) -> str:
+    """Format every live thread's stack (named, most frames first)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        name = names.get(tid, "?")
+        stack = "".join(traceback.format_stack(frame)[-max_frames:])
+        chunks.append(f"Thread {name} (ident={tid}):\n{stack}")
+    return "\n".join(chunks)
+
+
+def arm(request_id: str, name: str, eta_s: Optional[float],
+        on_stall: Optional[Callable[[], None]] = None,
+        ) -> Optional[threading.Event]:
+    """Start watching one operation; returns the disarm latch, or ``None``
+    when the watchdog is off or no ETA is known. The caller MUST
+    :func:`disarm` the returned event from a ``finally`` block."""
+    k = factor()
+    if k <= 0.0 or not eta_s or eta_s <= 0.0:
+        return None
+    stop = threading.Event()
+    deadline_s = k * float(eta_s)
+
+    def watch() -> None:
+        if stop.wait(deadline_s):
+            return  # disarmed in time: no stall
+        _record_stall(request_id, name, float(eta_s), deadline_s)
+        if on_stall is not None:
+            try:
+                on_stall()
+            except Exception:
+                pass
+
+    threading.Thread(target=watch, daemon=True,
+                     name=f"watchdog-{name}").start()
+    return stop
+
+
+def disarm(stop: Optional[threading.Event]) -> None:
+    if stop is not None:
+        stop.set()
+
+
+def _record_stall(request_id: str, name: str, eta_s: float,
+                  waited_s: float) -> None:
+    from . import flightrec, journal
+    from . import prometheus as prom
+    from ..runtime.logging import get_logger
+
+    stacks = dump_stacks()
+    prom.count_watchdog_stall(name)
+    if journal.enabled():
+        journal.emit("watchdog_stall", request_id or "", name=name,
+                     eta_s=eta_s, waited_s=waited_s)
+    get_logger().warning(
+        "watchdog: %s stalled past %.2fs (%.2gx ETA %.2fs), request '%s'",
+        name, waited_s, factor(), eta_s, request_id)
+    flightrec.RECORDER.record(
+        request_id or "", "watchdog_stall",
+        f"{name} exceeded {factor():g}x ETA ({eta_s:.2f}s ETA, waited "
+        f"{waited_s:.2f}s); thread stacks:\n{stacks}",
+        events=[], duration_s=waited_s)

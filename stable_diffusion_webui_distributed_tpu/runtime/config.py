@@ -138,6 +138,29 @@ def _logger():
 # - ``SDTPU_PERF_SLO_TARGET`` (float, default 0.95): SLO attainment
 #   target behind the burn-rate gauge — burn 1.0 means consuming the
 #   (1 - target) error budget exactly.
+# - ``SDTPU_JOURNAL`` (flag, default off): the request lifecycle journal
+#   (obs/journal.py). On, every request's journey (received -> admitted/
+#   throttled -> bucketed -> coalesced -> dispatched -> decoded ->
+#   merged -> completed/failed, plus scheduler-tier plan/requeue events)
+#   is recorded with monotonic timestamps, causal parent seqs and
+#   payload fingerprints, served at ``GET /internal/journal`` and
+#   replayable with ``tools/replay.py``. Off (the default), every emit
+#   returns before touching the buffer and the serving path is
+#   byte-identical to the unjournaled build.
+# - ``SDTPU_JOURNAL_MAX`` (int, default 4096): journal ring capacity in
+#   events; oldest events are dropped first (the ring never blocks or
+#   grows unbounded).
+# - ``SDTPU_HEARTBEAT_S`` (float seconds, default 0 = off): worker
+#   heartbeat prober period — a daemon sweep of ``ping_workers`` so an
+#   UNAVAILABLE remote recovers to IDLE (and its health window updates)
+#   without an operator ping (scheduler/world.py start_heartbeat).
+# - ``SDTPU_WATCHDOG_FACTOR`` (float, default 0 = off): hang watchdog
+#   multiple — a dispatch or remote job still running past FACTOR x its
+#   predicted ETA gets a thread-stack dump into the flight recorder, a
+#   ``sdtpu_watchdog_stalls_total`` bump, and (remote jobs) a nudge into
+#   the requeue path (obs/watchdog.py). Only armed where an ETA exists
+#   (benchmarked calibration); 0 never arms and the join path is
+#   byte-identical to the unwatched build.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -72,7 +72,13 @@ _request_index = RequestLogIndex()
 
 
 def lines_for_request(request_id: str) -> List[str]:
-    """Log lines emitted while ``request_id``'s obs context was active."""
+    """Log lines emitted while ``request_id``'s obs context was active.
+
+    The context is a contextvar, so it does NOT cross a bare
+    ``threading.Thread`` — any fan-out thread that should log under the
+    request (the scheduler's job threads, ping sweeps) must be spawned
+    through ``obs.spans.bind_current`` or its lines land here under ''.
+    """
     return _request_index.lines(str(request_id))
 
 

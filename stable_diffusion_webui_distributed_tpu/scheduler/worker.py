@@ -25,8 +25,12 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Any, Dict, List, Optional, Protocol, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Protocol, Tuple
 
+from stable_diffusion_webui_distributed_tpu.obs import (
+    prometheus as obs_prom,
+)
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
     GenerationResult,
@@ -57,6 +61,96 @@ TRANSITIONS = {
     State.INTERRUPTED: {State.WORKING, State.IDLE},
     State.DISABLED: {State.IDLE},
 }
+
+
+class WorkerHealth:
+    """Rolling health telemetry for one worker.
+
+    The state machine says what a worker IS (idle/working/unavailable);
+    this says how it has been BEHAVING: error rate over a bounded outcome
+    window, latency EWMA, consecutive-failure streak, images requeued
+    away from it, and a ring of recent state transitions. Always on (it
+    never touches response bytes); the summary feeds
+    ``GET /internal/workers``, the ``sdtpu_worker_*`` Prometheus families
+    and the fleet autoscaler's health veto (fleet/slices.py).
+    """
+
+    WINDOW = 32           # request outcomes retained
+    TRANSITION_RING = 32  # state transitions retained
+    EWMA_ALPHA = 0.3
+
+    def __init__(self, label: str):
+        self.label = label
+        self._lock = threading.Lock()
+        self._window: Deque[bool] = deque(
+            maxlen=self.WINDOW)  # guarded-by: _lock
+        self._transitions: Deque[Tuple[float, str, str]] = deque(
+            maxlen=self.TRANSITION_RING)  # guarded-by: _lock
+        self.requests = 0               # guarded-by: _lock
+        self.failures = 0               # guarded-by: _lock
+        self.consecutive_failures = 0   # guarded-by: _lock
+        self.requeued_images = 0        # guarded-by: _lock
+        self.latency_ewma_s: Optional[float] = None  # guarded-by: _lock
+
+    def record_result(self, ok: bool,
+                      latency_s: Optional[float] = None) -> None:
+        """One generate outcome; metrics are bumped outside the lock."""
+        with self._lock:
+            self.requests += 1
+            self._window.append(bool(ok))
+            if ok:
+                self.consecutive_failures = 0
+                if latency_s is not None:
+                    prev = self.latency_ewma_s
+                    self.latency_ewma_s = (
+                        float(latency_s) if prev is None
+                        else self.EWMA_ALPHA * float(latency_s)
+                        + (1.0 - self.EWMA_ALPHA) * prev)
+            else:
+                self.failures += 1
+                self.consecutive_failures += 1
+            ewma = self.latency_ewma_s
+        obs_prom.worker_count("requests", worker=self.label)
+        if not ok:
+            obs_prom.worker_count("failures", worker=self.label)
+        elif ewma is not None:
+            obs_prom.set_worker_latency(self.label, ewma)
+
+    def record_requeue(self, images: int) -> None:
+        """``images`` of this worker's slice were requeued elsewhere."""
+        with self._lock:
+            self.requeued_images += int(images)
+        obs_prom.worker_count("requeued_images", int(images),
+                              worker=self.label)
+
+    def record_transition(self, frm: str, to: str) -> None:
+        at = time.time()  # sdtpu-lint: wallclock — operator-facing timeline
+        with self._lock:
+            self._transitions.append((at, frm, to))
+        obs_prom.worker_count("transitions", worker=self.label, to=to)
+
+    def error_rate(self) -> float:
+        with self._lock:
+            if not self._window:
+                return 0.0
+            return (sum(1 for ok in self._window if not ok)
+                    / len(self._window))
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            window = list(self._window)
+            return {
+                "requests": self.requests,
+                "failures": self.failures,
+                "window": len(window),
+                "error_rate": ((sum(1 for ok in window if not ok)
+                                / len(window)) if window else 0.0),
+                "consecutive_failures": self.consecutive_failures,
+                "latency_ewma_s": self.latency_ewma_s,
+                "requeued_images": self.requeued_images,
+                "transitions": [{"at": at, "from": f, "to": t}
+                                for at, f, t in self._transitions],
+            }
 
 
 class Backend(Protocol):
@@ -132,6 +226,9 @@ class WorkerNode:
         # (None = the process-wide runtime.interrupt.STATE)
         self.interrupt_state = None
         self.interrupt_poll_s = 0.5  # reference's poll cadence
+        # rolling behavioural telemetry (own lock; never nested under
+        # _lock — set_state records transitions after releasing it)
+        self.health = WorkerHealth(label)
 
         self._lock = threading.Lock()
 
@@ -139,12 +236,22 @@ class WorkerNode:
 
     def set_state(self, state: State, expect_cycle: bool = False) -> bool:
         """Guarded transition; returns True if the state changed/held legally."""
+        ok, changed = self._transition(state, expect_cycle)
+        if changed is not None:
+            # recorded after _lock is released (health has its own lock)
+            self.health.record_transition(*changed)
+        return ok
+
+    def _transition(self, state: State, expect_cycle: bool,
+                    ) -> Tuple[bool, Optional[Tuple[str, str]]]:
+        """(legal, (from, to) if the state actually moved)."""
         log = get_logger()
         with self._lock:
             if state == State.UNAVAILABLE:
                 if self.state == State.DISABLED:
                     log.debug("%s: disabled, refusing UNAVAILABLE", self.label)
-                    return False
+                    return False, None
+                prev = self.state
                 # invalidate model cache so reconnection forces re-sync
                 # (reference worker.py:747-755)
                 self.loaded_model = None
@@ -152,16 +259,18 @@ class WorkerNode:
                 log.warning("worker '%s' unreachable; avoided until "
                             "reconnection", self.label)
                 self.state = State.UNAVAILABLE
-                return True
+                return True, (prev.name, state.name)
             if state in TRANSITIONS.get(self.state, set()):
                 if state != self.state or expect_cycle:
-                    log.debug("%s: %s -> %s", self.label, self.state.name,
+                    prev = self.state
+                    log.debug("%s: %s -> %s", self.label, prev.name,
                               state.name)
                     self.state = state
-                return True
+                    return True, (prev.name, state.name)
+                return True, None
             log.debug("%s: invalid transition %s -> %s", self.label,
                       self.state.name, state.name)
-            return False
+            return False, None
 
     @property
     def available(self) -> bool:
@@ -239,6 +348,7 @@ class WorkerNode:
                 result = self.backend.generate(payload, start_index, count)
         except Exception as e:  # noqa: BLE001 — any backend failure demotes
             log.error("worker '%s' failed request: %s", self.label, e)
+            self.health.record_result(False)
             self.set_state(State.UNAVAILABLE)
             return None
         finally:
@@ -246,6 +356,7 @@ class WorkerNode:
                 stop_watch.set()
         elapsed = time.monotonic() - started
         self.response_time = elapsed
+        self.health.record_result(True, elapsed)
         if wsp is not None:
             # predicted-vs-actual on the span itself: one request's ETA
             # calibration quality is readable straight off its trace
@@ -608,6 +719,23 @@ class HTTPBackend:
 
     def generate(self, payload: GenerationPayload, start_index: int,
                  count: int) -> GenerationResult:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            spans as obs_spans,
+        )
+
+        # cross-node trace propagation: the remote roots its own spans
+        # under the same request id (obs/stitch.py correlates on it).
+        # Session headers, not a per-call kwarg, so every hop (including
+        # the sampler-fallback retry) carries them.
+        rid = obs_spans.current_request_id()
+        if rid:
+            self.session.headers["X-SDTPU-Request-Id"] = rid
+            tp = obs_spans.traceparent()
+            if tp:
+                self.session.headers["traceparent"] = tp
+        else:
+            self.session.headers.pop("X-SDTPU-Request-Id", None)
+            self.session.headers.pop("traceparent", None)
         body = payload.model_dump()
         # seed fan-out arithmetic, identical to the reference master
         # (distributed.py:297-305): offset by prior images. Same-seed

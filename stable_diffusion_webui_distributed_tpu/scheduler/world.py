@@ -22,6 +22,12 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from stable_diffusion_webui_distributed_tpu.obs import (
+    flightrec as obs_flightrec,
+    journal as obs_journal,
+    spans as obs_spans,
+    watchdog as obs_watchdog,
+)
 from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationPayload,
     GenerationResult,
@@ -45,6 +51,10 @@ class Job:
         self.start_index = 0          # global image index of this job's range
         self.result: Optional[GenerationResult] = None
         self.thread: Optional[threading.Thread] = None
+        # latched by the hang watchdog (obs/watchdog.py) when this job
+        # exceeds k x its ETA; execute() then abandons the thread and
+        # requeues the range
+        self.stalled = False
 
     def __str__(self):
         prefix = "(complementary) " if self.complementary else ""
@@ -109,6 +119,11 @@ class World:
         # TLS verification for remotes added at runtime (reference
         # --distributed-skip-verify-remotes, distributed.py:38-46)
         self.verify_tls: bool = True
+        # optional heartbeat prober (SDTPU_HEARTBEAT_S > 0): a daemon
+        # sweep of ping_workers so UNAVAILABLE nodes recover without an
+        # operator ping; off by default (no thread spawned)
+        self._heartbeat_stop: Optional[threading.Event] = None
+        self.start_heartbeat()
 
     # -- registry -----------------------------------------------------------
 
@@ -482,31 +497,62 @@ class World:
             + ("*" if j.complementary else "") for j in jobs)
         log.info("distributing %d image(s): %s", payload.total_images, summary)
 
-        for job in jobs:
-            job_payload = payload
-            if job.step_override is not None:
-                job_payload = payload.model_copy()
-                job_payload.steps = job.step_override
-            job.thread = threading.Thread(
-                target=self._run_job, args=(job, job_payload),
-                name=f"job-{job.worker.label}", daemon=True)
-            job.thread.start()
+        rid = str(getattr(payload, "request_id", "")
+                  or obs_spans.current_request_id() or "")
+        if obs_journal.enabled():
+            # post-fix_seed payload dump: the replay anchor — re-executing
+            # this exact dump reproduces every per-image seed
+            obs_journal.emit(
+                "planned", rid, seed=payload.seed, subseed=payload.subseed,
+                total=payload.total_images,
+                payload=payload.model_dump(),
+                fingerprint=obs_journal.fingerprint(payload.model_dump()),
+                jobs=[{"worker": j.worker.label, "batch": j.batch_size,
+                       "start": j.start_index,
+                       "complementary": j.complementary} for j in jobs])
 
-        for job in jobs:
-            job.thread.join()
+        with obs_spans.span("world.execute", images=payload.total_images,
+                            jobs=len(jobs)):
+            for job in jobs:
+                job_payload = payload
+                if job.step_override is not None:
+                    job_payload = payload.model_copy()
+                    job_payload.steps = job.step_override
+                # bind_current: fan-out threads must inherit the request
+                # contextvar or RequestIdFilter/spans lose scheduler lines
+                job.thread = threading.Thread(
+                    target=obs_spans.bind_current(self._run_job),
+                    args=(job, job_payload),
+                    name=f"job-{job.worker.label}", daemon=True)
+                job.thread.start()
+
+            watchdogged = obs_watchdog.enabled()
+            for job in jobs:
+                if not watchdogged:
+                    job.thread.join()
+                    continue
+                # a watchdog-latched stall abandons the (daemon) job thread
+                # so its range falls into the requeue path below
+                while job.thread.is_alive() and not job.stalled:
+                    job.thread.join(0.1)
 
         # re-queue failed ranges on surviving workers (elastic recovery) —
         # but never after an interrupt: a job that died because the user
         # cancelled must not be re-fanned-out as fresh work
         if not interrupt_mod.STATE.flag.interrupted:
             failed = [j for j in jobs
-                      if j.result is None and not j.complementary]
+                      if (j.result is None or j.stalled)
+                      and not j.complementary]
             for job in failed:
-                jobs.extend(self._requeue_failed(job, payload))
+                recovered = self._requeue_failed(job, payload)
+                jobs.extend(recovered)
+                self._note_job_failure(job, recovered, rid)
 
         merged = GenerationResult(parameters=payload.model_dump())
         for job in sorted(jobs, key=lambda j: j.start_index):
-            if job.result is None:
+            # a stalled job's thread may still complete late; its range
+            # was already requeued, so its result must not merge twice
+            if job.result is None or job.stalled:
                 continue
             r = job.result
             r.worker_labels = [job.worker.label] * len(r.images)
@@ -518,7 +564,44 @@ class World:
             ]
             merged.extend(r)
         self.save_config()
+        if obs_journal.enabled():
+            # the journaled outcome tools/replay.py byte-compares against
+            obs_journal.emit("completed", rid, images=len(merged.images),
+                             seeds=list(merged.seeds),
+                             infotexts=list(merged.infotexts))
         return merged
+
+    def _note_job_failure(self, job: Job, recovered: List[Job],
+                          rid: str) -> None:
+        """Always-on failure bookkeeping for a failed/stalled remote job:
+        a flight-recorder entry carrying the worker label, its state at
+        failure and the requeue decision, the failed worker's requeue
+        counter, and (when on) journal events."""
+        n = sum(j.batch_size for j in recovered)
+        if recovered:
+            dests = ", ".join(f"{j.worker.label}:{j.batch_size}"
+                              for j in recovered)
+            decision = f"requeued {n}/{job.batch_size} image(s) -> {dests}"
+        else:
+            decision = (f"dropped {job.batch_size} image(s) "
+                        f"(no survivor could absorb them)")
+        state = job.worker.current_state().name
+        why = "stalled past the watchdog deadline on" if job.stalled \
+            else "failed"
+        job.worker.health.record_requeue(n)
+        obs_flightrec.RECORDER.record(
+            rid, "worker_failure",
+            f"worker '{job.worker.label}' {why} {job.batch_size} image(s) "
+            f"[{job.start_index}..{job.start_index + job.batch_size}); "
+            f"state={state}; {decision}", events=[])
+        if obs_journal.enabled():
+            obs_journal.emit("job_failed", rid, worker=job.worker.label,
+                             batch=job.batch_size, start=job.start_index,
+                             stalled=job.stalled, state=state)
+            obs_journal.emit("requeued", rid,
+                             from_worker=job.worker.label, recovered=n,
+                             dropped=job.batch_size - n,
+                             to=[j.worker.label for j in recovered])
 
     def _requeue_failed(self, job: Job,
                         payload: GenerationPayload) -> List[Job]:
@@ -580,6 +663,14 @@ class World:
         return recovered
 
     def _run_job(self, job: Job, payload: GenerationPayload) -> None:
+        rid = str(getattr(payload, "request_id", "")
+                  or obs_spans.current_request_id() or "")
+        get_logger().info("job '%s': %d image(s) [%d..%d)",
+                          job.worker.label, job.batch_size, job.start_index,
+                          job.start_index + job.batch_size)
+        if obs_journal.enabled():
+            obs_journal.emit("job_dispatched", rid, worker=job.worker.label,
+                             batch=job.batch_size, start=job.start_index)
         # sync the loaded checkpoint before generating (the reference sends
         # an option_payload with each request when the worker's cached model
         # differs, worker.py:342-343,646-688); load_options no-ops when the
@@ -589,8 +680,27 @@ class World:
                                            self.current_vae):
                 job.result = None
                 return
-        job.result = job.worker.request(payload, job.start_index,
-                                        job.batch_size)
+        eta_s = None
+        if obs_watchdog.enabled() and job.worker.cal.benchmarked:
+            try:
+                eta_s = job.worker.eta(payload, batch_size=job.batch_size)
+            except ValueError:
+                eta_s = None
+        stop = obs_watchdog.arm(
+            rid, f"job-{job.worker.label}", eta_s,
+            on_stall=lambda: setattr(job, "stalled", True))
+        try:
+            with obs_spans.span("scheduler.job", worker=job.worker.label,
+                                batch=job.batch_size,
+                                start=job.start_index):
+                job.result = job.worker.request(payload, job.start_index,
+                                                job.batch_size)
+        finally:
+            obs_watchdog.disarm(stop)
+        if job.result is not None and obs_journal.enabled():
+            obs_journal.emit("job_completed", rid, worker=job.worker.label,
+                             batch=job.batch_size, start=job.start_index,
+                             images=len(job.result.images))
 
     # -- cluster ops --------------------------------------------------------
 
@@ -640,18 +750,60 @@ class World:
         for w in self._workers_snapshot():
             if w.state == State.DISABLED and not indiscriminate:
                 continue
-            t = threading.Thread(target=probe, args=(w,), daemon=True)
+            t = threading.Thread(target=obs_spans.bind_current(probe),
+                                 args=(w,), daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
         return results
 
+    def start_heartbeat(self) -> Optional[threading.Event]:
+        """Spawn the heartbeat prober when ``SDTPU_HEARTBEAT_S`` > 0: a
+        daemon thread running :meth:`ping_workers` every period so
+        UNAVAILABLE workers recover to IDLE (and freshly dead ones are
+        demoted) without operator traffic. Idempotent; returns the stop
+        latch, or None when the knob is off (the default — no thread)."""
+        period = config_mod.env_float("SDTPU_HEARTBEAT_S", 0.0) or 0.0
+        if period <= 0.0 or self._heartbeat_stop is not None:
+            return self._heartbeat_stop
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(period):
+                try:
+                    self.ping_workers()
+                except Exception as e:  # noqa: BLE001 — sweep must survive
+                    get_logger().debug("heartbeat sweep failed: %s", e)
+
+        threading.Thread(target=beat, daemon=True,
+                         name="worker-heartbeat").start()
+        self._heartbeat_stop = stop
+        return stop
+
+    def stop_heartbeat(self) -> None:
+        if self._heartbeat_stop is not None:
+            self._heartbeat_stop.set()
+            self._heartbeat_stop = None
+
+    def health_summary(self) -> Dict[str, Dict]:
+        """Per-worker behavioural health + state: the autoscaler's
+        residency/health input (fleet/slices.py) and the enriched
+        ``GET /internal/workers`` body."""
+        out: Dict[str, Dict] = {}
+        for w in self._workers_snapshot():
+            s = w.health.summary()
+            s["state"] = w.current_state().name
+            s["avg_ipm"] = w.cal.avg_ipm
+            out[w.label] = s
+        return out
+
     def interrupt_all(self) -> None:
         """Fan-out interrupt (world.py:173-179)."""
         for w in self._workers_snapshot():
             if w.state == State.WORKING:
-                threading.Thread(target=w.interrupt, daemon=True).start()
+                threading.Thread(target=obs_spans.bind_current(w.interrupt),
+                                 daemon=True).start()
 
     def restart_all(self) -> Dict[str, bool]:
         """Fleet restart fan-out (reference ui.py:274-280 "Restart All
@@ -666,7 +818,8 @@ class World:
         for w in self._workers_snapshot():
             if w.master or w.state == State.DISABLED:
                 continue
-            t = threading.Thread(target=run, args=(w,), daemon=True)
+            t = threading.Thread(target=obs_spans.bind_current(run),
+                                 args=(w,), daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
@@ -869,7 +1022,8 @@ class World:
             if w.master:
                 run(w)
             else:
-                t = threading.Thread(target=run, args=(w,), daemon=True)
+                t = threading.Thread(target=obs_spans.bind_current(run),
+                                     args=(w,), daemon=True)
                 t.start()
                 threads.append(t)
         for t in threads:
@@ -920,8 +1074,8 @@ class World:
         for w in self._workers_snapshot():
             if w.master or not w.available:
                 continue
-            t = threading.Thread(target=w.load_options, args=(model, vae),
-                                 daemon=True)
+            t = threading.Thread(target=obs_spans.bind_current(w.load_options),
+                                 args=(model, vae), daemon=True)
             t.start()
             threads.append(t)
         for t in threads:

@@ -504,6 +504,22 @@ class ApiServer:
 
         return obs_spans.TRACER.export_chrome()
 
+    def handle_stitched_trace(self) -> Dict[str, Any]:
+        """Cross-node merged Chrome trace (obs/stitch.py): the master's
+        spans plus every reachable remote's trace, clock-corrected from
+        fetch RTT and retagged pid="worker:<label>"."""
+        from stable_diffusion_webui_distributed_tpu.obs import stitch
+
+        return stitch.stitch(self.source)
+
+    def handle_journal_get(self, query: Dict[str, str]) -> Dict[str, Any]:
+        """Request lifecycle journal (obs/journal.py; SDTPU_JOURNAL=1).
+        ``?request_id=`` narrows to one request's event slice — the input
+        to ``tools/replay.py``."""
+        from stable_diffusion_webui_distributed_tpu.obs import journal
+
+        return journal.JOURNAL.snapshot(query.get("request_id") or None)
+
     def handle_metrics(self) -> "TextResponse":
         """Prometheus text exposition: latency histograms (e2e / queue
         wait / device dispatch / decode), every DispatchMetrics and
@@ -819,6 +835,9 @@ class ApiServer:
             ("GET", ""): self.handle_panel,
             ("GET", "/internal/status"): self.handle_internal_status,
             ("GET", "/internal/trace.json"): self.handle_trace_json,
+            ("GET", "/internal/stitched-trace.json"):
+                self.handle_stitched_trace,
+            ("GET", "/internal/journal"): self.handle_journal_get,
             ("GET", "/internal/metrics"): self.handle_metrics,
             ("GET", "/internal/flightrec"): self.handle_flightrec,
             ("GET", "/internal/perf"): self.handle_perf,
@@ -885,6 +904,17 @@ class ApiServer:
                         length = int(self.headers.get("Content-Length", 0))
                         raw = self.rfile.read(length) if length else b"{}"
                         body = json.loads(raw or b"{}")
+                        if key[1] in ("/sdapi/v1/txt2img",
+                                      "/sdapi/v1/img2img") \
+                                and isinstance(body, dict) \
+                                and not body.get("request_id"):
+                            # cross-node trace join: a master's scheduler
+                            # stamps the request id on the outbound hop
+                            # (HTTPBackend.generate) so this worker roots
+                            # its trace under the same id
+                            rid_hdr = self.headers.get("X-SDTPU-Request-Id")
+                            if rid_hdr:
+                                body["request_id"] = rid_hdr
                         result = fn(body) if fn.__code__.co_argcount > 1 \
                             else fn()
                     elif fn.__code__.co_argcount > 1:
@@ -1025,6 +1055,11 @@ def _worker_dict(w) -> Dict[str, Any]:
         "pin_validated": w.pin_validated,
         "disabled": state.name == "DISABLED",
     }
+    health = getattr(w, "health", None)
+    if health is not None and hasattr(health, "summary"):
+        # rolling error rate / latency EWMA / transition timeline
+        # (scheduler/worker.py WorkerHealth) — guarded for bare doubles
+        d["health"] = health.summary()
     backend = w.backend
     if hasattr(backend, "address"):
         d["address"] = backend.address

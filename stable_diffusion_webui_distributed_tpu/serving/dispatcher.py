@@ -57,7 +57,10 @@ from stable_diffusion_webui_distributed_tpu.fleet import (
     quotas as fleet_quotas,
 )
 from stable_diffusion_webui_distributed_tpu.obs import (
-    perf as obs_perf, prometheus as obs_prom,
+    journal as obs_journal,
+    perf as obs_perf,
+    prometheus as obs_prom,
+    watchdog as obs_watchdog,
 )
 from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
 from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
@@ -166,12 +169,34 @@ class ServingDispatcher:
         # root the obs trace here for direct callers; HTTP ingress already
         # minted one for API traffic (maybe_request joins it)
         with obs_spans.maybe_request(rid, name=f"serve.{job}"):
+            jr_on = obs_journal.enabled()
+            if jr_on:
+                # post-fix_seed dump: the replay anchor (tools/replay.py)
+                dump = payload.model_dump()
+                obs_journal.emit("received", rid, job=job, payload=dump,
+                                 fingerprint=obs_journal.fingerprint(dump))
             fleet_class = ""
             if self.fleet is not None:
                 # quota + SLO gate BEFORE any metrics accounting: a
                 # never-admitted request must not feed the queue-wait
                 # histogram or the ETA calibration
-                fleet_class = self._admit_fleet(payload)
+                try:
+                    fleet_class = self._admit_fleet(payload)
+                except fleet_admission.FleetRejected as e:
+                    if jr_on:
+                        obs_journal.emit(
+                            "throttled", rid,
+                            reason=getattr(e, "reason", ""),
+                            detail=str(getattr(e, "detail", e)))
+                    raise
+                if jr_on:
+                    obs_journal.emit("admitted", rid,
+                                     **{"class": fleet_class})
+                    degraded = (payload.override_settings
+                                or {}).get("fleet_degraded")
+                    if degraded:
+                        obs_journal.emit("degraded", rid,
+                                         detail=str(degraded))
             bypass = bool(payload.init_images or payload.enable_hr)
             if bypass:
                 run, bucketed = payload.model_copy(), False
@@ -182,6 +207,10 @@ class ServingDispatcher:
                     bucketed,
                     padding_ratio=self.bucketer.padding_ratio(
                         payload.width, payload.height))
+            if jr_on:
+                obs_journal.emit("bucketed", rid, bucketed=bucketed,
+                                 bypassed=bypass,
+                                 bucket=f"{run.width}x{run.height}")
 
             ticket = Ticket(payload, run, job, bucketed, rid)
             ticket.fleet_class = fleet_class
@@ -193,7 +222,20 @@ class ServingDispatcher:
                 else:
                     self._run_solo(ticket)
                 if ticket.error is not None:
+                    if jr_on:
+                        obs_journal.emit(
+                            "failed", rid,
+                            error=f"{type(ticket.error).__name__}: "
+                                  f"{ticket.error}")
                     raise ticket.error
+                if jr_on:
+                    r = ticket.result
+                    # journaled outcome for the replay byte-compare
+                    obs_journal.emit(
+                        "completed", rid,
+                        images=len(r.images) if r else 0,
+                        seeds=list(r.seeds) if r else [],
+                        infotexts=list(r.infotexts) if r else [])
                 return ticket.result
             finally:
                 with self._lock:
@@ -397,6 +439,27 @@ class ServingDispatcher:
                 sc.cadence, sc.cutoff_sigma,
                 ServingDispatcher._precision_name(self, run))
 
+    def _dispatch_eta(self, run, batch_size: int) -> Optional[float]:
+        """Predicted device seconds for the hang watchdog, from the SLO
+        admission controller's ETA calibration when one is attached and
+        benchmarked; None (nothing armed) otherwise — without a
+        calibration there is no deadline to compare against."""
+        if not obs_watchdog.enabled() or self.admission is None:
+            return None
+        cal = getattr(self.admission, "calibration", None)
+        if cal is None or not getattr(cal, "benchmarked", False):
+            return None
+        from stable_diffusion_webui_distributed_tpu.scheduler import (
+            eta as eta_mod,
+        )
+        try:
+            return eta_mod.predict_eta(
+                cal, run, getattr(self.admission, "benchmark", None),
+                batch_size=batch_size,
+                precision=self._precision_name(run))
+        except (ValueError, TypeError):
+            return None
+
     def _run_grouped(self, ticket: Ticket) -> None:
         key = self._group_key(ticket.run)
         n = ticket.run.total_images
@@ -410,6 +473,13 @@ class ServingDispatcher:
                 leader = False
             g.tickets.append(ticket)
             g.images += n
+            leader_rid = g.tickets[0].request_id
+        if obs_journal.enabled():
+            # journal the join decision for replay: a follower's outcome
+            # depends on its leader's batch, so record the linkage
+            obs_journal.emit(
+                "coalesced_leader" if leader else "coalesced_follower",
+                ticket.request_id, images=n, leader_request_id=leader_rid)
         if not leader:
             ticket.done.wait()
             return
@@ -425,6 +495,7 @@ class ServingDispatcher:
             start = time.monotonic()
             start_perf = time.perf_counter()
             leader_req = obs_spans.current()
+            jr_on = obs_journal.enabled()
             for t in g.tickets:
                 if t.cancelled.is_set():
                     # never dispatched: its wait must not feed the
@@ -438,7 +509,14 @@ class ServingDispatcher:
                         self.fleet.policy.resolve(t.fleet_class).name, wait)
                 obs_spans.add_span(t.obs_req, "queue_wait", t.enqueued_perf,
                                    start_perf - t.enqueued_perf)
+                if jr_on:
+                    obs_journal.emit("dispatched", t.request_id,
+                                     group=len(g.tickets),
+                                     precision=str(g.key[-1]))
             dsp = None
+            wd = obs_watchdog.arm(
+                g.tickets[0].request_id, "dispatch.device",
+                self._dispatch_eta(g.tickets[0].run, g.images))
             try:
                 # precision attribute rides the device span so the flight
                 # recorder shows which precision a failed request ran at
@@ -451,6 +529,7 @@ class ServingDispatcher:
                     if t.error is None and t.result is None:
                         t.error = e
             finally:
+                obs_watchdog.disarm(wd)
                 # leader/follower link: mirror the leader's device span
                 # into every follower's trace so a follower's tree shows
                 # where its wall-clock went
@@ -512,16 +591,26 @@ class ServingDispatcher:
                 prec = self._precision_name(ticket.run)
                 METRICS.record_dispatch(1, precision=prec)
                 obs_prom.count_precision(prec, 1)
+                if obs_journal.enabled():
+                    obs_journal.emit("dispatched", ticket.request_id,
+                                     group=1, precision=prec)
                 # perf ledger (SDTPU_PERF): same passive attribution as
                 # the grouped path — no-op with the knob off
                 perf_on = obs_perf.enabled()
                 if perf_on:
                     flops0 = METRICS.unet_flops_snapshot()
                     t0_dev = time.perf_counter()
-                with obs_spans.span("dispatch.device", requests=1,
-                                    precision=prec):
-                    result = self.engine.generate_range(
-                        ticket.run, 0, None, ticket.job)
+                wd = obs_watchdog.arm(
+                    ticket.request_id, "dispatch.device",
+                    self._dispatch_eta(ticket.run,
+                                       ticket.run.total_images))
+                try:
+                    with obs_spans.span("dispatch.device", requests=1,
+                                        precision=prec):
+                        result = self.engine.generate_range(
+                            ticket.run, 0, None, ticket.job)
+                finally:
+                    obs_watchdog.disarm(wd)
                 if perf_on:
                     from stable_diffusion_webui_distributed_tpu.pipeline \
                         import stepcache
@@ -648,6 +737,10 @@ class ServingDispatcher:
         entries = engine._queue_decoded(latents, 0, b_raw, width, height)
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)
+        jr_on = obs_journal.enabled()
+        if jr_on:
+            obs_journal.emit("decoded", live[0].request_id,
+                             images=b_raw, batch_run=b_run)
 
         with obs_spans.span("merge.split", requests=len(live),
                             images=b_raw):
@@ -665,6 +758,8 @@ class ServingDispatcher:
                         [self.bucketer.crop(im, ow, oh) for im in rows])
                 engine._append_images(out, t.payload, rows, 0, n_p, ow, oh)
                 t.result = out
+                if jr_on:
+                    obs_journal.emit("merged", t.request_id, images=n_p)
         engine.state.finish()
 
     # -- result fix-up -----------------------------------------------------

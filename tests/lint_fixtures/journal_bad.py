@@ -1,0 +1,31 @@
+"""OB003 fixture: journal event literals outside the registered set.
+
+Loaded by tests/test_lint.py as a standalone module: obs/journal.py is
+not in the analyzed set, so the registered-event vocabulary is empty and
+every literal emit is flagged unless marker-exempt.
+"""
+
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs.journal import emit
+
+# BAD (line 12): module-helper emit with an unregistered literal
+obs_journal.emit("complete", "rid-1")
+
+
+def lifecycle(rid):
+    # BAD (line 17): aliased helper emit inside a function scope
+    emit("dispatchd", rid, worker="w0")
+    # BAD (line 19): keyword spelling of the event argument
+    obs_journal.JOURNAL.emit(request_id=rid, event="finishd")
+
+
+def dynamic(rid, name):
+    # OK: computed event name — the runtime check covers it
+    obs_journal.emit(name, rid)
+
+
+# OK: deliberate out-of-band literal, marker-exempt
+obs_journal.emit("mysterious", "rid-2")  # sdtpu-lint: journal
+
+# OK: a plain string constant that is not a journal emit call at all
+NOTE = "completed"

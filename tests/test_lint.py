@@ -69,8 +69,8 @@ class TestRepoGate:
 
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "RC001", "RC002",
-                     "RC003", "EV001", "OB001", "OB002", "LK001", "LK002",
-                     "LK003", "FL001", "AL001", "AL002"):
+                     "RC003", "EV001", "OB001", "OB002", "OB003", "LK001",
+                     "LK002", "LK003", "FL001", "AL001", "AL002"):
             assert rule in RULES and RULES[rule]
 
 
@@ -182,6 +182,35 @@ class TestFixtures:
         mod = load_module(os.path.join(FIXTURES, "metric_bad.py"), rel)
         found = _rule_lines(analyze_modules([mod]))
         assert not {f for f in found if f[0] == "OB002"}
+
+    def test_journal_family(self):
+        # OB003: every journal.emit literal must come from obs/journal.py
+        # EVENTS. The fixture analyzes WITHOUT the registry module, so the
+        # registered set is empty and all un-exempt literals fire.
+        found = _rule_lines(_fixture_findings("journal_bad.py"))
+        assert found == {
+            ("OB003", 12),  # module-helper emit, unregistered literal
+            ("OB003", 17),  # aliased helper emit inside a function
+            ("OB003", 19),  # keyword spelling of the event argument
+        }
+        # dynamic event names, the marker-exempt literal, and plain
+        # non-emit strings stay clean
+
+    def test_journal_rule_accepts_registered_events(self):
+        # the same emits analyzed WITH the registry module present are
+        # checked against its real EVENTS set: a registered name passes
+        rel = "stable_diffusion_webui_distributed_tpu/obs/journal.py"
+        pkg = os.path.join(
+            REPO, "stable_diffusion_webui_distributed_tpu", "obs",
+            "journal.py")
+        registry = load_module(pkg, rel)
+        caller = load_module(
+            os.path.join(FIXTURES, "journal_bad.py"),
+            "stable_diffusion_webui_distributed_tpu/serving/jb.py")
+        found = _rule_lines(analyze_modules([registry, caller]))
+        # the bad literals still fire; "completed"-class names would not
+        assert {f for f in found if f[0] == "OB003"} == {
+            ("OB003", 12), ("OB003", 17), ("OB003", 19)}
 
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")

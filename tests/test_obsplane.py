@@ -1,0 +1,593 @@
+"""PR 9 observability plane: lifecycle journal + replay, cross-node trace
+stitching, worker health telemetry, and the hang watchdog.
+
+All CPU-safe and engine-free except the HTTP ingress test (stub World
+behind a real ApiServer). Covers:
+
+- the journal's off-by-default gating, bounded ring, event validation,
+  causal parent chaining, and exact snapshot schema;
+- scheduler-tier journaling through ``World.execute`` (planned ->
+  job_dispatched -> job_completed -> completed, and the failure path's
+  job_failed + requeued), plus the worker-failure flight-recorder entry;
+- the request-id contextvar crossing scheduler fan-out threads
+  (``lines_for_request`` sees ``_run_job`` output);
+- ``tools/replay.py`` reconstructing a journaled request and
+  re-executing it deterministically (seed/infotext byte-compare);
+- the hang watchdog latching a stalled stub job, dumping thread stacks
+  into the flight recorder, and nudging the requeue path;
+- WorkerHealth windows, the heartbeat prober, the ``sdtpu_worker_*``
+  Prometheus families, and the autoscaler's unhealthy-worker veto;
+- ``GET /internal/journal`` and ``GET /internal/workers`` exact-schema
+  snapshots, the ``X-SDTPU-Request-Id`` ingress pickup, and a stitched
+  trace merged from two in-process workers over real HTTP.
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.obs import flightrec
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs import prometheus as obs_prom
+from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
+from stable_diffusion_webui_distributed_tpu.obs import stitch as obs_stitch
+from stable_diffusion_webui_distributed_tpu.obs import (
+    watchdog as obs_watchdog,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import ConfigModel
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.logging import (
+    lines_for_request,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+    StubBackend, StubBehavior, WorkerHealth, WorkerNode,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+
+sys.path.insert(0, "tools")
+
+import replay  # noqa: E402  (tools/ on path)
+
+
+def node(label, ipm, behavior=None, master=False):
+    return WorkerNode(label, StubBackend(behavior), master=master,
+                      avg_ipm=ipm)
+
+
+def payload(**kw):
+    defaults = dict(prompt="p", steps=20, width=512, height=512,
+                    batch_size=4, seed=10)
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+@pytest.fixture()
+def journal_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_JOURNAL", "1")
+    obs_journal.JOURNAL.clear()
+    yield obs_journal.JOURNAL
+    obs_journal.JOURNAL.clear()
+
+
+# -- the journal itself ------------------------------------------------------
+
+class TestJournal:
+    def test_off_by_default_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_JOURNAL", raising=False)
+        j = obs_journal.EventJournal(capacity=8)
+        assert obs_journal.enabled() is False
+        assert j.emit("received", "rid") is None
+        assert len(j) == 0
+        snap = j.snapshot()
+        assert snap["enabled"] is False and snap["events"] == []
+
+    def test_snapshot_schema(self, journal_on):
+        journal_on.emit("received", "rid-s", job="txt2img")
+        snap = journal_on.snapshot()
+        assert set(snap) == {"enabled", "capacity", "count",
+                             "total_emitted", "events"}
+        (ev,) = snap["events"]
+        assert set(ev) == {"seq", "event", "request_id", "t_mono",
+                           "parent", "attrs"}
+        assert ev["event"] == "received"
+        assert ev["attrs"]["job"] == "txt2img"
+        assert ev["parent"] is None
+
+    def test_unregistered_event_raises(self, journal_on):
+        with pytest.raises(ValueError):
+            journal_on.emit("not_a_real_event", "rid")
+
+    def test_parent_chains_per_request(self, journal_on):
+        journal_on.emit("received", "a")
+        journal_on.emit("received", "b")
+        journal_on.emit("bucketed", "a")
+        evs = journal_on.events_for("a")
+        assert [e["event"] for e in evs] == ["received", "bucketed"]
+        # causal chain: same request's previous event, not b's
+        assert evs[1]["parent"] == evs[0]["seq"]
+        explicit = journal_on.emit("dispatched", "a", parent=12345)
+        assert explicit["parent"] == 12345
+
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        j = obs_journal.EventJournal(capacity=4)
+        for i in range(10):
+            j.emit("received", f"r{i}")
+        assert len(j) == 4
+        snap = j.snapshot()
+        assert snap["total_emitted"] == 10 and snap["count"] == 4
+        assert [e["request_id"] for e in snap["events"]] == \
+            ["r6", "r7", "r8", "r9"]
+
+    def test_fingerprint_is_order_insensitive(self):
+        a = obs_journal.fingerprint({"x": 1, "y": [2, 3]})
+        b = obs_journal.fingerprint({"y": [2, 3], "x": 1})
+        assert a == b and len(a) == 16
+        assert a != obs_journal.fingerprint({"x": 2, "y": [2, 3]})
+
+
+# -- scheduler-tier journaling + failure satellites --------------------------
+
+class TestWorldJournal:
+    def test_lifecycle_events_in_order(self, journal_on):
+        w = World(ConfigModel())
+        w.add_worker(node("a", 10.0))
+        w.add_worker(node("b", 10.0))
+        result = w.execute(payload(request_id="rid-life"))
+        assert len(result.images) == 4
+        names = [e["event"] for e in journal_on.events_for("rid-life")]
+        assert names[0] == "planned"
+        assert names[-1] == "completed"
+        assert names.count("job_dispatched") == 2
+        assert names.count("job_completed") == 2
+        planned = journal_on.events_for("rid-life")[0]
+        assert planned["attrs"]["payload"]["seed"] == 10
+        assert len(planned["attrs"]["fingerprint"]) == 16
+        assert {j["worker"] for j in planned["attrs"]["jobs"]} == {"a", "b"}
+
+    def test_failure_path_journals_and_flightrecs(self, journal_on):
+        w = World(ConfigModel())
+        w.add_worker(node("ok", 10.0))
+        w.add_worker(node("bad", 10.0,
+                          StubBehavior(fail_generate=True)))
+        before = len(flightrec.RECORDER)
+        result = w.execute(payload(request_id="rid-fail"))
+        assert len(result.images) == 4  # requeued onto the survivor
+        names = [e["event"] for e in journal_on.events_for("rid-fail")]
+        assert "job_failed" in names and "requeued" in names
+        req = [e for e in journal_on.events_for("rid-fail")
+               if e["event"] == "requeued"]
+        assert req[0]["attrs"]["from_worker"] == "bad"
+        assert req[0]["attrs"]["to"] == ["ok"]
+        # satellite: the remote-job failure lands in the flight recorder
+        # with worker label, state at failure, and the requeue decision
+        assert len(flightrec.RECORDER) > before
+        entry = flightrec.RECORDER.dump()["entries"][-1]
+        assert entry["reason"] == "worker_failure"
+        assert "'bad'" in entry["detail"]
+        assert "state=" in entry["detail"]
+        assert "requeued" in entry["detail"]
+
+    def test_journal_off_changes_nothing(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_JOURNAL", raising=False)
+        obs_journal.JOURNAL.clear()
+        w = World(ConfigModel())
+        w.add_worker(node("a", 10.0))
+        result = w.execute(payload(request_id="rid-off"))
+        assert len(result.images) == 4
+        assert obs_journal.JOURNAL.events_for("rid-off") == []
+
+
+class TestRequestContextAcrossThreads:
+    def test_run_job_logs_correlate_to_request(self):
+        # satellite: World fan-out threads must carry the obs contextvar
+        # (spans.bind_current), or per-request log correlation loses every
+        # line emitted inside _run_job
+        w = World(ConfigModel())
+        w.add_worker(node("a", 10.0))
+        w.add_worker(node("b", 10.0))
+        rid = "rid-logline"
+        with obs_spans.request(rid):
+            w.execute(payload(request_id=rid))
+        lines = lines_for_request(rid)
+        assert any("job 'a'" in ln or "job 'b'" in ln for ln in lines), \
+            f"no _run_job lines under {rid!r}: {lines}"
+
+
+# -- replay ------------------------------------------------------------------
+
+def _failing_world():
+    w = World(ConfigModel())
+    w.add_worker(node("ok", 10.0))
+    w.add_worker(node("bad", 10.0, StubBehavior(fail_generate=True)))
+    return w
+
+
+class TestReplay:
+    def test_reconstruct_and_deterministic_reexecution(self, journal_on):
+        rid = "rid-replay"
+        w = _failing_world()
+        first = w.execute(payload(request_id=rid, seed=77))
+        assert len(first.images) == 4
+        snap = journal_on.snapshot()
+        plan = replay.reconstruct(replay.events_for(snap, rid))
+        assert plan.request_id == rid
+        assert plan.payload["seed"] == 77
+        assert plan.jobs and plan.requeues
+        assert plan.outcome["status"] == "completed"
+        assert plan.outcome["seeds"] == list(first.seeds)
+        # re-execute on an identical (fresh) fleet: same failure
+        # injection -> same requeue -> same seeds AND same worker labels
+        # in the infotexts, byte-for-byte
+        verdict = replay.replay_with(
+            plan, lambda pd: _failing_world().execute(
+                GenerationPayload(**pd)))
+        assert verdict["deterministic"] is True
+        assert verdict["seeds_match"] and verdict["infotexts_match"]
+
+    def test_compare_flags_divergence(self, journal_on):
+        rid = "rid-diverge"
+        w = _failing_world()
+        w.execute(payload(request_id=rid, seed=5))
+        plan = replay.reconstruct(
+            replay.events_for(journal_on.snapshot(), rid))
+        bad = [s + 1 for s in plan.outcome["seeds"]]
+        verdict = replay.compare(plan, bad, plan.outcome["infotexts"])
+        assert verdict["deterministic"] is False
+        assert verdict["seeds_match"] is False
+
+    def test_reconstruct_without_events_raises(self):
+        with pytest.raises(ValueError):
+            replay.reconstruct([])
+
+
+# -- hang watchdog -----------------------------------------------------------
+
+class TestWatchdog:
+    def test_disabled_never_arms(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_WATCHDOG_FACTOR", raising=False)
+        assert obs_watchdog.enabled() is False
+        assert obs_watchdog.arm("rid", "x", 1.0) is None
+        obs_watchdog.disarm(None)  # tolerated
+
+    def test_dump_stacks_names_threads(self):
+        text = obs_watchdog.dump_stacks()
+        assert "Thread" in text and "ident=" in text
+
+    def test_stalled_job_is_requeued_with_stack_dump(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_WATCHDOG_FACTOR", "2.0")
+        w = World(ConfigModel())
+        w.add_worker(node("survivor", 2400.0,
+                          StubBehavior(seconds_per_image=0.001)))
+        # benchmarked at 2400 ipm (ETA 0.05 s for its 2-image share) but
+        # delivering 0.5 s/image: blows through 2x ETA and must stall
+        w.add_worker(node("staller", 2400.0,
+                          StubBehavior(seconds_per_image=0.5)))
+        stalls0 = obs_prom.watchdog_stalls_total()
+        rec0 = len(flightrec.RECORDER)
+        result = w.execute(payload(request_id="rid-stall"))
+        # every image still delivered — the stalled range was requeued
+        assert len(result.images) == 4
+        assert "survivor" in result.infotexts[0]
+        assert obs_prom.watchdog_stalls_total() == stalls0 + 1
+        assert w.workers[1].health.summary()["requeued_images"] == 2
+        # flight recorder got the stall with a thread-stack dump
+        assert len(flightrec.RECORDER) > rec0
+        entries = flightrec.RECORDER.dump()["entries"]
+        stall = [e for e in entries if e["reason"] == "watchdog_stall"][-1]
+        assert "Thread" in stall["detail"]
+        assert "job-staller" in stall["detail"]
+
+
+# -- worker health + heartbeat ----------------------------------------------
+
+class TestWorkerHealth:
+    def test_window_and_summary_schema(self):
+        h = WorkerHealth("w0")
+        h.record_result(True, 0.5)
+        h.record_result(False)
+        h.record_result(False)
+        h.record_requeue(3)
+        h.record_transition("IDLE", "WORKING")
+        s = h.summary()
+        assert set(s) == {"requests", "failures", "window", "error_rate",
+                          "consecutive_failures", "latency_ewma_s",
+                          "requeued_images", "transitions"}
+        assert s["requests"] == 3 and s["failures"] == 2
+        assert s["consecutive_failures"] == 2
+        assert s["error_rate"] == pytest.approx(2 / 3)
+        assert s["latency_ewma_s"] == pytest.approx(0.5)
+        assert s["requeued_images"] == 3
+        assert s["transitions"][-1]["from"] == "IDLE"
+        assert s["transitions"][-1]["to"] == "WORKING"
+
+    def test_success_resets_consecutive_failures(self):
+        h = WorkerHealth("w1")
+        h.record_result(False)
+        h.record_result(False)
+        h.record_result(True, 0.1)
+        assert h.summary()["consecutive_failures"] == 0
+
+    def test_state_transitions_recorded_by_set_state(self):
+        w = node("t", 10.0)
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            State,
+        )
+
+        w.set_state(State.WORKING)
+        w.set_state(State.IDLE, expect_cycle=True)
+        trail = [(t["from"], t["to"])
+                 for t in w.health.summary()["transitions"]]
+        assert ("IDLE", "WORKING") in trail
+        assert ("WORKING", "IDLE") in trail
+
+    def test_prometheus_worker_families_render(self):
+        h = WorkerHealth("prom-w")
+        h.record_result(True, 0.25)
+        h.record_result(False)
+        text = obs_prom.render()
+        assert "sdtpu_worker_requests_total" in text
+        assert "sdtpu_worker_failures_total" in text
+        assert 'sdtpu_worker_latency_ewma_seconds{worker="prom-w"}' in text
+        assert "sdtpu_watchdog_stalls_total" in text
+
+    def test_heartbeat_recovers_unavailable_worker(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+            State,
+        )
+
+        monkeypatch.setenv("SDTPU_HEARTBEAT_S", "0.05")
+        behavior = StubBehavior(fail_reachable=True)
+        w = World(ConfigModel())
+        try:
+            w.add_worker(node("flaky", 10.0, behavior))
+            w.ping_workers()
+            assert w.workers[0].current_state() is State.UNAVAILABLE
+            behavior.fail_reachable = False  # the node comes back
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and w.workers[0].current_state() is not State.IDLE:
+                time.sleep(0.02)
+            assert w.workers[0].current_state() is State.IDLE
+        finally:
+            w.stop_heartbeat()
+
+    def test_heartbeat_off_spawns_no_thread(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_HEARTBEAT_S", raising=False)
+        names0 = {t.name for t in threading.enumerate()}
+        w = World(ConfigModel())
+        assert w.start_heartbeat() is None
+        assert not ({t.name for t in threading.enumerate()} - names0)
+
+
+class TestAutoscaleHealthVeto:
+    def _engine(self, health):
+        from stable_diffusion_webui_distributed_tpu.fleet import slices
+
+        reg = slices.SliceRegistry()
+        reg.register(slices.SliceInfo(name="s0", group="g",
+                                      replicas=2, min_replicas=1,
+                                      max_replicas=4))
+        return slices, slices.AutoscaleEngine(
+            reg, quantile_source=lambda: 0.0, up_p95_s=5.0,
+            down_p95_s=0.5, cooldown_s=0.0, health_source=health)
+
+    def test_scale_down_vetoed_while_unhealthy(self):
+        slices, eng = self._engine(
+            lambda: {"w0": {"consecutive_failures": 3, "error_rate": 0.0,
+                            "state": "WORKING"}})
+        try:
+            assert eng.unhealthy_workers() == ["w0"]
+            assert eng.decide() == []  # p95 says down; health says no
+            assert eng.audit()["unhealthy_workers"] == ["w0"]
+        finally:
+            slices.set_autoscale(None)
+
+    def test_scale_down_proceeds_when_healthy(self):
+        slices, eng = self._engine(
+            lambda: {"w0": {"consecutive_failures": 0, "error_rate": 0.0,
+                            "state": "IDLE"}})
+        try:
+            (d,) = eng.decide()
+            assert d.direction == "down"
+        finally:
+            slices.set_autoscale(None)
+
+    def test_no_health_source_changes_nothing(self):
+        slices, eng = self._engine(None)
+        try:
+            assert eng.unhealthy_workers() == []
+            (d,) = eng.decide()
+            assert d.direction == "down"
+        finally:
+            slices.set_autoscale(None)
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+def make_world():
+    w = World(ConfigModel())
+    w.add_worker(node("m", 10.0, master=True))
+    w.add_worker(node("r", 10.0))
+    return w
+
+
+@pytest.fixture(scope="class")
+def server():
+    srv = ApiServer(make_world(), state=GenerationState(),
+                    host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def call(server, route, body=None, headers=None):
+    url = f"http://127.0.0.1:{server.port}{route}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data else "GET",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read() or b"{}")
+
+
+class TestHttpSurfaces:
+    def test_journal_endpoint_schema_snapshot(self, server, journal_on):
+        journal_on.emit("received", "rid-http", job="txt2img")
+        journal_on.emit("bucketed", "rid-http", bucket="512x512")
+        journal_on.emit("received", "rid-other")
+        out = call(server, "/internal/journal")
+        assert set(out) == {"enabled", "capacity", "count",
+                            "total_emitted", "events"}
+        assert out["enabled"] is True and out["count"] == 3
+        narrowed = call(server, "/internal/journal?request_id=rid-http")
+        assert [e["event"] for e in narrowed["events"]] == \
+            ["received", "bucketed"]
+        assert all(set(e) == {"seq", "event", "request_id", "t_mono",
+                              "parent", "attrs"}
+                   for e in narrowed["events"])
+
+    def test_workers_endpoint_schema_snapshot(self, server):
+        rows = call(server, "/internal/workers")
+        assert [r["label"] for r in rows] == ["m", "r"]
+        for row in rows:
+            # stub backends carry no endpoint fields; exact schema
+            assert set(row) == {"label", "state", "avg_ipm", "master",
+                                "pixel_cap", "model_override",
+                                "pin_validated", "disabled", "health"}
+            assert set(row["health"]) == {
+                "requests", "failures", "window", "error_rate",
+                "consecutive_failures", "latency_ewma_s",
+                "requeued_images", "transitions"}
+
+    def test_worker_health_reflects_traffic(self, server):
+        call(server, "/sdapi/v1/txt2img",
+             {"prompt": "cow", "batch_size": 2, "seed": 3,
+              "steps": 4, "width": 64, "height": 64})
+        rows = call(server, "/internal/workers")
+        assert sum(r["health"]["requests"] for r in rows) >= 1
+        assert all(r["health"]["failures"] == 0 for r in rows)
+
+    def test_ingress_header_joins_the_journal(self, server, journal_on):
+        rid = "rid-from-header"
+        call(server, "/sdapi/v1/txt2img",
+             {"prompt": "cow", "batch_size": 2, "seed": 9,
+              "steps": 4, "width": 64, "height": 64},
+             headers={"X-SDTPU-Request-Id": rid})
+        names = [e["event"] for e in journal_on.events_for(rid)]
+        # World tier: the header-minted id roots the scheduler journey
+        assert "planned" in names and "completed" in names
+
+    def test_body_request_id_beats_header(self, server, journal_on):
+        call(server, "/sdapi/v1/txt2img",
+             {"prompt": "cow", "batch_size": 1, "seed": 4, "steps": 4,
+              "width": 64, "height": 64, "request_id": "rid-body"},
+             headers={"X-SDTPU-Request-Id": "rid-header"})
+        assert journal_on.events_for("rid-body")
+        assert not journal_on.events_for("rid-header")
+
+
+# -- cross-node trace stitching ----------------------------------------------
+
+class _UrlSession:
+    """requests-shaped session over urllib for in-process servers."""
+
+    def get(self, url, timeout=0):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            data = r.read()
+
+        class Resp:
+            def raise_for_status(self):
+                pass
+
+            def json(self):
+                return json.loads(data)
+
+        return Resp()
+
+
+class _Remote:
+    """Worker double with just what the stitcher reads."""
+
+    def __init__(self, label, port=0, session=None):
+        self.label = label
+        self.backend = type("B", (), {})()
+        self.backend.address = "127.0.0.1"
+        self.backend.port = port
+        self.backend.tls = False
+        self.backend.session = session or _UrlSession()
+
+
+class TestStitch:
+    def test_clock_offset_midpoint_math(self):
+        doc = {"clock_us": 1000.0}
+        offset, rtt = obs_stitch.clock_offset_us(doc, 5000.0, 7000.0)
+        assert rtt == 2000.0
+        assert offset == 5000.0  # midpoint 6000 - remote 1000
+
+    def test_merge_retags_and_shifts(self):
+        events = []
+        n = obs_stitch.merge_remote(
+            events, {"traceEvents": [{"name": "g", "ts": 10.0, "pid": 1}]},
+            "w1", 90.0)
+        assert n == 1
+        assert events[0]["ts"] == 100.0
+        assert events[0]["pid"] == "worker:w1"
+
+    def test_two_inprocess_workers_single_timeline(self):
+        # two real ApiServers fetched over real HTTP; both serve the
+        # process-global tracer, so the timeline is known in advance
+        with obs_spans.request("rid-stitch"):
+            with obs_spans.span("denoise.work"):
+                pass
+        s1 = ApiServer(make_world(), state=GenerationState(),
+                       host="127.0.0.1", port=0).start()
+        s2 = ApiServer(make_world(), state=GenerationState(),
+                       host="127.0.0.1", port=0).start()
+        try:
+            doc = obs_stitch.stitch(
+                [_Remote("w1", s1.port), _Remote("w2", s2.port)])
+        finally:
+            s1.stop()
+            s2.stop()
+        assert set(doc) == {"traceEvents", "displayTimeUnit", "clock_us",
+                            "nodes"}
+        nodes = {n["node"]: n for n in doc["nodes"]}
+        assert set(nodes) == {"master", "worker:w1", "worker:w2"}
+        assert all(n["error"] is None for n in nodes.values())
+        assert nodes["worker:w1"]["events"] > 0
+        # same process, same clock: the RTT-estimated offset must be tiny
+        for label in ("worker:w1", "worker:w2"):
+            assert abs(nodes[label]["offset_us"]) < 0.5e6
+        # one merged, sorted timeline with per-node pid lanes
+        ts = [e["ts"] for e in doc["traceEvents"]]
+        assert ts == sorted(ts)
+        pids = {e["pid"] for e in doc["traceEvents"]}
+        assert any(p == "worker:w1" for p in pids)
+        assert any(p == "worker:w2" for p in pids)
+
+    def test_unreachable_remote_is_isolated(self):
+        doc = obs_stitch.stitch([_Remote("dead", port=1)])
+        (node_entry,) = [n for n in doc["nodes"]
+                         if n["node"] == "worker:dead"]
+        assert node_entry["error"] is not None
+        assert node_entry["events"] == 0
+
+    def test_traceparent_is_deterministic(self):
+        with obs_spans.request("abc"):
+            tp1 = obs_spans.traceparent()
+        with obs_spans.request("abc"):
+            tp2 = obs_spans.traceparent()
+        assert tp1 is not None and tp1.startswith("00-")
+        # same request id -> same trace id field
+        assert tp1.split("-")[1] == tp2.split("-")[1]
+        assert obs_spans.traceparent() is None  # outside any request

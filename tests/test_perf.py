@@ -383,6 +383,39 @@ class TestEndpoints:
         assert out["budget"]["per_bucket"] == 6
         assert isinstance(out["buckets"], list)
 
+    def test_dispatcher_tier_journal_journey(self, server, monkeypatch):
+        # the serving-tier lifecycle events (PR 9): a real engine dispatch
+        # must journal the full received -> ... -> completed journey with
+        # an intact causal parent chain
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+        )
+
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        obs_journal.JOURNAL.clear()
+        try:
+            out = call(server, "/sdapi/v1/txt2img",
+                       {"prompt": "a cow", "batch_size": 2, "seed": 3,
+                        "steps": 4, "width": 32, "height": 32,
+                        "request_id": "rid-disp-journey"})
+            assert len(out["images"]) == 2
+            events = call(server,
+                          "/internal/journal?request_id=rid-disp-journey"
+                          )["events"]
+            names = [e["event"] for e in events]
+            assert names == ["received", "bucketed", "coalesced_leader",
+                             "dispatched", "decoded", "merged",
+                             "completed"]
+            by_name = {e["event"]: e for e in events}
+            assert by_name["bucketed"]["attrs"]["bucket"] == "48x48"
+            assert by_name["received"]["attrs"]["fingerprint"]
+            assert by_name["completed"]["attrs"]["seeds"] == [3, 4]
+            seqs = {e["seq"] for e in events}
+            assert events[0]["parent"] is None
+            assert all(e["parent"] in seqs for e in events[1:])
+        finally:
+            obs_journal.JOURNAL.clear()
+
     def test_autoscale_endpoint_audit_ring(self, server):
         slices.set_autoscale(None)
         try:

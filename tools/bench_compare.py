@@ -44,6 +44,10 @@ THRESHOLDS = {
     "unet_flops_per_image": ("up", "rel", 0.02),
     "slo_attainment": ("down", "abs", 0.10),
     "quota_throttle_rate": ("up", "abs", 0.10),
+    # watchdog rows (bench.py run_watchdog): the structural scenario is
+    # deterministic, so any movement at all is a behavior change
+    "watchdog_stalls": ("up", "abs", 0.0),
+    "requeue_recovery_rate": ("down", "abs", 0.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back
@@ -159,7 +163,7 @@ def main(argv=None) -> int:
                          "a ledger file)")
     ap.add_argument("--kind", default=None,
                     help="ledger mode: restrict to rows of this kind "
-                         "(serving, fleet)")
+                         "(serving, fleet, watchdog)")
     ap.add_argument("--base-row", type=int, default=0,
                     help="ledger mode: base row index (default 0, oldest)")
     ap.add_argument("--head-row", type=int, default=-1,

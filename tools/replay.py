@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Deterministic replay of a journaled request (obs/journal.py).
+
+The lifecycle journal (``SDTPU_JOURNAL=1``) records, for every request,
+the post-``fix_seed`` payload dump, every scheduling decision made for it
+(bucketing, coalesce role, per-worker job plan, requeues), and the
+journaled outcome (seeds + infotexts). That is everything needed to
+re-execute the request and byte-compare: seeds are pinned in the dump,
+worker assignment is reproduced by the same planner, and infotexts embed
+both — so a matching re-run proves the failure (or the fix) is
+deterministic, and a mismatch localizes the nondeterminism to whatever
+decision diverged.
+
+Usage:
+  python tools/replay.py --source journal.json --request-id RID
+  python tools/replay.py --source http://host:7860/internal/journal \
+      --request-id RID --post http://host:7860
+  # --source accepts a saved snapshot file or a live /internal/journal
+  # URL; --post re-executes against a server and byte-compares.
+
+Library surface (used by tests and tooling): :func:`load_snapshot`,
+:func:`events_for`, :func:`reconstruct`, :func:`compare`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ReplayPlan:
+    """Everything the journal recorded about one request."""
+
+    request_id: str
+    payload: Optional[Dict[str, Any]]      # post-fix_seed model dump
+    fingerprint: str                       # journal fingerprint of it
+    journey: List[str]                     # event names, in order
+    jobs: List[Dict[str, Any]]             # scheduler plan (if any)
+    requeues: List[Dict[str, Any]]         # requeue decisions (if any)
+    coalesce: str                          # "leader" / "follower" / ""
+    outcome: Dict[str, Any]                # journaled completed/failed
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "request_id": self.request_id,
+            "fingerprint": self.fingerprint,
+            "journey": self.journey,
+            "jobs": self.jobs,
+            "requeues": self.requeues,
+            "coalesce": self.coalesce,
+            "outcome": self.outcome,
+            "replayable": self.payload is not None,
+        }
+
+
+def load_snapshot(source: str) -> Dict[str, Any]:
+    """A journal snapshot from a saved JSON file or a live
+    ``/internal/journal`` URL."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    with open(source, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def events_for(snapshot: Dict[str, Any],
+               request_id: str) -> List[Dict[str, Any]]:
+    """One request's journal slice, in emit order."""
+    events = snapshot.get("events") or []
+    mine = [e for e in events if e.get("request_id") == request_id]
+    return sorted(mine, key=lambda e: e.get("seq", 0))
+
+
+def reconstruct(events: List[Dict[str, Any]]) -> ReplayPlan:
+    """Rebuild a request's payload + scheduling decisions from its
+    journal slice. The payload comes from the ``received`` event
+    (dispatcher tier) or the ``planned`` event (scheduler tier) —
+    whichever the deployment journaled."""
+    if not events:
+        raise ValueError("no journal events for that request id")
+    rid = str(events[0].get("request_id", ""))
+    payload: Optional[Dict[str, Any]] = None
+    fingerprint = ""
+    jobs: List[Dict[str, Any]] = []
+    requeues: List[Dict[str, Any]] = []
+    coalesce = ""
+    outcome: Dict[str, Any] = {}
+    for e in events:
+        name = e.get("event", "")
+        attrs = e.get("attrs") or {}
+        if name in ("received", "planned") and attrs.get("payload"):
+            # "received" is the dispatcher-tier anchor; a later scheduler
+            # "planned" dump for the same request is the same payload
+            if payload is None:
+                payload = attrs["payload"]
+                fingerprint = str(attrs.get("fingerprint", ""))
+        if name == "planned":
+            jobs = list(attrs.get("jobs") or [])
+        elif name == "requeued":
+            requeues.append(dict(attrs))
+        elif name == "coalesced_leader":
+            coalesce = "leader"
+        elif name == "coalesced_follower":
+            coalesce = "follower"
+        elif name == "completed":
+            outcome = {"status": "completed",
+                       "seeds": list(attrs.get("seeds") or []),
+                       "infotexts": list(attrs.get("infotexts") or []),
+                       "images": attrs.get("images", 0)}
+        elif name in ("failed", "throttled"):
+            outcome = {"status": name,
+                       "error": attrs.get("error", attrs.get("detail", ""))}
+    return ReplayPlan(request_id=rid, payload=payload,
+                      fingerprint=fingerprint,
+                      journey=[e.get("event", "") for e in events],
+                      jobs=jobs, requeues=requeues, coalesce=coalesce,
+                      outcome=outcome)
+
+
+def compare(plan: ReplayPlan, seeds: List[Any],
+            infotexts: List[str]) -> Dict[str, Any]:
+    """Byte-compare a re-execution against the journaled outcome. Exact
+    list equality: seeds are ints pinned by fix_seed, infotexts embed
+    seed + worker label, so any scheduling or RNG divergence shows up."""
+    want_seeds = list(plan.outcome.get("seeds") or [])
+    want_info = list(plan.outcome.get("infotexts") or [])
+    seeds_match = list(seeds) == want_seeds
+    info_match = list(infotexts) == want_info
+    return {
+        "seeds_match": seeds_match,
+        "infotexts_match": info_match,
+        "deterministic": seeds_match and info_match,
+        "journaled_seeds": want_seeds,
+        "replayed_seeds": list(seeds),
+    }
+
+
+def replay_with(plan: ReplayPlan, executor) -> Dict[str, Any]:
+    """Re-execute ``plan.payload`` through ``executor`` (any callable
+    taking a payload dict and returning an object with ``seeds`` and
+    ``infotexts``) and byte-compare against the journaled outcome."""
+    if plan.payload is None:
+        raise ValueError(
+            "journal slice has no payload dump (was SDTPU_JOURNAL on?)")
+    result = executor(dict(plan.payload))
+    return compare(plan, list(getattr(result, "seeds", [])),
+                   list(getattr(result, "infotexts", [])))
+
+
+def _post_executor(base_url: str):
+    """Executor that re-POSTs the payload to a live server's txt2img."""
+    def run(payload: Dict[str, Any]):
+        body = json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            base_url.rstrip("/") + "/sdapi/v1/txt2img", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=3600) as resp:
+            out = json.loads(resp.read().decode("utf-8"))
+        info = json.loads(out.get("info") or "{}")
+
+        class R:
+            seeds = info.get("all_seeds") or []
+            infotexts = info.get("infotexts") or []
+        return R()
+    return run
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--source", required=True,
+                    help="journal snapshot file or /internal/journal URL")
+    ap.add_argument("--request-id", required=True)
+    ap.add_argument("--post", default="",
+                    help="server base URL to re-execute against "
+                         "(omit to only reconstruct)")
+    args = ap.parse_args(argv)
+
+    snapshot = load_snapshot(args.source)
+    events = events_for(snapshot, args.request_id)
+    try:
+        plan = reconstruct(events)
+    except ValueError as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return 2
+    report: Dict[str, Any] = {"plan": plan.summary()}
+    if args.post:
+        report["replay"] = replay_with(plan, _post_executor(args.post))
+        ok = report["replay"]["deterministic"]
+    else:
+        ok = plan.payload is not None
+    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
