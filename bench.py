@@ -1230,6 +1230,20 @@ def _ledger_row(kind, metrics, device, tiny, recorded_at):
             "device": device, "tiny": bool(tiny), "metrics": metrics}
 
 
+def _run_lint_metrics():
+    """Full-package sdtpu-lint run for the ledger: wall time (trajectory
+    only) and finding count (zero-movement gated by bench_compare — the
+    repo gate is clean, so any nonzero count is a regression)."""
+    from stable_diffusion_webui_distributed_tpu.analysis import run_analysis
+    root = os.path.dirname(os.path.abspath(__file__))
+    result = run_analysis(root, use_cache=False)
+    return {
+        "lint_wall_time_s": round(result.wall_time_s, 3),
+        "lint_finding_count": len(result.findings),
+        "lint_modules": result.modules,
+    }
+
+
 def run_ledger(tiny):
     """--ledger: run the serving and fleet microbenches with the perf
     ledger on (SDTPU_PERF=1) and append one structural row per run to
@@ -1264,6 +1278,7 @@ def run_ledger(tiny):
             "requeued_images": watchdog.get("requeued_images"),
             "requeue_recovery_rate": watchdog.get("value"),
         }, watchdog.get("device", ""), tiny, recorded_at),
+        _ledger_row("lint", _run_lint_metrics(), "cpu", tiny, recorded_at),
     ]
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_LEDGER.jsonl")

@@ -13,15 +13,20 @@ without importing any of the code under analysis.
 from __future__ import annotations
 
 import datetime
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from . import allowlist as allowlist_mod
-from . import (envrules, fleetrules, journalrules, locks, metricrules,
-               purity, recompile, timerules)
+from . import cache as cache_mod
+from . import callgraph as callgraph_mod
+from . import summaries as summaries_mod
+from . import (donation, envrules, escape, fleetrules, journalrules, locks,
+               metricrules, purity, recompile, timerules)
 from .core import RULES, Finding, ModuleInfo, walk_package
 
-__all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis"]
+__all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis",
+           "analyze_modules"]
 
 
 @dataclass
@@ -30,21 +35,37 @@ class AnalysisResult:
     suppressed: List[Finding]
     modules: int = 0
     counts: Dict[str, int] = field(default_factory=dict)
+    wall_time_s: float = 0.0
+    cache_hit: bool = False  # every module key hit; no pass ran
 
     @property
     def clean(self) -> bool:
         return not self.findings
 
 
-def analyze_modules(modules: List[ModuleInfo]) -> List[Finding]:
+def analyze_modules(modules: List[ModuleInfo],
+                    interprocedural: bool = True,
+                    prog=None, summaries=None) -> List[Finding]:
+    """Run every rule pass. ``interprocedural=False`` reproduces the
+    historical per-module taint engine (no summaries) — kept so the
+    cross-module fixture test can assert what the old pass missed.
+    ``prog``/``summaries`` accept prebuilt indexes (the cache path)."""
+    if interprocedural:
+        prog = prog if prog is not None else callgraph_mod.build(modules)
+        summaries = summaries if summaries is not None \
+            else summaries_mod.compute(prog)
+    else:
+        prog = summaries = None
     findings: List[Finding] = []
     findings.extend(purity.check(modules))
-    findings.extend(recompile.check(modules))
+    findings.extend(recompile.check(modules, summaries=summaries))
     findings.extend(envrules.check(modules))
     findings.extend(timerules.check(modules))
     findings.extend(metricrules.check(modules))
     findings.extend(journalrules.check(modules))
-    findings.extend(locks.check(modules))
+    findings.extend(locks.check(modules, prog=prog))
+    findings.extend(donation.check(modules, prog=prog))
+    findings.extend(escape.check(modules, prog=prog))
     findings.extend(fleetrules.check(modules))
     # rule passes may re-walk nested statements; dedupe identical findings
     seen = set()
@@ -62,9 +83,35 @@ def run_analysis(root: str,
                  paths: Optional[Sequence[str]] = None,
                  allowlist_path: Optional[str] = None,
                  use_allowlist: bool = True,
-                 today: Optional[datetime.date] = None) -> AnalysisResult:
+                 today: Optional[datetime.date] = None,
+                 use_cache: bool = False,
+                 changed_only: bool = False) -> AnalysisResult:
+    t0 = time.perf_counter()
     modules = walk_package(root, paths)
-    findings = analyze_modules(modules)
+    prog = callgraph_mod.build(modules)
+    findings: Optional[List[Finding]] = None
+    cache_hit = False
+    if use_cache:
+        store = cache_mod.Cache(root)
+        dirty, keys = store.split(modules)
+        if not dirty:
+            findings = store.cached_findings()
+            cache_hit = findings is not None
+        if findings is None:
+            dirty_closure = prog.dependents(dirty) if dirty else None
+            seed = store.seed_summaries(
+                {m.path for m in modules} - (dirty_closure or set()))
+            summaries = summaries_mod.compute(
+                prog, seed=seed, dirty_paths=dirty_closure)
+            findings = analyze_modules(modules, prog=prog,
+                                       summaries=summaries)
+            store.store(keys, findings, summaries_mod.by_path(summaries))
+    if findings is None:
+        findings = analyze_modules(modules, prog=prog)
+    if changed_only:
+        changed = cache_mod.git_changed_paths(root)
+        scope = prog.dependents(changed) if changed else set()
+        findings = [f for f in findings if f.path in scope]
     suppressed: List[Finding] = []
     if use_allowlist:
         entries, list_path = allowlist_mod.load(allowlist_path)
@@ -74,4 +121,6 @@ def run_analysis(root: str,
     for f in findings:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return AnalysisResult(findings=findings, suppressed=suppressed,
-                          modules=len(modules), counts=counts)
+                          modules=len(modules), counts=counts,
+                          wall_time_s=time.perf_counter() - t0,
+                          cache_hit=cache_hit)

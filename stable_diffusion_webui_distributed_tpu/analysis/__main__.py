@@ -34,6 +34,13 @@ def main(argv=None) -> int:
                     help="report raw findings, ignoring the allowlist")
     ap.add_argument("--rules", action="store_true",
                     help="list rule IDs and exit")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in git-changed files and "
+                         "their import dependents (full package is still "
+                         "analyzed for cross-module soundness)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write the per-module analysis "
+                         "cache (.sdtpu-lint-cache.json)")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -43,7 +50,11 @@ def main(argv=None) -> int:
 
     result = run_analysis(repo_root(), paths=args.paths or None,
                           allowlist_path=args.allowlist,
-                          use_allowlist=not args.no_allowlist)
+                          use_allowlist=not args.no_allowlist,
+                          # cache entries are keyed per-module; explicit
+                          # path scoping would poison the full-package set
+                          use_cache=not args.no_cache and not args.paths,
+                          changed_only=args.changed)
     if args.json:
         json.dump({"modules": result.modules,
                    "counts": result.counts,
@@ -54,9 +65,11 @@ def main(argv=None) -> int:
     else:
         for f in result.findings:
             print(f.render())
+        cached = " (cached)" if result.cache_hit else ""
         print(f"sdtpu-lint: {len(result.findings)} finding(s), "
               f"{len(result.suppressed)} allowlisted, "
-              f"{result.modules} module(s) analyzed", file=sys.stderr)
+              f"{result.modules} module(s) analyzed in "
+              f"{result.wall_time_s:.2f}s{cached}", file=sys.stderr)
     return 1 if result.findings else 0
 
 

@@ -43,6 +43,9 @@ RULES = {
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",
+    "LK004": "blocking device/network/time call while holding a lock",
+    "DN001": "donated buffer used after the donating jit call",
+    "TP004": "tracer escapes the traced function into self/global state",
     "FL001": "unguarded mutable container in a lock-bearing fleet class",
     "AL001": "allowlist entry expired",
     "AL002": "allowlist entry matched no finding",

@@ -1,0 +1,210 @@
+"""Use-after-donate rule (DN001).
+
+``jax.jit(fn, donate_argnums=(0,))`` hands the argument buffer to XLA for
+in-place reuse: after the call returns, the donated array is *deleted* —
+touching it raises ``RuntimeError: Array has been deleted`` on device, and
+on CPU test runs it silently works, which is exactly why a static rule is
+needed (tier-1 cannot catch it dynamically).
+
+The pass is a forward scan per function, same discipline as the recompile
+taint pass:
+
+- a local bound from ``jax.jit(..., donate_argnums=...)`` / ``pjit`` —
+  or from a factory marked ``# sdtpu-lint: jitted(donate=N[,M])`` — is a
+  *donor*; calling it marks the simple-name arguments at donated
+  positions **donated-dead**;
+- rebinding a dead name revives it — including the same-statement rebind
+  idiom the engine uses (``carry, cache = fn(params, carry, cache)``):
+  the call's donations are applied before the assignment's stores, matching
+  Python evaluation order;
+- any later load of a dead name is DN001, unless the line carries the
+  ``# sdtpu-lint: donated`` escape hatch (for deliberate aliasing the
+  author has audited);
+- loop bodies are scanned twice, so a donate-at-the-bottom /
+  use-at-the-top cycle is caught on the second sweep.
+
+Only simple ``Name`` arguments are tracked; donated attribute/subscript
+expressions are out of scope (documented under-reporting).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FuncInfo, ModuleInfo
+from .purity import TRACE_FNS, _resolve_func
+
+_DONATE_MARKER = re.compile(r"donate=([0-9,\s]+)")
+
+
+def _donate_positions(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    out.add(n.value)
+    return out
+
+
+def _marker_donate(mod: ModuleInfo, info: FuncInfo) -> Optional[Set[int]]:
+    """Donated positions from ``# sdtpu-lint: jitted(donate=N[,M])`` on a
+    factory def (composes with the existing ``static=`` payload)."""
+    payload = mod.marker(getattr(info.node, "lineno", 0), "sdtpu-lint:")
+    if not payload or not payload.startswith("jitted"):
+        return None
+    m = _DONATE_MARKER.search(payload)
+    if m is None:
+        return None
+    return {int(p) for p in m.group(1).split(",") if p.strip().isdigit()}
+
+
+def _suppressed(mod: ModuleInfo, line: int) -> bool:
+    return (mod.marker(line, "sdtpu-lint:") or "").strip() == "donated"
+
+
+class _DonationScan:
+    def __init__(self, mod: ModuleInfo, info: FuncInfo):
+        self.mod = mod
+        self.info = info
+        self.donors: Dict[str, Tuple[Set[int], str]] = {}
+        self.dead: Dict[str, str] = {}  # name -> donor description
+        self.findings: Dict[Tuple[int, str], Finding] = {}
+
+    def run(self) -> List[Finding]:
+        self._visit(self.info.node.body)  # type: ignore[attr-defined]
+        return list(self.findings.values())
+
+    # -- statement walk ------------------------------------------------------
+
+    def _visit(self, stmts: List[ast.stmt]) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # separate scope
+        if isinstance(st, ast.Assign):
+            self._scan_expr(st.value)
+            for t in st.targets:
+                self._store(t)
+            if len(st.targets) == 1:
+                self._note_donor(st.targets[0], st.value)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._scan_expr(st.value)
+                self._store(st.target)
+                self._note_donor(st.target, st.value)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._scan_expr(st.value)
+            if isinstance(st.target, ast.Name):
+                self._use(st.target)  # augmented assign reads the target
+                self.dead.pop(st.target.id, None)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter)
+            self._store(st.target)
+            self._visit(st.body)
+            self._visit(st.body)  # second sweep: catch cross-iteration use
+            self._visit(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            self._scan_expr(st.test)
+            self._visit(st.body)
+            self._scan_expr(st.test)
+            self._visit(st.body)
+            self._visit(st.orelse)
+            return
+        if isinstance(st, ast.If):
+            self._scan_expr(st.test)
+            self._visit(st.body)
+            self._visit(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._store(item.optional_vars)
+            self._visit(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self._visit(st.body)
+            for h in st.handlers:
+                self._visit(h.body)
+            self._visit(st.orelse)
+            self._visit(st.finalbody)
+            return
+        self._scan_expr(st)
+
+    # -- expression scan -----------------------------------------------------
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        donations: List[Tuple[str, str]] = []
+
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                self._use(sub)
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id in self.donors:
+                positions, why = self.donors[sub.func.id]
+                for i, arg in enumerate(sub.args):
+                    if i in positions and isinstance(arg, ast.Name):
+                        donations.append((arg.id, why))
+        # donations take effect after the expression finishes evaluating
+        for name, why in donations:
+            self.dead[name] = why
+
+    def _use(self, node: ast.Name) -> None:
+        why = self.dead.get(node.id)
+        if why is None or _suppressed(self.mod, node.lineno):
+            return
+        key = (node.lineno, node.id)
+        if key in self.findings:
+            return
+        self.findings[key] = Finding(
+            "DN001", self.mod.path, node.lineno, self.info.qualname,
+            f"'{node.id}' was donated to {why} and is dead here: the "
+            f"buffer is deleted after the call (CPU runs won't catch it) "
+            f"— use the call's result, or drop donate_argnums")
+
+    def _store(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.dead.pop(sub.id, None)
+
+    def _note_donor(self, target: ast.AST, value: ast.AST) -> None:
+        if not isinstance(target, ast.Name) or \
+                not isinstance(value, ast.Call):
+            return
+        name, _res = self.mod.call_name(value)
+        if name.endswith(("jit", "pjit")) and name in TRACE_FNS:
+            positions = _donate_positions(value)
+            if positions:
+                self.donors[target.id] = (positions, f"{name} donate_argnums")
+            return
+        factory = _resolve_func(self.mod, value.func, self.info)
+        if factory is not None:
+            positions = _marker_donate(self.mod, factory)
+            if positions:
+                self.donors[target.id] = (
+                    positions, f"{factory.qualname} (marked donating)")
+
+
+def check(modules: List[ModuleInfo], prog=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        for info in mod.funcs.values():
+            if not isinstance(info.node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(_DonationScan(mod, info).run())
+    return findings

@@ -1,0 +1,135 @@
+"""Tracer-escape rule (TP004).
+
+A traced function's array arguments are *tracers*: symbolic placeholders
+that exist only while JAX builds the jaxpr. Storing one on ``self`` or in
+a global container smuggles the placeholder out of the trace — the stored
+object is not the runtime value (it is a ``Tracer`` whose trace context is
+gone: using it later raises ``UnexpectedTracerError``, a leak JAX only
+detects lazily, sometimes far from the cause).
+
+TP003 already flags mutation of closed-over *locals* and declared
+globals inside traced bodies, but deliberately excludes ``self``/``cls``
+bases (nn.Module hyperparameter writes at init are legitimate). TP004
+covers exactly that blind spot, with value precision TP003 doesn't have:
+
+- ``self.attr = <expr>`` (or ``self.attr[k] = ...``) inside a traced
+  function where the expression derives from a tracer parameter;
+- ``self.attr.append/extend/add/update/setdefault(...)`` with a
+  tracer-derived argument.
+
+Only traced roots with a *known* parameter mapping participate (direct
+``jit``/``scan`` wiring — see purity.find_traced); ``# sdtpu-lint:
+traced``-marked functions have unknown signatures and are skipped.
+Shape/dtype introspection (``x.shape``, ``len(x)``) is a trace-time
+constant, not a tracer, and never taints.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Finding, ModuleInfo
+from .purity import SHAPE_ATTRS, SHAPE_CALLS, TracedFn, find_traced
+
+_MUTATORS = {"append", "extend", "add", "update", "setdefault", "insert"}
+
+
+def _tracer_use(node: ast.AST, tainted: Set[str],
+                mod: ModuleInfo) -> Optional[str]:
+    """Name of a tracer-derived value used *as a value* in ``node``."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in SHAPE_ATTRS:
+            return None  # trace-time constant
+        return _tracer_use(node.value, tainted, mod)
+    if isinstance(node, ast.Call):
+        name, _res = mod.call_name(node)
+        if name.split(".")[-1] in SHAPE_CALLS:
+            return None
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            got = _tracer_use(a, tainted, mod)
+            if got is not None:
+                return got
+        return _tracer_use(node.func, tainted, mod) \
+            if not isinstance(node.func, ast.Name) else None
+    if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+        return node.id if node.id in tainted else None
+    for child in ast.iter_child_nodes(node):
+        got = _tracer_use(child, tainted, mod)
+        if got is not None:
+            return got
+    return None
+
+
+def _self_base(t: ast.AST) -> bool:
+    while isinstance(t, (ast.Attribute, ast.Subscript)):
+        t = t.value
+    return isinstance(t, ast.Name) and t.id in ("self", "cls")
+
+
+def _check_traced(tf: TracedFn) -> List[Finding]:
+    if not tf.tracer_params:
+        return []
+    mod, fn = tf.mod, tf.info.node
+    tainted: Set[str] = set(tf.tracer_params)
+
+    # two sweeps: propagate tracer taint through local assignments, so
+    # `y = x * sigma; self.cache = y` is still an escape
+    for _sweep in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if _tracer_use(node.value, tainted, mod) is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                if _tracer_use(node.value, tainted, mod) is not None:
+                    tainted.add(node.target.id)
+
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, where: str, name: str) -> None:
+        out.append(Finding(
+            "TP004", mod.path, node.lineno, tf.info.qualname,
+            f"tracer-derived '{name}' escapes the traced function "
+            f"({tf.why}) into {where}: the stored object is a stale "
+            f"Tracer, not a value — return it instead"))
+
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                    _self_base(t):
+                value = getattr(node, "value", None)
+                if value is None:
+                    continue
+                name = _tracer_use(value, tainted, mod)
+                if name is not None:
+                    dotted = ast.unparse(t) if hasattr(ast, "unparse") \
+                        else "self-attribute"
+                    flag(t, f"'{dotted}'", name)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and _self_base(node.func.value):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                name = _tracer_use(a, tainted, mod)
+                if name is not None:
+                    container = ast.unparse(node.func.value) \
+                        if hasattr(ast, "unparse") else "self-container"
+                    flag(node, f"'{container}.{node.func.attr}(...)'", name)
+                    break
+
+    return out
+
+
+def check(modules: List[ModuleInfo], prog=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        for tf in find_traced(mod).values():
+            findings.extend(_check_traced(tf))
+    return findings

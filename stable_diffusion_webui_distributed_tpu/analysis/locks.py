@@ -1,28 +1,42 @@
-"""Lock-discipline rules (LK001/LK002/LK003).
+"""Lock-discipline rules (LK001/LK002/LK003/LK004).
 
 Convention: a ``# guarded-by: <lockname>`` comment on a ``self.<attr> = ...``
 line in ``__init__`` (or the line directly above it) declares that attribute
-protected by ``self.<lockname>``. The analyzer then verifies, lexically and
-per class, that every ``self.<attr>`` access outside ``__init__`` happens
-inside a ``with self.<lockname>:`` block (LK001), that the named lock is a
-real ``threading.Lock/RLock/Condition`` attribute of the class (LK002), and
-that no two locks are ever acquired in opposite orders anywhere in the
-package (LK003 — the deadlock precondition).
+protected by ``self.<lockname>``. The analyzer then verifies that every
+access to the attribute happens while the declaring class's lock is held
+(LK001), that the named lock is a real ``threading`` lock attribute of the
+class (LK002), that no two locks are ever acquired in opposite orders
+anywhere in the package (LK003 — the deadlock precondition), and that no
+blocking device/network/sleep call runs while any known lock is held
+(LK004 — a latency cliff, and with two locks a deadlock precondition).
 
-Scope and honesty about limits (documented in ANALYSIS.md): guarding is
-checked *intra-class* — ``self.attr`` in the declaring class's methods.
-Cross-object accesses (``worker.state`` from the scheduler) are out of
-lexical reach; classes expose locked accessors for those paths instead.
-``__init__`` is exempt (construction is single-threaded), as are nested
-``def``s spawned as threads — they start with no locks held, which is
-exactly how the checker treats them.
+Unlike the original per-class lexical pass, this version reasons through
+the whole-program index (``analysis/callgraph.py``):
 
-Lock-order edges come from three places: lexically nested ``with`` blocks;
-method calls made while holding a lock, closed transitively over same-class
-``self.method()`` calls; and cross-class calls resolved through a small
-attribute->class hint table (``self.engine`` is an Engine, the module
-singletons METRICS/STATE are DispatchMetrics/GenerationState). A cycle in
-the resulting digraph is reported once per cycle as LK003.
+- LK001 is **cross-object**: ``self.state.progress`` from a class whose
+  ``state`` attribute is inferred to be a ``GenerationState`` is checked
+  against ``GenerationState``'s guard declarations, as is ``p.progress``
+  through an annotated param or typed local. Locks are named
+  ``Class.attr`` program-wide; ``with self.worker._lock:`` on the right
+  object satisfies the guard.
+- LK003 builds its acquisition graph from the real call graph: a method
+  called while a lock is held contributes every lock the callee may
+  transitively acquire — across classes and modules, with attribute types
+  inferred instead of hand-hinted (the old ``CLASS_HINTS`` table is gone).
+- LK004 flags blocking calls (``time.sleep``, ``block_until_ready``,
+  HTTP verbs on a requests session, ``urlopen``, zero-arg ``.result()``,
+  thread ``.join()``) made while holding a lock — directly, or through a
+  call chain whose leaf blocks. ``cond.wait()`` on the *only* lock held is
+  exempt (wait releases it); waiting while holding a second lock is not.
+
+``__init__`` of the declaring class is exempt (construction is
+single-threaded), and nested ``def``s are scanned with an empty held-lock
+set — they run later on other threads. Unknown types produce no finding
+and no edge: the pass under-reports, never guesses.
+
+The static edge set is exported via :func:`lock_order_graph` so the
+runtime lockset sanitizer (``runtime/locksan.py``) can diff observed
+acquisition order against this model at test teardown.
 """
 
 from __future__ import annotations
@@ -30,21 +44,13 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, ModuleInfo
-
-#: attribute/variable name -> class name, for cross-class lock-order edges.
-CLASS_HINTS = {
-    "engine": "Engine",
-    "state": "GenerationState",
-    "metrics": "DispatchMetrics",
-    "METRICS": "DispatchMetrics",
-    "STATE": "GenerationState",
-    "registry": "ModelRegistry",
-    "dispatcher": "ServingDispatcher",
-    "bucketer": "ShapeBucketer",
-}
+from . import callgraph
+from .core import Finding, FuncInfo, ModuleInfo
 
 LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+#: HTTP verbs that block on the network when called on requests / a Session
+_HTTP_VERBS = {"get", "post", "put", "delete", "head", "patch", "request"}
 
 
 class ClassLocks:
@@ -54,7 +60,6 @@ class ClassLocks:
         self.node = node
         self.locks: Set[str] = set()  # attr names holding threading locks
         self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
-        self.methods: Dict[str, ast.AST] = {}
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -69,11 +74,6 @@ def _collect_classes(modules: List[ModuleInfo]) -> Dict[str, ClassLocks]:
     for mod in modules:
         for qual, cls in mod.classes.items():
             info = ClassLocks(cls.name, mod, cls)
-            for item in cls.body:
-                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    info.methods[item.name] = item
-            # find lock attributes + guarded-by annotations anywhere in the
-            # class body (usually __init__)
             for node in ast.walk(cls):
                 if isinstance(node, ast.Assign):
                     targets = node.targets
@@ -93,37 +93,68 @@ def _collect_classes(modules: List[ModuleInfo]) -> Dict[str, ClassLocks]:
                     if g:
                         info.guarded[attr] = (g.split()[0], node.lineno)
             if info.locks or info.guarded:
-                # last definition wins on duplicate class names; the package
-                # has none, and fixtures are analyzed in isolation
-                out[info.name] = info
+                # first definition wins on duplicate class names; the
+                # package has none, and fixtures are analyzed in isolation
+                out.setdefault(info.name, info)
     return out
 
 
-def _with_locks(item: ast.withitem, cls: ClassLocks) -> Optional[str]:
-    attr = _self_attr(item.context_expr)
-    if attr is not None and attr in cls.locks:
-        return attr
-    return None
+# -- per-function traversal --------------------------------------------------
 
+class _FuncScan:
+    """One pass over a function body: cross-object LK001 checks, lock
+    acquisitions (qualified ``Class.attr`` names), LK004 blocking sites,
+    and the call facts the transitive passes need."""
 
-# -- per-method traversal ----------------------------------------------------
-
-class _MethodScan:
-    """One pass over a method body: LK001 guarded-access checks, direct
-    lock acquisitions, and (held-lock -> call / held-lock -> lock) edges."""
-
-    def __init__(self, cls: ClassLocks, method_name: str):
-        self.cls = cls
-        self.method = method_name
+    def __init__(self, mod: ModuleInfo, info: FuncInfo, qual: str,
+                 prog: callgraph.Program,
+                 classes: Dict[str, ClassLocks]):
+        self.mod = mod
+        self.info = info
+        self.qual = qual  # dotted program-wide qualname
+        self.prog = prog
+        self.classes = classes
+        self.local_types = prog.local_types(mod, info)
+        self.lock_aliases: Dict[str, str] = {}  # var -> qualified lock
         self.findings: List[Finding] = []
-        self.acquired: Set[str] = set()  # locks this method may take
-        # (held_lock, callee) where callee is ("self", meth) or (Class, meth)
-        self.calls_under: Set[Tuple[str, Tuple[str, str]]] = set()
-        self.edges: Set[Tuple[str, str]] = set()  # lock -> lock, same class
-        self.local_hints: Dict[str, str] = {}  # var -> class name
+        self.acquired: Set[str] = set()  # qualified locks this fn may take
+        self.edges: Set[Tuple[str, str]] = set()
+        self.all_calls: Set[str] = set()  # resolvable callees (any context)
+        #: (held-locks, callee qualname, call line)
+        self.calls_under: List[Tuple[frozenset, str, int]] = []
+        #: (held-locks, reason, line) for direct blocking calls under a lock
+        self.blocking_sites: List[Tuple[frozenset, str, int]] = []
+        #: first directly-blocking call reason, from the caller's point of
+        #: view (cond.wait always counts: it blocks whoever calls us)
+        self.may_block: Optional[str] = None
+        # depth > 0 while inside a nested def: LK001 held-tracking still
+        # applies (closures read self), but acquisitions/calls/blocking
+        # belong to the thread that eventually runs the closure, not to
+        # this function's callers
+        self._nested = 0
 
-    def run(self, node: ast.AST) -> None:
-        self._body(getattr(node, "body", []), frozenset())
+    # -- type/lock resolution ------------------------------------------------
+
+    def _expr_class(self, expr: ast.AST) -> Optional[str]:
+        return self.prog.expr_type(self.mod, self.info, expr,
+                                   self.local_types)
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        """Qualified ``Class.attr`` lock name an expression denotes."""
+        if isinstance(expr, ast.Name):
+            return self.lock_aliases.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base_t = self._expr_class(expr.value)
+            if base_t is not None:
+                cl = self.classes.get(base_t)
+                if cl is not None and expr.attr in cl.locks:
+                    return f"{base_t}.{expr.attr}"
+        return None
+
+    # -- traversal -----------------------------------------------------------
+
+    def run(self) -> None:
+        self._body(getattr(self.info.node, "body", []), frozenset())
 
     def _body(self, stmts: List[ast.stmt], held: frozenset) -> None:
         for st in stmts:
@@ -133,16 +164,19 @@ class _MethodScan:
         if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
             # a nested def runs later (thread target / callback): no locks
             # are held when it starts
+            self._nested += 1
             self._body(st.body, frozenset())
+            self._nested -= 1
             return
         if isinstance(st, (ast.With, ast.AsyncWith)):
             newly = []
             for item in st.items:
                 self._expr(item.context_expr, held)
-                lock = _with_locks(item, self.cls)
+                lock = self._lock_of(item.context_expr)
                 if lock is not None:
                     newly.append(lock)
-                    self.acquired.add(lock)
+                    if not self._nested:
+                        self.acquired.add(lock)
                     for h in held:
                         self.edges.add((h, lock))
             self._body(st.body, held | frozenset(newly))
@@ -159,17 +193,17 @@ class _MethodScan:
             self._body(st.body, held)
             self._body(st.orelse, held)
             return
-        if isinstance(st, ast.For):
+        if isinstance(st, (ast.For, ast.AsyncFor)):
             self._expr(st.iter, held)
             self._body(st.body, held)
             self._body(st.orelse, held)
             return
-        # track `engine = self.engine` style aliases for lock-order hints
+        # track `lk = self._lock` / `gate = self.fleet` style aliases
         if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
                 isinstance(st.targets[0], ast.Name):
-            src = _self_attr(st.value)
-            if src is not None and src in CLASS_HINTS:
-                self.local_hints[st.targets[0].id] = CLASS_HINTS[src]
+            lock = self._lock_of(st.value)
+            if lock is not None:
+                self.lock_aliases[st.targets[0].id] = lock
         self._expr(st, held)
 
     def _expr(self, node: ast.AST, held: frozenset) -> None:
@@ -177,43 +211,173 @@ class _MethodScan:
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
                                 ast.Lambda)):
                 continue
-            attr = _self_attr(sub) if isinstance(sub, ast.Attribute) else None
-            if attr is not None and attr in self.cls.guarded:
-                lock, _ln = self.cls.guarded[attr]
-                if lock not in held:
-                    self.findings.append(Finding(
-                        "LK001", self.cls.mod.path, sub.lineno,
-                        f"{self.cls.name}.{self.method}",
-                        f"access to '{attr}' (guarded-by {lock}) without "
-                        f"holding self.{lock}"))
+            if isinstance(sub, ast.Attribute):
+                self._check_guarded(sub, held)
             if isinstance(sub, ast.Call):
                 self._call(sub, held)
 
-    def _call(self, call: ast.Call, held: frozenset) -> None:
-        if not held:
+    def _check_guarded(self, node: ast.Attribute, held: frozenset) -> None:
+        owner = self._expr_class(node.value)
+        if owner is None:
             return
-        fn = call.func
-        if not isinstance(fn, ast.Attribute):
+        cl = self.classes.get(owner)
+        if cl is None or node.attr not in cl.guarded:
             return
-        base = fn.value
-        callee: Optional[Tuple[str, str]] = None
-        if isinstance(base, ast.Name):
-            if base.id == "self":
-                callee = ("self", fn.attr)
-            elif base.id in self.local_hints:
-                callee = (self.local_hints[base.id], fn.attr)
-            elif base.id in CLASS_HINTS:
-                callee = (CLASS_HINTS[base.id], fn.attr)
+        # construction is single-threaded: the declaring class's own
+        # __init__ writes its guarded attributes without the lock
+        if self.info.cls == owner and \
+                self.info.node.name == "__init__":  # type: ignore[attr-defined]
+            return
+        lock, _ln = cl.guarded[node.attr]
+        if f"{owner}.{lock}" in held:
+            return
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and self.info.cls == owner:
+            msg = (f"access to '{node.attr}' (guarded-by {lock}) without "
+                   f"holding self.{lock}")
         else:
-            attr = _self_attr(base)
-            if attr is not None and attr in CLASS_HINTS:
-                callee = (CLASS_HINTS[attr], fn.attr)
-        if callee is not None:
-            for h in held:
-                self.calls_under.add((h, callee))
+            msg = (f"cross-object access to {owner}.{node.attr} "
+                   f"(guarded-by {lock}) without holding {owner}.{lock} — "
+                   f"use the owning class's locked accessor or take the "
+                   f"lock")
+        self.findings.append(Finding(
+            "LK001", self.mod.path, node.lineno, self._symbol(), msg))
+
+    def _symbol(self) -> str:
+        if self.info.cls:
+            return f"{self.info.cls}.{self.info.node.name}"  # type: ignore[attr-defined]
+        return self.info.qualname
+
+    def _call(self, call: ast.Call, held: frozenset) -> None:
+        tgt = self.prog.resolve_call(self.mod, self.info, call,
+                                     self.local_types)
+        if self._nested:
+            return  # runs on another thread; not attributable to callers
+        if tgt is not None:
+            self.all_calls.add(tgt)
+            if held:
+                self.calls_under.append((held, tgt, call.lineno))
+        if held:
+            why = self._blocking_reason(call, held)
+            if why is not None:
+                self.blocking_sites.append((held, why, call.lineno))
+        if self.may_block is None:
+            why = self._blocking_reason(call, frozenset({"<caller>"}))
+            if why is not None:
+                self.may_block = why
+
+    def _blocking_reason(self, call: ast.Call,
+                         held: frozenset) -> Optional[str]:
+        got = self.prog.canonical(self.mod, call.func)
+        name, resolved = got if got is not None else ("", False)
+        tail = name.split(".")[-1] if name else ""
+        if name == "time.sleep" and resolved:
+            return "time.sleep()"
+        if tail == "block_until_ready":
+            return ".block_until_ready()"
+        if tail == "urlopen":
+            return "urlopen()"
+        if tail in _HTTP_VERBS:
+            if (resolved and name.startswith("requests.")) or \
+                    ".session." in f".{name}":
+                return f"HTTP .{tail}()"
+            return None
+        if tail == "result" and not call.args and not call.keywords:
+            return ".result()"
+        if tail == "join":
+            if resolved and name.startswith("os.path"):
+                return None
+            base = call.func.value if isinstance(call.func, ast.Attribute) \
+                else None
+            if isinstance(base, ast.Constant):
+                return None  # ", ".join(...)
+            if not call.args or (len(call.args) == 1 and isinstance(
+                    call.args[0], ast.Constant) and isinstance(
+                    call.args[0].value, (int, float))):
+                return ".join() on a thread"
+            return None
+        if tail == "wait":
+            base = call.func.value if isinstance(call.func, ast.Attribute) \
+                else None
+            lock = self._lock_of(base) if base is not None else None
+            if lock is not None and held == frozenset({lock}):
+                return None  # cond.wait() releases the only lock held
+            return ".wait()"
+        return None
 
 
-def check(modules: List[ModuleInfo]) -> List[Finding]:
+# -- whole-package analysis --------------------------------------------------
+
+def _scan_all(modules: List[ModuleInfo], prog: callgraph.Program,
+              classes: Dict[str, ClassLocks]) -> Dict[str, _FuncScan]:
+    scans: Dict[str, _FuncScan] = {}
+    for mod in modules:
+        dotted = callgraph.module_name(mod.path)
+        for qual, info in mod.funcs.items():
+            if not isinstance(info.node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if info.parent_qual and info.parent_qual in mod.funcs:
+                continue  # nested def: scanned by its parent (no locks held)
+            scan = _FuncScan(mod, info, f"{dotted}.{qual}", prog, classes)
+            scan.run()
+            scans[scan.qual] = scan
+    return scans
+
+
+def _transitive_acquired(scans: Dict[str, _FuncScan]
+                         ) -> Dict[str, Set[str]]:
+    acquired = {q: set(s.acquired) for q, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, scan in scans.items():
+            for tgt in scan.all_calls:
+                extra = acquired.get(tgt)
+                if extra and not extra <= acquired[q]:
+                    acquired[q] |= extra
+                    changed = True
+    return acquired
+
+
+def _transitive_blocking(scans: Dict[str, _FuncScan],
+                         prog: callgraph.Program) -> Dict[str, str]:
+    """qualname -> reason, for functions that may block anywhere in their
+    call tree (direct reasons computed ignoring the held-set exemption:
+    a Condition.wait blocks its *callers* even though it releases its own
+    lock)."""
+    blocking: Dict[str, str] = {
+        q: scan.may_block for q, scan in scans.items()
+        if scan.may_block is not None}
+    changed = True
+    while changed:
+        changed = False
+        for q, scan in scans.items():
+            if q in blocking:
+                continue
+            for tgt in scan.all_calls:
+                if tgt in blocking:
+                    leaf = blocking[tgt].split(" [via ")[0]
+                    blocking[q] = f"{leaf} [via {tgt}]"
+                    changed = True
+                    break
+    return blocking
+
+
+def _edge_line(scan: _FuncScan) -> int:
+    """Fixture tests pin LK003 to the owning class's line; module-level
+    functions use their own def line."""
+    if scan.info.cls:
+        for qual, cls in scan.mod.classes.items():
+            if cls.name == scan.info.cls:
+                return cls.lineno
+    return getattr(scan.info.node, "lineno", 0)
+
+
+def _analyze(modules: List[ModuleInfo], prog: Optional[callgraph.Program]
+             ) -> Tuple[List[Finding], Dict[str, Set[str]]]:
+    if prog is None:
+        prog = callgraph.build(modules)
     findings: List[Finding] = []
     classes = _collect_classes(modules)
 
@@ -226,30 +390,39 @@ def check(modules: List[ModuleInfo]) -> List[Finding]:
                     f"guarded-by names '{lock}', which is not a "
                     f"threading lock attribute of {cls.name}"))
 
-    # per-method scans
-    scans: Dict[Tuple[str, str], _MethodScan] = {}
-    for cls in classes.values():
-        for mname, mnode in cls.methods.items():
-            scan = _MethodScan(cls, mname)
-            scan.run(mnode)
-            scans[(cls.name, mname)] = scan
-            if mname != "__init__":
-                findings.extend(scan.findings)
+    scans = _scan_all(modules, prog, classes)
+    for scan in scans.values():
+        if not (scan.info.cls and
+                scan.info.node.name == "__init__"):  # type: ignore[attr-defined]
+            findings.extend(scan.findings)
 
-    # transitive lock-acquisition sets per method (fixpoint)
-    acquired: Dict[Tuple[str, str], Set[str]] = {
-        key: {f"{key[0]}.{lk}" for lk in scan.acquired}
-        for key, scan in scans.items()}
-    changed = True
-    while changed:
-        changed = False
-        for key, scan in scans.items():
-            for _h, (tgt_cls, tgt_meth) in scan.calls_under:
-                tgt = (key[0] if tgt_cls == "self" else tgt_cls, tgt_meth)
-                extra = acquired.get(tgt, set())
-                if not extra <= acquired[key]:
-                    acquired[key] |= extra
-                    changed = True
+    acquired = _transitive_acquired(scans)
+    blocking = _transitive_blocking(scans, prog)
+
+    # LK004: blocking call while holding a lock — direct sites, then calls
+    # whose resolved callee may transitively block
+    for scan in scans.values():
+        reported: Set[int] = set()
+        for held, why, line in scan.blocking_sites:
+            if line in reported:
+                continue
+            reported.add(line)
+            findings.append(Finding(
+                "LK004", scan.mod.path, line, scan._symbol(),
+                f"blocking call {why} while holding "
+                f"{', '.join(sorted(held))} — release the lock before "
+                f"blocking on device/network/time, or the lock becomes a "
+                f"convoy (and a deadlock precondition)"))
+        for held, tgt, line in scan.calls_under:
+            why = blocking.get(tgt)
+            if why is None or line in reported:
+                continue
+            reported.add(line)
+            findings.append(Finding(
+                "LK004", scan.mod.path, line, scan._symbol(),
+                f"call to {tgt}() may block ({why}) while holding "
+                f"{', '.join(sorted(held))} — release the lock before "
+                f"blocking on device/network/time"))
 
     # lock-order edges: nested withs + calls made while holding a lock
     edges: Dict[str, Set[str]] = {}
@@ -261,16 +434,15 @@ def check(modules: List[ModuleInfo]) -> List[Finding]:
         edges.setdefault(a, set()).add(b)
         edge_src.setdefault((a, b), (mod.path, line, sym))
 
-    for key, scan in scans.items():
-        cls = scan.cls
+    for scan in scans.values():
+        line = _edge_line(scan)
         for (a, b) in scan.edges:
-            add_edge(f"{cls.name}.{a}", f"{cls.name}.{b}", cls.mod,
-                     cls.node.lineno, f"{cls.name}.{key[1]}")
-        for h, (tgt_cls, tgt_meth) in scan.calls_under:
-            tgt = (key[0] if tgt_cls == "self" else tgt_cls, tgt_meth)
+            add_edge(a, b, scan.mod, line, scan._symbol())
+        for held, tgt, _callline in scan.calls_under:
             for lk in acquired.get(tgt, set()):
-                add_edge(f"{cls.name}.{h}", lk, cls.mod, cls.node.lineno,
-                         f"{cls.name}.{key[1]} -> {tgt[0]}.{tgt[1]}")
+                for h in held:
+                    add_edge(h, lk, scan.mod, line,
+                             f"{scan._symbol()} -> {tgt}")
 
     # LK003: cycles in the lock digraph
     seen_cycles: Set[frozenset] = set()
@@ -302,4 +474,20 @@ def check(modules: List[ModuleInfo]) -> List[Finding]:
         if node not in visited:
             dfs(node, [], set(), visited)
 
+    return findings, edges
+
+
+def check(modules: List[ModuleInfo],
+          prog: Optional[callgraph.Program] = None) -> List[Finding]:
+    findings, _edges = _analyze(modules, prog)
     return findings
+
+
+def lock_order_graph(modules: List[ModuleInfo],
+                     prog: Optional[callgraph.Program] = None
+                     ) -> Dict[str, Set[str]]:
+    """The static lock-acquisition digraph (``Class.attr`` -> set of
+    ``Class.attr`` acquired while held). runtime/locksan.py diffs the
+    observed runtime order graph against this model."""
+    _findings, edges = _analyze(modules, prog)
+    return edges

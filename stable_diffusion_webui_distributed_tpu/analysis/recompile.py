@@ -77,12 +77,72 @@ def _sanitized(mod: ModuleInfo, node: ast.Call) -> bool:
     return False
 
 
+class _Inter:
+    """Interprocedural adapter: resolves call sites against the summary
+    table (analysis/summaries.py) so ``_taint_of`` can follow taint
+    through helpers in other modules. ``None`` everywhere degrades to the
+    old intra-procedural behavior (which the cross-module fixture test
+    exercises both ways)."""
+
+    def __init__(self, summaries, mod: ModuleInfo):
+        self.summaries = summaries
+        self.mod = mod
+
+    def resolve(self, info: FuncInfo, call: ast.Call):
+        return self.summaries.callee(self.mod, info, call)
+
+    def sanitizing(self, info: FuncInfo, call: ast.Call) -> bool:
+        got = self.resolve(info, call)
+        return got is not None and got[0].sanitizes
+
+    def call_taint(self, info: FuncInfo, call: ast.Call, tainted: Set[str],
+                   payload_params: Set[str]) -> Optional[str]:
+        """Why a summarized call's return value is tainted, or None."""
+        got = self.resolve(info, call)
+        if got is None:
+            return None
+        summ, offset = got
+        if summ.sanitizes:
+            return None
+        if summ.returns_taint:
+            return f"{summ.qualname}() [{summ.returns_taint}]"
+        forwarded = list(enumerate(call.args)) + [
+            (summ.params.index(kw.arg) - offset, kw.value)
+            for kw in call.keywords if kw.arg in summ.params]
+        for j, arg in forwarded:
+            if j + offset not in summ.param_to_return:
+                continue
+            why = _arg_taint(self.mod, arg, tainted, payload_params,
+                             self, info)
+            if why is not None:
+                return f"{summ.qualname}({why})"
+        return None
+
+
+def _arg_taint(mod: ModuleInfo, arg: ast.AST, tainted: Set[str],
+               payload_params: Set[str], inter: Optional["_Inter"],
+               info: Optional[FuncInfo]) -> Optional[str]:
+    """Taint of a call argument: the usual expression taint, plus the
+    whole-request-object case (``helper(payload)`` — a bare payload param
+    is itself request-derived even though only attribute reads off it are
+    taint *sources* intra-procedurally)."""
+    why = _taint_of(mod, arg, tainted, payload_params, inter, info)
+    if why is None and isinstance(arg, ast.Name) and \
+            arg.id in payload_params:
+        why = f"'{arg.id}' (request object)"
+    return why
+
+
 def _taint_of(mod: ModuleInfo, expr: ast.AST, tainted: Set[str],
-              payload_params: Set[str]) -> Optional[str]:
+              payload_params: Set[str], inter: Optional[_Inter] = None,
+              info: Optional[FuncInfo] = None) -> Optional[str]:
     """Why ``expr`` is tainted (a description), or None if clean."""
     for node in ast.walk(expr):
         if isinstance(node, ast.Call) and _sanitized(mod, node):
             return None  # quantized somewhere in the expression
+        if inter is not None and info is not None and \
+                isinstance(node, ast.Call) and inter.sanitizing(info, node):
+            return None  # callee's summary says it bucket/clamps
     for node in ast.walk(expr):
         if _is_env_read(mod, node):
             return "environment read"
@@ -93,6 +153,11 @@ def _taint_of(mod: ModuleInfo, expr: ast.AST, tainted: Set[str],
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
                 and node.id in tainted:
             return f"'{node.id}'"
+        if inter is not None and info is not None and \
+                isinstance(node, ast.Call):
+            why = inter.call_taint(info, node, tainted, payload_params)
+            if why is not None:
+                return why
     return None
 
 
@@ -118,6 +183,7 @@ def _jitted_marker(mod: ModuleInfo, info: FuncInfo) -> Optional[Set[int]]:
 
 def _scope_seed(mod: ModuleInfo, info: FuncInfo,
                 memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]],
+                inter: Optional[_Inter] = None,
                 ) -> Tuple[Set[str], Dict[str, _JitBinding]]:
     """(tainted names, jit bindings) a nested def inherits by closure.
 
@@ -134,7 +200,8 @@ def _scope_seed(mod: ModuleInfo, info: FuncInfo,
         return set(), {}
     if parent.qualname not in memo:
         tainted, bindings = _forward_pass(
-            mod, parent, *_scope_seed(mod, parent, memo), findings=None)
+            mod, parent, *_scope_seed(mod, parent, memo, inter),
+            findings=None, inter=inter)
         memo[parent.qualname] = (tainted, bindings)
     tainted, bindings = memo[parent.qualname]
     # names the child rebinds locally are its own, not the closure's
@@ -147,6 +214,7 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
                   seed_tainted: Set[str],
                   seed_bindings: Dict[str, _JitBinding],
                   findings: Optional[List[Finding]],
+                  inter: Optional[_Inter] = None,
                   ) -> Tuple[Set[str], Dict[str, _JitBinding]]:
     fn = info.node
     params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
@@ -171,7 +239,7 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
                     bindings[target.id] = _JitBinding(
                         statics, set(), f"{factory.qualname} (marked jitted)")
                     return
-        why = _taint_of(mod, value, tainted, payload_params)
+        why = _taint_of(mod, value, tainted, payload_params, inter, info)
         if why is not None:
             tainted.add(target.id)
         else:
@@ -187,7 +255,8 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
             elif isinstance(st, ast.AnnAssign) and st.value is not None:
                 note_assign(st.target, st.value)
             elif isinstance(st, ast.AugAssign):
-                why = _taint_of(mod, st.value, tainted, payload_params)
+                why = _taint_of(mod, st.value, tainted, payload_params,
+                                inter, info)
                 if why is not None and isinstance(st.target, ast.Name):
                     tainted.add(st.target.id)
             # RC001: calls to known-jitted callables with tainted statics
@@ -200,11 +269,17 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
                 if isinstance(node.func, ast.Name):
                     bind = bindings.get(node.func.id)
                 if bind is None:
+                    # interprocedural RC001: the callee's summary says one
+                    # of its params reaches a static jit sink inside it
+                    if inter is not None and findings is not None:
+                        _check_summary_sink(mod, info, node, tainted,
+                                            payload_params, inter, findings)
                     continue
                 for i, arg in enumerate(node.args):
                     if i not in bind.statics:
                         continue
-                    why = _taint_of(mod, arg, tainted, payload_params)
+                    why = _taint_of(mod, arg, tainted, payload_params,
+                                    inter, info)
                     if why is not None and findings is not None:
                         findings.append(Finding(
                             "RC001", mod.path, node.lineno, info.qualname,
@@ -216,7 +291,7 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
                 for kw in node.keywords:
                     if kw.arg in bind.static_names:
                         why = _taint_of(mod, kw.value, tainted,
-                                        payload_params)
+                                        payload_params, inter, info)
                         if why is not None and findings is not None:
                             findings.append(Finding(
                                 "RC001", mod.path, node.lineno,
@@ -236,15 +311,46 @@ def _forward_pass(mod: ModuleInfo, info: FuncInfo,
     return tainted, bindings
 
 
+def _check_summary_sink(mod: ModuleInfo, info: FuncInfo, call: ast.Call,
+                        tainted: Set[str], payload_params: Set[str],
+                        inter: _Inter, findings: List[Finding]) -> None:
+    """RC001 at a call whose callee (per its summary) forwards the given
+    argument position into a static jit argument."""
+    got = inter.resolve(info, call)
+    if got is None:
+        return
+    summ, offset = got
+    if not summ.param_to_sink:
+        return
+    forwarded = list(enumerate(call.args)) + [
+        (summ.params.index(kw.arg) - offset, kw.value)
+        for kw in call.keywords if kw.arg in summ.params]
+    for j, arg in forwarded:
+        sink = summ.param_to_sink.get(j + offset)
+        if sink is None:
+            continue
+        why = _arg_taint(mod, arg, tainted, payload_params, inter, info)
+        if why is not None:
+            findings.append(Finding(
+                "RC001", mod.path, call.lineno, info.qualname,
+                f"argument {j} of {summ.qualname}() reaches a static jit "
+                f"argument inside the callee ({sink}) and derives from "
+                f"{why}: every distinct value recompiles — quantize "
+                f"through the ShapeBucketer ladder or clamp to a constant "
+                f"range first"))
+
+
 def _check_function(mod: ModuleInfo, info: FuncInfo,
                     memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]],
+                    inter: Optional[_Inter] = None,
                     ) -> List[Finding]:
     fn = info.node
     if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return []
     findings: List[Finding] = []
     tainted, _bindings = _forward_pass(
-        mod, info, *_scope_seed(mod, info, memo), findings=findings)
+        mod, info, *_scope_seed(mod, info, memo, inter), findings=findings,
+        inter=inter)
 
     # RC002: functions handed to trace combinators that close over taint
     if tainted:
@@ -350,11 +456,15 @@ def _check_precision_reads(mod: ModuleInfo) -> List[Finding]:
     return findings
 
 
-def check(modules: List[ModuleInfo]) -> List[Finding]:
+def check(modules: List[ModuleInfo], summaries=None) -> List[Finding]:
+    """``summaries`` (analysis/summaries.Summaries) turns RC001/RC002
+    interprocedural; None reproduces the historical intra-procedural pass
+    (the cross-module fixture test asserts the difference)."""
     findings: List[Finding] = []
     for mod in modules:
+        inter = _Inter(summaries, mod) if summaries is not None else None
         memo: Dict[str, Tuple[Set[str], Dict[str, _JitBinding]]] = {}
         for info in mod.funcs.values():
-            findings.extend(_check_function(mod, info, memo))
+            findings.extend(_check_function(mod, info, memo, inter))
         findings.extend(_check_precision_reads(mod))
     return findings

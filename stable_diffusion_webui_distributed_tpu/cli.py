@@ -80,7 +80,7 @@ def _build_world(args, require_local: bool = True):
             pixel_cap=master_cal.pixel_cap if master_cal else 0,
         )
         world.add_worker(node, front=True)  # master leads the gallery
-    elif engine is None and require_local and not world.workers:
+    elif engine is None and require_local and not world.workers_snapshot():
         print("no checkpoints found and no remote workers configured; "
               f"put a .safetensors under '{registry.model_dir}' or add "
               "workers to the config", file=sys.stderr)

@@ -161,6 +161,13 @@ def _logger():
 #   the requeue path (obs/watchdog.py). Only armed where an ETA exists
 #   (benchmarked calibration); 0 never arms and the join path is
 #   byte-identical to the unwatched build.
+# - ``SDTPU_LOCKSAN`` (flag, default off): runtime lockset sanitizer
+#   (runtime/locksan.py). When 1, tests/conftest.py wraps the
+#   ``threading`` lock factories to record observed lock-acquisition
+#   order and diffs it against the static LK003 graph at session end;
+#   any ordering the static model missed fails the run. Off by default:
+#   nothing is patched and the lock path is byte-identical to stock
+#   threading. Test harness only — never set in production serving.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -135,6 +135,14 @@ class GenerationState:
         with self._lock:
             self._listeners.append(cb)
 
+    def progress_snapshot(self) -> Progress:
+        """Locked copy for cross-thread readers (the HTTP progress
+        endpoints): ``begin`` replaces the Progress object and ``step``
+        mutates it on the executor thread, so a bare ``state.progress``
+        read can see a torn update."""
+        with self._lock:
+            return dataclasses.replace(self.progress)
+
 
 #: Default process-wide state (servers may create their own).
 STATE = GenerationState()

@@ -1,0 +1,225 @@
+"""Runtime lockset sanitizer (``SDTPU_LOCKSAN``, default off).
+
+The static lock analysis (analysis/locks.py) computes an acquisition-order
+digraph over ``Class.attr`` lock names. This module is the other half of
+the contract: when ``SDTPU_LOCKSAN=1``, the ``threading.Lock`` /
+``threading.RLock`` factories are replaced with wrappers that
+
+- **name** each lock at creation by inspecting the creating frame: a lock
+  born from ``self._lock = threading.Lock()`` inside ``WorkerNode.__init__``
+  is named ``WorkerNode._lock`` — the same qualified name the static graph
+  uses, so the two graphs diff cleanly;
+- **record** every nested acquisition as an ordered edge (held → acquired)
+  in a process-global edge set, per-thread via a thread-local held stack;
+- implement the ``Condition`` protocol (``_release_save`` /
+  ``_acquire_restore`` / ``_is_owned``) so ``cond.wait()`` correctly pops
+  and re-pushes the held stack.
+
+At teardown (tests/conftest.py wires this under ``SDTPU_LOCKSAN=1``),
+:func:`divergence` compares the observed edges against the static graph:
+an observed edge between two statically-known lock names with no static
+path in that direction means the static model missed a real ordering —
+the run fails rather than letting the model rot. Anonymous locks (no
+``self.<attr> =`` creation site, stdlib internals) never participate.
+
+Default off: importing this module patches nothing; ``install()`` is the
+only entry point with side effects, and ``uninstall()`` restores the real
+factories. The wrapper adds two dict lookups and a list append per
+acquire — fine for tests, not meant for production serving.
+"""
+
+from __future__ import annotations
+
+import linecache
+import re
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_ATTR_ASSIGN = re.compile(r"self\s*\.\s*(\w+)\s*(?::[^=]+)?=")
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+_installed = False
+_edges: Set[Tuple[str, str]] = set()
+_edges_guard = _real_lock()
+_tls = threading.local()
+
+
+def _held_stack() -> List["_SanLock"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _name_from_frame(depth: int = 2) -> Optional[str]:
+    """``Class.attr`` for a ``self.<attr> = threading.Lock()`` creation
+    site, else None (anonymous)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return None
+    obj = frame.f_locals.get("self")
+    if obj is None:
+        return None
+    line = linecache.getline(frame.f_code.co_filename, frame.f_lineno)
+    m = _ATTR_ASSIGN.search(line)
+    if m is None:
+        return None
+    return f"{type(obj).__name__}.{m.group(1)}"
+
+
+class _SanLock:
+    """Order-recording wrapper around a real Lock/RLock."""
+
+    def __init__(self, raw, name: Optional[str]):
+        self._raw = raw
+        self._san_name = name
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _push(self) -> None:
+        stack = _held_stack()
+        if self._san_name is not None:
+            new_edges = [
+                (h._san_name, self._san_name) for h in stack
+                if h._san_name is not None and h._san_name != self._san_name]
+            if new_edges:
+                with _edges_guard:
+                    _edges.update(new_edges)
+        stack.append(self)
+
+    def _pop(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+
+    # -- lock protocol -------------------------------------------------------
+
+    def acquire(self, *args, **kwargs):
+        got = self._raw.acquire(*args, **kwargs)
+        if got:
+            self._push()
+        return got
+
+    def release(self):
+        self._pop()
+        return self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- Condition protocol (cond.wait releases and reacquires) -------------
+
+    def _release_save(self):
+        self._pop()
+        if hasattr(self._raw, "_release_save"):
+            return self._raw._release_save()
+        self._raw.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._raw, "_acquire_restore"):
+            self._raw._acquire_restore(state)
+        else:
+            self._raw.acquire()
+        self._push()
+
+    def _is_owned(self):
+        if hasattr(self._raw, "_is_owned"):
+            return self._raw._is_owned()
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<SanLock {self._san_name or 'anon'} {self._raw!r}>"
+
+
+def _lock_factory():
+    return _SanLock(_real_lock(), _name_from_frame())
+
+
+def _rlock_factory(*args, **kwargs):
+    return _SanLock(_real_rlock(*args, **kwargs), _name_from_frame())
+
+
+def install() -> None:
+    """Patch the threading lock factories (idempotent). ``Condition()``
+    with no explicit lock picks the patch up too: CPython resolves
+    ``RLock`` through the threading module globals at call time."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _edges_guard:
+        _edges.clear()
+
+
+def observed_edges() -> Set[Tuple[str, str]]:
+    with _edges_guard:
+        return set(_edges)
+
+
+def static_graph(root: str) -> Dict[str, Set[str]]:
+    """The package's static lock-order digraph (pure AST; no device)."""
+    from ..analysis import callgraph, locks
+    from ..analysis.core import walk_package
+    modules = walk_package(root)
+    return locks.lock_order_graph(modules, callgraph.build(modules))
+
+
+def divergence(observed: Set[Tuple[str, str]],
+               static: Dict[str, Set[str]]) -> List[Tuple[str, str]]:
+    """Observed edges between statically-known locks that the static
+    graph has no path for — the static model missed a real ordering
+    (or the runtime inverted a modeled one)."""
+    nodes: Set[str] = set(static)
+    for vs in static.values():
+        nodes |= vs
+
+    def reachable(a: str, b: str) -> bool:
+        frontier, seen = [a], {a}
+        while frontier:
+            cur = frontier.pop()
+            if cur == b:
+                return True
+            for nxt in static.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return False
+
+    return sorted((a, b) for a, b in observed
+                  if a in nodes and b in nodes and not reachable(a, b))

@@ -1168,6 +1168,6 @@ class World:
                     model_override=wm.model_override,
                 )
                 if wm.disabled:
-                    node.state = State.DISABLED
+                    node.set_state(State.DISABLED)
                 world.add_worker(node)
         return world

@@ -299,7 +299,7 @@ class ApiServer:
         return {}
 
     def handle_progress(self) -> Dict[str, Any]:
-        p = self.state.progress
+        p = self.state.progress_snapshot()
         eta = p.eta_seconds()
         return {
             "progress": p.fraction,
@@ -449,7 +449,7 @@ class ApiServer:
         if hasattr(self.source, "workers"):
             for w in _fleet_workers(self.source):
                 workers.append(_worker_dict(w))
-        p = self.state.progress
+        p = self.state.progress_snapshot()
         settings = None
         if hasattr(self.source, "job_timeout"):
             settings = {

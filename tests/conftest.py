@@ -27,6 +27,12 @@ def pytest_configure(config):
     first: it has already redirected fd 1/2 to tempfiles, and an exec'd
     process inheriting those would lose every byte of output.
     """
+    if os.environ.get("SDTPU_LOCKSAN") == "1":
+        # Patch the threading lock factories BEFORE test modules import
+        # the package, so every Class.attr lock is wrapped and named.
+        from stable_diffusion_webui_distributed_tpu.runtime import locksan
+
+        locksan.install()
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return
     capman = config.pluginmanager.getplugin("capturemanager")
@@ -55,6 +61,29 @@ _SLOW_MODULES = {
     "test_pipeline", "test_adapters", "test_inpaint_model",
     "test_embeddings", "test_registry", "test_esrgan", "test_goldens",
 }
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """SDTPU_LOCKSAN=1: diff the observed lock-order graph against the
+    static LK003 graph; an edge the static model has no path for fails
+    the run — the model must not silently diverge from reality."""
+    if os.environ.get("SDTPU_LOCKSAN") != "1":
+        return
+    from stable_diffusion_webui_distributed_tpu.runtime import locksan
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    diverged = locksan.divergence(locksan.observed_edges(),
+                                  locksan.static_graph(root))
+    if diverged:
+        print("\nlocksan: observed lock orderings missing from the static "
+              "graph (analysis/locks.py):", file=sys.stderr)
+        for a, b in diverged:
+            print(f"  {a} -> {b}", file=sys.stderr)
+        session.exitstatus = 1
+    else:
+        print(f"\nlocksan: {len(locksan.observed_edges())} observed "
+              f"edge(s), zero divergence from the static graph",
+              file=sys.stderr)
 
 
 def pytest_collection_modifyitems(config, items):

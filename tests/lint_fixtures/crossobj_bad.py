@@ -1,0 +1,37 @@
+"""Known-bad cross-object lock fixture (LK001/LK003 through inferred
+attribute types — no hand-maintained class hints anywhere).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "idle"  # guarded-by: _lock
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self.node = Node()
+
+    def peek(self):
+        return self.node.state  # LK001: Node._lock not held (cross-object)
+
+    def locked_peek(self):
+        with self.node._lock:
+            return self.node.state  # fine: the owning lock is held
+
+    def nested(self):
+        with self._reg_lock:
+            with self.node._lock:
+                pass
+
+
+def inverted(reg: Registry):
+    with reg.node._lock:
+        with reg._reg_lock:  # LK003: opposite order to Registry.nested
+            pass

@@ -1,0 +1,36 @@
+"""Known-bad blocking-under-lock fixture (LK004).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+import time
+
+import requests
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def convoy(self, out):
+        with self._lock:
+            time.sleep(0.5)  # LK004: sleep under lock
+            out.block_until_ready()  # LK004: device sync under lock
+
+    def _fetch(self):
+        return requests.get("http://example/health")
+
+    def indirect(self):
+        with self._lock:
+            return self._fetch()  # LK004: callee blocks on the network
+
+    def wait_ok(self):
+        with self._cv:
+            self._cv.wait()  # fine: wait releases the only held lock
+
+    def release_first(self, out):
+        with self._lock:
+            pass
+        out.block_until_ready()  # fine: lock released before blocking

@@ -1,0 +1,45 @@
+"""Known-bad donation fixture (DN001).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def use_after_donate(latents, noise):
+    fn = jax.jit(lambda c, n: c + n, donate_argnums=(0,))
+    out = fn(latents, noise)
+    return latents + out  # DN001: latents was donated
+
+
+def rebind_ok(carry, noise):
+    fn = jax.jit(lambda c, n: c + n, donate_argnums=(0,))
+    for _ in range(4):
+        carry = fn(carry, noise)  # fine: rebound in the same statement
+    return carry
+
+
+def loop_bad(carry, noise):
+    fn = jax.jit(lambda c, n: c + n, donate_argnums=(0,))
+    total = jnp.zeros(())
+    for _ in range(4):
+        total = fn(carry, noise)  # DN001: carry dead on iteration 2
+    return total
+
+
+# sdtpu-lint: jitted(donate=0)
+def make_step():
+    return jax.jit(lambda c, n: c + n, donate_argnums=(0,))
+
+
+def factory_donate(carry, noise):
+    step = make_step()
+    out = step(carry, noise)
+    return carry * out  # DN001: donated via marked factory
+
+
+def audited(carry, noise):
+    fn = jax.jit(lambda c, n: c + n, donate_argnums=(0,))
+    out = fn(carry, noise)
+    return carry.shape, out  # sdtpu-lint: donated

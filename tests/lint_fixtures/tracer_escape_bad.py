@@ -1,0 +1,22 @@
+"""Known-bad tracer-escape fixture (TP004).
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+
+
+class Denoiser:
+    def __init__(self):
+        self.trace_leak = None
+        self.history = []
+        self.stats = None
+
+    def run(self, latents):
+        def body(x, sigma):
+            self.trace_leak = x * sigma  # TP004: tracer stored on self
+            self.history.append(sigma)  # TP004: tracer into container
+            self.stats = x.shape  # fine: shape is a trace-time constant
+            return x - sigma
+
+        return jax.jit(body)(latents, 0.5)

@@ -1,0 +1,21 @@
+"""Cross-module taint fixture, consumer half (see xmod_helper.py).
+
+Analyzed as AST only — never imported, never run. Line numbers are
+asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+from tests.lint_fixtures.xmod_helper import bucketed_steps, raw_steps
+
+
+def render(payload):
+    fn = jax.jit(lambda v, steps: v * steps, static_argnums=(1,))
+    steps = raw_steps(payload)  # taint laundered through another module
+    return fn(jnp.zeros(4), steps)  # RC001: interprocedural only
+
+
+def render_bucketed(payload):
+    fn = jax.jit(lambda v, steps: v * steps, static_argnums=(1,))
+    steps = bucketed_steps(payload)  # callee summary says: sanitized
+    return fn(jnp.zeros(4), steps)  # fine either way

@@ -1,0 +1,22 @@
+"""Cross-module taint fixture, helper half.
+
+``raw_steps`` launders a request read across a module boundary: the old
+intra-procedural pass sees ``raw_steps(payload)`` in the consumer as a
+clean call (a bare ``payload`` name is not a taint source; only attribute
+reads are), while the summary engine knows the callee returns
+``payload.steps``. tests/test_lint.py asserts BOTH behaviors.
+
+Analyzed as AST only — never imported, never run.
+"""
+
+
+def raw_steps(payload):
+    return payload.steps
+
+
+def bucketed_steps(payload):
+    return bucket_steps(payload.steps)
+
+
+def bucket_steps(steps):
+    return max(steps, 8)

@@ -1,0 +1,182 @@
+"""Tests for the runtime lockset sanitizer (runtime/locksan.py).
+
+Three layers:
+
+- default-off guarantees: importing the module patches nothing, and a
+  deterministic lock-using workload produces byte-identical results with
+  the sanitizer on and off (the wrapper observes, never alters);
+- wrapper mechanics: creation-site naming, nested-acquisition edge
+  recording, Condition.wait() stack hygiene;
+- divergence detection: synthetic observed/static graph pairs, including
+  the transitive-path case and the anonymous-lock exemption.
+
+The full-package integration (observed edges from a real test run diffed
+against the static graph at session teardown) lives in tests/conftest.py
+under ``SDTPU_LOCKSAN=1``.
+"""
+
+import hashlib
+import os
+import threading
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.runtime import locksan
+
+LOCKSAN_ON = os.environ.get("SDTPU_LOCKSAN") == "1"
+
+
+@pytest.fixture
+def sanitized():
+    """Install the sanitizer for one test, restoring prior state after."""
+    was = locksan.installed()
+    locksan.install()
+    locksan.reset()
+    yield
+    locksan.reset()
+    if not was:
+        locksan.uninstall()
+
+
+def _workload():
+    """Deterministic lock-using computation; returns a digest."""
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv_lock = threading.RLock()
+            self.values = []
+
+        def record(self, v):
+            with self._lock:
+                with self._cv_lock:
+                    self.values.append(v * 3 + 1)
+
+    c = Counter()
+    threads = [threading.Thread(target=c.record, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    payload = ",".join(str(v) for v in sorted(c.values)).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+class TestDefaultOff:
+    @pytest.mark.skipif(LOCKSAN_ON, reason="conftest installed the sanitizer")
+    def test_import_patches_nothing(self):
+        assert not locksan.installed()
+        assert threading.Lock is locksan._real_lock
+        assert threading.RLock is locksan._real_rlock
+
+    def test_workload_is_byte_identical_on_and_off(self, sanitized):
+        with_san = _workload()
+        was = locksan.installed()
+        locksan.uninstall()
+        try:
+            without = _workload()
+        finally:
+            if was:
+                locksan.install()
+        assert with_san == without
+
+    def test_uninstall_restores_real_factories(self):
+        was = locksan.installed()
+        locksan.install()
+        locksan.uninstall()
+        assert threading.Lock is locksan._real_lock
+        assert threading.RLock is locksan._real_rlock
+        if was:
+            locksan.install()
+
+
+class TestWrapperMechanics:
+    def test_creation_site_naming(self, sanitized):
+        class WorkerNode:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        node = WorkerNode()
+        assert isinstance(node._lock, locksan._SanLock)
+        assert node._lock._san_name == "WorkerNode._lock"
+
+    def test_module_level_lock_is_anonymous(self, sanitized):
+        lock = threading.Lock()
+        assert isinstance(lock, locksan._SanLock)
+        assert lock._san_name is None
+
+    def test_nested_acquisition_records_edge(self, sanitized):
+        class Pair:
+            def __init__(self):
+                self.outer = threading.Lock()
+                self.inner = threading.Lock()
+
+        p = Pair()
+        with p.outer:
+            with p.inner:
+                pass
+        assert ("Pair.outer", "Pair.inner") in locksan.observed_edges()
+        assert ("Pair.inner", "Pair.outer") not in locksan.observed_edges()
+
+    def test_anonymous_locks_record_no_edges(self, sanitized):
+        a, b = threading.Lock(), threading.Lock()
+        with a:
+            with b:
+                pass
+        assert not locksan.observed_edges()
+
+    def test_condition_wait_pops_the_held_stack(self, sanitized):
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cv = threading.Condition(self._lock)
+
+        box = Box()
+        hits = []
+
+        def waiter():
+            with box.cv:
+                box.cv.wait()
+                hits.append(len(locksan._held_stack()))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        # keep notifying until the waiter wakes: wait() must have
+        # RELEASED the wrapped lock or these acquires would deadlock
+        import time
+        deadline = time.monotonic() + 5
+        while not hits and time.monotonic() < deadline:
+            with box.cv:
+                box.cv.notify()
+        t.join(timeout=5)
+        assert hits == [1]  # cv reacquired -> exactly the cv lock held
+
+
+class TestDivergence:
+    def test_consistent_order_is_clean(self):
+        static = {"A.l": {"B.l"}, "B.l": {"C.l"}}
+        assert locksan.divergence({("A.l", "B.l")}, static) == []
+
+    def test_transitive_static_path_is_clean(self):
+        # observed A->C with static A->B->C: the model covers it
+        static = {"A.l": {"B.l"}, "B.l": {"C.l"}}
+        assert locksan.divergence({("A.l", "C.l")}, static) == []
+
+    def test_inverted_edge_is_reported(self):
+        static = {"A.l": {"B.l"}}
+        assert locksan.divergence({("B.l", "A.l")}, static) == [
+            ("B.l", "A.l")]
+
+    def test_unknown_nodes_are_exempt(self):
+        # an edge touching a lock the static model never saw cannot
+        # diverge — the sanitizer only checks what the model claims
+        static = {"A.l": {"B.l"}}
+        assert locksan.divergence({("A.l", "Ghost.l")}, static) == []
+
+    def test_static_graph_of_the_repo_is_acyclic_shaped(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        graph = locksan.static_graph(repo)
+        assert isinstance(graph, dict)
+        for src, dsts in graph.items():
+            assert "." in src
+            assert src not in dsts  # no self-loops in a clean gate

@@ -690,3 +690,92 @@ class TestBenchCompare:
             assert bench_compare.main([name, name, "--json"]) == 0
             v = json.loads(capsys.readouterr().out)
             assert v["ok"] is True and v["compared"] >= 2
+
+
+class TestLintReport:
+    """tools/lint_report.py: the JSON roll-up plus the SARIF 2.1.0 log
+    code-scanning endpoints ingest. Scoped to one fixture file so the
+    test stays fast; the full-package run is TestRepoGate's job."""
+
+    FIXTURE = ["tests/lint_fixtures/env_bad.py"]
+
+    def _report(self):
+        import lint_report
+
+        return lint_report.build_report(paths=self.FIXTURE,
+                                        use_allowlist=False)
+
+    def test_report_carries_wall_time_and_counts(self):
+        rep = self._report()
+        assert isinstance(rep["wall_time_s"], float)
+        assert rep["wall_time_s"] >= 0.0
+        assert rep["finding_count"] == 2
+        assert rep["counts_by_rule"] == {"EV001": 2}
+
+    def test_sarif_log_shape(self):
+        import lint_report
+
+        rep = self._report()
+        log = lint_report.to_sarif(rep)
+        assert log["version"] == "2.1.0"
+        assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+        assert len(log["runs"]) == 1
+        run = log["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "sdtpu-lint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert rule_ids == set(rep["rules"])
+        for r in driver["rules"]:
+            assert r["shortDescription"]["text"]
+        assert len(run["results"]) == rep["finding_count"]
+        for res in run["results"]:
+            assert res["ruleId"] in rule_ids
+            assert res["message"]["text"]
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"] == self.FIXTURE[0]
+            assert loc["region"]["startLine"] >= 1
+
+    def test_sarif_cli_writes_the_log(self, tmp_path):
+        import lint_report
+
+        out = tmp_path / "lint.sarif"
+        rc = lint_report.main(
+            self.FIXTURE + ["--no-allowlist", "--sarif", str(out),
+                            "-o", str(tmp_path / "lint.json")])
+        assert rc == 1  # the fixture has findings by design
+        log = json.loads(out.read_text())
+        assert log["version"] == "2.1.0"
+        assert log["runs"][0]["results"]
+
+    def test_suppressed_findings_carry_suppressions(self, tmp_path):
+        import lint_report
+
+        allow = tmp_path / "allow.json"
+        allow.write_text(json.dumps([{
+            "rule": "EV001", "path": self.FIXTURE[0],
+            "symbol": "read_knob", "reason": "fixture exercise"}]))
+        rep = lint_report.build_report(paths=self.FIXTURE,
+                                       allowlist_path=str(allow))
+        log = lint_report.to_sarif(rep)
+        results = log["runs"][0]["results"]
+        flagged = [r for r in results if "suppressions" in r]
+        assert len(flagged) == 1
+        assert flagged[0]["suppressions"][0]["kind"] == "external"
+
+    def test_lint_ledger_row_gates_finding_count(self):
+        import bench_compare
+
+        def row(count, wall):
+            return {"schema": 1, "kind": "lint", "device": "cpu",
+                    "tiny": True, "metrics": {
+                        "lint_finding_count": count,
+                        "lint_wall_time_s": wall,
+                        "lint_modules": 84}}
+
+        # wall time is trajectory-only: doubling it alone stays clean
+        ok = bench_compare.compare(row(0, 4.0), row(0, 9.0))
+        assert ok["ok"] is True
+        # the finding count has zero tolerance
+        bad = bench_compare.compare(row(0, 4.0), row(1, 4.0))
+        assert bad["ok"] is False
+        assert bad["regressions"] == ["lint_finding_count"]

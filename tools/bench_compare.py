@@ -48,6 +48,11 @@ THRESHOLDS = {
     # deterministic, so any movement at all is a behavior change
     "watchdog_stalls": ("up", "abs", 0.0),
     "requeue_recovery_rate": ("down", "abs", 0.0),
+    # lint rows (bench.py _run_lint_metrics): the repo gate is clean, so
+    # the finding count moving up at all means someone landed a finding
+    # without fixing or allowlisting it (wall time is trajectory-only —
+    # machine-dependent, never gated)
+    "lint_finding_count": ("up", "abs", 0.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back

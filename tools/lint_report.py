@@ -3,11 +3,16 @@
 
 Wraps ``python -m stable_diffusion_webui_distributed_tpu.analysis --json``
 with the roll-ups a dashboard wants: per-rule counts, per-file counts, the
-allowlist ledger (live/expired/unused), and a single ``clean`` boolean.
+allowlist ledger (live/expired/unused), full-package wall time, and a
+single ``clean`` boolean.
 
     python tools/lint_report.py                 # JSON to stdout
     python tools/lint_report.py -o lint.json    # ... or to a file
     python tools/lint_report.py --no-allowlist  # raw findings, no ledger
+    python tools/lint_report.py --sarif out.sarif  # SARIF 2.1.0 sidecar
+
+Wall time is measured with the cache disabled — it is the honest
+full-package figure the bench ledger tracks, not a cache hit.
 
 Exit code mirrors the lint gate: 0 clean, 1 findings.
 """
@@ -33,13 +38,14 @@ from stable_diffusion_webui_distributed_tpu.analysis import (  # noqa: E402
 
 def build_report(paths=None, allowlist_path=None, use_allowlist=True):
     result = run_analysis(REPO, paths=paths, allowlist_path=allowlist_path,
-                          use_allowlist=use_allowlist)
+                          use_allowlist=use_allowlist, use_cache=False)
     by_file = {}
     for f in result.findings:
         by_file[f.path] = by_file.get(f.path, 0) + 1
     report = {
         "clean": result.clean,
         "modules_analyzed": result.modules,
+        "wall_time_s": round(result.wall_time_s, 3),
         "finding_count": len(result.findings),
         "suppressed_count": len(result.suppressed),
         "counts_by_rule": dict(sorted(result.counts.items())),
@@ -59,6 +65,55 @@ def build_report(paths=None, allowlist_path=None, use_allowlist=True):
     return report
 
 
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(report):
+    """SARIF 2.1.0 log for code-scanning upload endpoints.
+
+    One run, one ``tool.driver`` carrying the full rule table; every
+    finding becomes a ``result`` with a physical location. Suppressed
+    (allowlisted) findings are emitted with a SARIF ``suppressions``
+    entry rather than dropped, so the upload shows the debt.
+    """
+    def result(f, suppressed=False):
+        out = {
+            "ruleId": f["rule"],
+            "level": "error",
+            "message": {"text": f["message"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f["path"]},
+                    "region": {"startLine": f["line"]},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f["symbol"]}],
+            }],
+        }
+        if suppressed:
+            out["suppressions"] = [{"kind": "external",
+                                    "justification": "allowlist entry"}]
+        return out
+
+    return {
+        "version": "2.1.0",
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "sdtpu-lint",
+                "rules": [
+                    {"id": rid,
+                     "shortDescription": {"text": text}}
+                    for rid, text in report["rules"].items()
+                ],
+            }},
+            "results": ([result(f) for f in report["findings"]]
+                        + [result(f, suppressed=True)
+                           for f in report["suppressed"]]),
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*",
@@ -67,11 +122,18 @@ def main(argv=None) -> int:
                     help="write JSON here instead of stdout")
     ap.add_argument("--allowlist", default=None)
     ap.add_argument("--no-allowlist", action="store_true")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write a SARIF 2.1.0 log here")
     args = ap.parse_args(argv)
 
     report = build_report(paths=args.paths or None,
                           allowlist_path=args.allowlist,
                           use_allowlist=not args.no_allowlist)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(to_sarif(report), fh, indent=2)
+            fh.write("\n")
+        print(f"wrote {args.sarif}", file=sys.stderr)
     text = json.dumps(report, indent=2) + "\n"
     if args.output:
         with open(args.output, "w", encoding="utf-8") as fh:
