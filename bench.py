@@ -976,6 +976,160 @@ class _EnvPatch:
         return False
 
 
+def run_cache(tiny):
+    """--cache: caching-tier microbench over a redundant request mix
+    (SDTPU_CACHE=1). Four phases through the serving dispatcher: a cold
+    set of distinct prompts sharing one negative (embed dedupe), byte-
+    exact repeats (result dedupe at admission — zero new dispatches), a
+    concurrent identical burst (single-flight collapse), and prefix
+    pairs that diverge only in a post-prefix field (mid-denoise resume
+    from the chunk-boundary carry). Reports per-layer hit rates, the
+    FLOPs/image delta between a full and a resumed denoise, and e2e
+    latency percentiles. Counts and FLOP ratios are structural —
+    meaningful on CPU. Writes BENCH_cache.json and appends a "cache"
+    row to BENCH_LEDGER.jsonl."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu import cache
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    if tiny or dev.platform == "cpu":
+        family, size, steps = C.TINY, 64, 8
+    else:
+        family, size, steps = C.SD15, 512, 16
+
+    # chunk 4 puts a capture boundary at the resume step (steps/2) and
+    # keeps the resumed run's chunk partition identical to a continuous
+    # run from that boundary — the byte-identity invariant.
+    with _EnvPatch(SDTPU_CACHE="1", SDTPU_CHUNK="4"):
+        engine = _make_engine(family)
+        bucketer = ShapeBucketer(shapes=[(size, size)], batches=[1])
+        dispatcher = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        cache.clear_all()
+        METRICS.clear()
+
+        lat, lat_lock, errs = [], threading.Lock(), []
+
+        def go(p):
+            t0 = time.time()
+            try:
+                dispatcher.submit(p)
+            except Exception as e:  # noqa: BLE001 — reported in the JSON
+                errs.append(repr(e))
+                return
+            with lat_lock:
+                lat.append(time.time() - t0)
+
+        def payload(tag, seed, **kw):
+            return GenerationPayload(
+                prompt=f"bench cache cow {tag}",
+                negative_prompt="blurry, low quality, jpeg artifacts",
+                steps=steps, width=size, height=size, seed=seed,
+                sampler_name="Euler a", **kw)
+
+        # phase 1 — cold: distinct prompts, one shared negative. The
+        # negative half hits from the second request on.
+        distinct = [payload(i, 200 + i) for i in range(6)]
+        for p in distinct:
+            go(p.model_copy(deep=True))
+        flops_full = METRICS.summary()["unet_flops_per_image"]
+
+        # phase 2 — byte-exact repeats: served from the result cache at
+        # admission; no new dispatch, no encode, no denoise.
+        for p in distinct:
+            go(p.model_copy(deep=True))
+
+        # phase 3 — concurrent identical burst: single-flight elects one
+        # leader, the rest block on its flight and share the result.
+        burst = payload("burst", 999)
+        threads = [threading.Thread(target=go,
+                                    args=(burst.model_copy(deep=True),))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # phase 4 — prefix pairs: denoising_strength is inert for plain
+        # txt2img but splits the result key, so the second request of
+        # each pair misses result dedupe and instead resumes mid-denoise
+        # from the carry its twin captured at the chunk boundary.
+        resumed_flops = []
+        for j in range(3):
+            first = payload(f"prefix{j}", 500 + j, denoising_strength=0.4)
+            second = payload(f"prefix{j}", 500 + j, denoising_strength=0.7)
+            go(first.model_copy(deep=True))
+            METRICS.clear()
+            go(second.model_copy(deep=True))
+            resumed_flops.append(METRICS.summary()["unet_flops_per_image"])
+
+        summ = cache.summary()
+        cache.clear_all()
+    if errs:
+        _dump_flightrec("cache")
+
+    embed = summ["embed"]
+    pos, neg = embed["positive"], embed["negative"]
+    e_hits = pos["hits"] + neg["hits"]
+    e_total = e_hits + pos["misses"] + neg["misses"]
+    res = summ["result"]
+    resumed = [f for f in resumed_flops if f]
+    flops_resumed = (sum(resumed) / len(resumed)) if resumed else None
+    reduction = None
+    if flops_full and flops_resumed is not None:
+        reduction = round((1.0 - flops_resumed / flops_full) * 100.0, 2)
+    out = {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "cache_embed_hit_rate",
+        "value": round((e_hits / e_total) if e_total else 0.0, 3),
+        "unit": "fraction",
+        "vs_baseline": None,
+        "embed_cache_hit_rate": round((e_hits / e_total) if e_total
+                                      else 0.0, 3),
+        "embed_positive": pos,
+        "embed_negative": neg,
+        "result_dedupe_hit_rate": round(res["hit_rate"], 3),
+        "result_dedupe_hits": res["hits"],
+        "single_flight": res["single_flight"],
+        "prefix_captured": summ["prefix"]["captured"],
+        "prefix_resumed": summ["prefix"]["resumed"],
+        "unet_flops_per_image_full": flops_full,
+        "unet_flops_per_image_resumed": flops_resumed,
+        "prefix_flops_reduction_pct": reduction,
+        "e2e_p50_s": round(_percentile(lat, 0.50), 4),
+        "e2e_p95_s": round(_percentile(lat, 0.95), 4),
+        "requests": len(lat),
+        "errors": errs,
+        "device": dev.device_kind,
+    }
+    base = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(base, "BENCH_cache.json"), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    row = _ledger_row("cache", {
+        "embed_cache_hit_rate": out["embed_cache_hit_rate"],
+        "result_dedupe_hit_rate": out["result_dedupe_hit_rate"],
+        "prefix_flops_reduction_pct": out["prefix_flops_reduction_pct"],
+        "prefix_resumed": out["prefix_resumed"],
+        "single_flight_joined": res["single_flight"].get("joined", 0),
+    }, dev.device_kind, tiny, time.time())
+    with open(os.path.join(base, "BENCH_LEDGER.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return out
+
+
 def _fleet_workload(tiny, dev):
     """The mixed-tenant open-loop arrival plan: (delay_s, tenant, class,
     payload-kwargs) per request. Interactive traffic is Poisson (seeded —
@@ -1328,6 +1482,12 @@ def main() -> None:
                     help="int8 x step-cache grid: FLOPs/image, compile "
                          "counts, PSNR/SSIM vs bf16 per cell; writes "
                          "BENCH_int8.json (CPU-safe)")
+    ap.add_argument("--cache", action="store_true",
+                    help="caching-tier microbench: redundant request mix "
+                         "through the dispatcher with SDTPU_CACHE=1 — "
+                         "per-layer hit rates, FLOPs/image delta for a "
+                         "prefix-resumed denoise, e2e p50/p95; writes "
+                         "BENCH_cache.json + a ledger row (CPU-safe)")
     ap.add_argument("--watchdog", action="store_true",
                     help="hang-watchdog/requeue structural microbench "
                          "(stub workers, no device); writes "
@@ -1378,6 +1538,8 @@ def main() -> None:
             print(json.dumps(run_fleet(tiny)))
         elif args.watchdog:
             print(json.dumps(run_watchdog(tiny)))
+        elif args.cache:
+            print(json.dumps(run_cache(tiny)))
         elif args.deepcache:
             print(json.dumps(run_deepcache(tiny)))
         elif args.int8:

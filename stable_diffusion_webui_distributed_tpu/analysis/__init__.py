@@ -21,8 +21,9 @@ from . import allowlist as allowlist_mod
 from . import cache as cache_mod
 from . import callgraph as callgraph_mod
 from . import summaries as summaries_mod
-from . import (donation, envrules, escape, fleetrules, journalrules, locks,
-               metricrules, purity, recompile, timerules)
+from . import (cacherules, donation, envrules, escape, fleetrules,
+               journalrules, locks, metricrules, purity, recompile,
+               timerules)
 from .core import RULES, Finding, ModuleInfo, walk_package
 
 __all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis",
@@ -67,6 +68,7 @@ def analyze_modules(modules: List[ModuleInfo],
     findings.extend(donation.check(modules, prog=prog))
     findings.extend(escape.check(modules, prog=prog))
     findings.extend(fleetrules.check(modules))
+    findings.extend(cacherules.check(modules))
     # rule passes may re-walk nested statements; dedupe identical findings
     seen = set()
     out = []

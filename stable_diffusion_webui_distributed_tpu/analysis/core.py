@@ -49,6 +49,8 @@ RULES = {
     "FL001": "unguarded mutable container in a lock-bearing fleet class",
     "AL001": "allowlist entry expired",
     "AL002": "allowlist entry matched no finding",
+    "CA001": "payload hashing or cache-key construction outside "
+             "cache/keys.py",
 }
 
 

@@ -1,0 +1,138 @@
+"""cache/ — the million-user caching tier.
+
+Real traffic at scale is massively redundant: negative prompts repeat
+across nearly every request, popular prompts repeat verbatim, and
+retries/variations share (prompt, model, size, seed) diverging only in
+late-step parameters. Three layers exploit that, sharing one key module
+(:mod:`cache.keys` — the only sanctioned payload-hashing site, lint rule
+CA001) and one bounded, lock-disciplined store (:mod:`cache.store`):
+
+- **embed** (:mod:`cache.embed`) — content-addressed CLIP conditioning:
+  each unique (text, clip_skip, chunks, model) encodes once per process;
+  positive/negative halves accounted separately.
+- **result** (this module) — seed-keyed full-result dedupe: a byte-exact
+  payload repeat returns the cached images + infotext at dispatcher
+  admission, never coalesced, never re-dispatched; N concurrent
+  identical requests collapse to one generation (single-flight).
+- **prefix** (:mod:`cache.prefix`) — denoise prefix sharing: requests
+  identical up to step k resume from a captured mid-denoise carry.
+
+The whole tier rides on ``SDTPU_CACHE`` (default OFF; the default path
+is byte-identical to the pre-cache build). Per-layer byte caps:
+``SDTPU_CACHE_EMBED_MB`` / ``SDTPU_CACHE_RESULT_MB`` /
+``SDTPU_CACHE_PREFIX_MB``; prefix capture depth floor:
+``SDTPU_CACHE_PREFIX_MIN_STEPS``. ``/internal/cache`` (server/api.py)
+exposes :func:`summary`; obs/perf.py folds the same numbers into
+``/internal/perf`` so FLOPs savings sit next to their attribution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from stable_diffusion_webui_distributed_tpu.cache import (
+    embed as embed_layer,
+    keys,
+    prefix as prefix_layer,
+)
+from stable_diffusion_webui_distributed_tpu.cache.store import (
+    BoundedStore,
+    Flight,
+    SingleFlight,
+)
+from stable_diffusion_webui_distributed_tpu.runtime import config
+
+enabled = keys.enabled
+
+_RESULT = BoundedStore("result", 0)
+FLIGHTS = SingleFlight()
+
+
+def _result_cap_bytes() -> int:
+    return int(config.env_float("SDTPU_CACHE_RESULT_MB", 256.0) * 1e6)
+
+
+def result_store() -> BoundedStore:
+    _RESULT.max_bytes = _result_cap_bytes()
+    return _RESULT
+
+
+def result_bytes(result: Any) -> int:
+    """Retained size of a cached GenerationResult: the base64 PNGs
+    dominate; infotexts ride along."""
+    try:
+        return (sum(len(s) for s in result.images)
+                + sum(len(s) for s in result.infotexts))
+    except Exception:
+        return 0
+
+
+def result_acquire(key: str) -> Tuple[str, Optional[Any], Optional[Flight]]:
+    """One admission-time result lookup with single-flight election.
+
+    Returns one of:
+    - ``("hit", result, None)`` — a byte-exact repeat; serve the copy.
+    - ``("joined", result, None)`` — arrived while an identical request
+      was generating; woke with the leader's published result.
+    - ``("leader", None, flight)`` — this request generates; the caller
+      MUST end the flight via :func:`result_publish` or
+      :func:`result_abandon` (the dispatcher does so in a finally).
+
+    A follower whose leader abandons (failed generation) re-elects, so a
+    crashing leader costs its followers a retry, never a deadlock.
+    """
+    while True:
+        cached = result_store().get(key)
+        if cached is not None:
+            _count("hit")
+            return "hit", cached, None
+        role, flight = FLIGHTS.acquire(key)
+        if role == "leader":
+            _count("miss")
+            return "leader", None, flight
+        flight.event.wait()
+        if flight.value is not None:
+            _count("joined")
+            return "joined", flight.value, None
+
+
+def result_publish(key: str, flight: Flight, result: Any) -> None:
+    """Leader success: cache the result, wake followers with it."""
+    result_store().put(key, result, result_bytes(result))
+    FLIGHTS.publish(key, flight, result)
+
+
+def result_abandon(key: str, flight: Flight) -> None:
+    """Leader failure: wake followers empty-handed so they re-elect."""
+    FLIGHTS.abandon(key, flight)
+
+
+def _count(outcome: str) -> None:
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        obs_prom.cache_count("result", outcome)
+    except Exception:
+        pass
+
+
+def summary() -> Dict[str, Any]:
+    """The ``/internal/cache`` body — per-layer stats, gate state."""
+    result = result_store().stats()
+    result["single_flight"] = FLIGHTS.stats()
+    return {
+        "enabled": enabled(),
+        "embed": embed_layer.summary(),
+        "result": result,
+        "prefix": prefix_layer.summary(),
+    }
+
+
+def clear_all() -> None:
+    """Full tier reset (tests, bench phase boundaries)."""
+    embed_layer.clear()
+    prefix_layer.clear()
+    _RESULT.clear()
+    FLIGHTS.clear()

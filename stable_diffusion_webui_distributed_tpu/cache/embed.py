@@ -1,0 +1,123 @@
+"""Content-addressed CLIP conditioning cache (the embed layer).
+
+Each unique (text, clip_skip, chunk-count, model fingerprint) encodes
+through the text tower ONCE PER PROCESS instead of once per request —
+the SwiftDiffusion argument (PAPERS.md, arxiv 2407.02031): the text
+tower is separable from the UNet, so its outputs are reusable artifacts,
+not per-request work. Positive and negative halves are separate entries
+with separate hit accounting because production traffic repeats negative
+prompts across nearly every request — the negative hit rate is the
+headline dedupe win and deserves its own number.
+
+Engine integration (pipeline/engine.py ``encode_prompts``): with
+``SDTPU_CACHE=1`` the per-engine cond LRU is superseded by this process-
+wide, byte-capped store; with the gate off the engine path is untouched
+byte-for-byte. Cached conditioning is the SAME device array the fresh
+encode produced, so cached-vs-fresh byte identity is structural.
+
+Per-request hit counts accumulate on the encoding thread and are drained
+by the dispatcher (``take_request_hits``) to emit the ``embed_cache_hit``
+journal event at the dispatcher tier, where the rest of the request
+lifecycle is journaled.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+from stable_diffusion_webui_distributed_tpu.cache import keys as cache_keys
+from stable_diffusion_webui_distributed_tpu.cache.store import BoundedStore
+from stable_diffusion_webui_distributed_tpu.runtime import config
+
+_STORE = BoundedStore("embed", 0)
+
+_lock = threading.Lock()
+_POS = {"hits": 0, "misses": 0}  # guarded-by: _lock
+_NEG = {"hits": 0, "misses": 0}  # guarded-by: _lock
+
+_tls = threading.local()  # per-thread (pos_hits, neg_hits) request note
+
+
+def _cap_bytes() -> int:
+    return int(config.env_float("SDTPU_CACHE_EMBED_MB", 64.0) * 1e6)
+
+
+def store() -> BoundedStore:
+    """The embed store with its byte cap refreshed from the environment
+    (tests and the bench re-knob the cap between phases)."""
+    _STORE.max_bytes = _cap_bytes()
+    return _STORE
+
+
+def _note_hit(negative: bool) -> None:
+    pos, neg = getattr(_tls, "note", (0, 0))
+    _tls.note = (pos + (0 if negative else 1), neg + (1 if negative else 0))
+
+
+def take_request_hits() -> Tuple[int, int]:
+    """Drain this thread's (positive, negative) hit counts accumulated
+    since the last drain — the dispatcher's journal feed."""
+    note = getattr(_tls, "note", (0, 0))
+    _tls.note = (0, 0)
+    return note
+
+
+def lookup_or_encode(engine: Any, text: str, clip_skip: int, chunks: int,
+                     negative: bool,
+                     encode: Callable[[], Any]) -> Any:
+    """One conditioning lookup: cached device arrays on a hit, else run
+    ``encode`` and publish its output. Accounting (layer counters,
+    prometheus, the per-thread journal note) never raises into the
+    encode path."""
+    key = cache_keys.embed_key(
+        text, clip_skip, chunks,
+        cache_keys.model_fingerprint(engine),
+        cache_keys.text_tower_fingerprint(engine))
+    s = store()
+    hit = s.get(key)
+    half = _NEG if negative else _POS
+    if hit is not None:
+        with _lock:
+            half["hits"] += 1
+        _note_hit(negative)
+        _count("hit", negative)
+        return hit
+    with _lock:
+        half["misses"] += 1
+    _count("miss", negative)
+    out = encode()
+    s.put(key, out, sum(int(getattr(a, "nbytes", 0)) for a in out))
+    return out
+
+
+def _count(outcome: str, negative: bool) -> None:
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        obs_prom.cache_count("embed_neg" if negative else "embed_pos",
+                             outcome)
+    except Exception:
+        pass
+
+
+def summary() -> Dict[str, Any]:
+    st = store().stats()
+    with _lock:
+        for label, half in (("positive", _POS), ("negative", _NEG)):
+            total = half["hits"] + half["misses"]
+            st[label] = {
+                "hits": half["hits"],
+                "misses": half["misses"],
+                "hit_rate": (half["hits"] / total) if total else 0.0,
+            }
+    return st
+
+
+def clear() -> None:
+    _STORE.clear()
+    with _lock:
+        for half in (_POS, _NEG):
+            half["hits"] = half["misses"] = 0

@@ -1,0 +1,178 @@
+"""Denoise prefix sharing (the creative layer).
+
+Two requests identical up to step k — same prompt/seed/shape/cadence/
+precision, diverging only in post-k parameters (a different CFG cutoff
+sigma, a different refiner switch point, a hires tail) — share the
+trajectory ``[0, k)`` exactly. This layer captures the sampler carry at
+a step-cache chunk boundary and lets the later request RESUME from it,
+skipping the shared prefix entirely: the paged-KV prefix-reuse idea of
+"Ragged Paged Attention" (PAPERS.md, arxiv 2604.15464) applied to the
+denoise trajectory instead of the context.
+
+Byte-identity is the contract, not an approximation, which drives every
+restriction here:
+
+- the FULL carry pytree is captured (latent + the 3-deep multistep
+  history), so LMS/PLMS/DPM++ 2M resume with the same history a
+  continuous run would hold;
+- capture happens only at boundaries the step-cache would refresh at
+  anyway (``pipeline/stepcache.prefix_boundary``), so a resumed run's
+  deep-feature cache — re-seeded invalid — refreshes at step k exactly
+  like the continuous run did;
+- capture and resume are both bounded by the CFG cutoff step, so the
+  shared prefix ran full CFG under BOTH requests;
+- the prefix key folds in the resolved cadence/precision and whether
+  the step-cache executable family was active (cache/keys.py) — resumed
+  chunks re-enter the very executables the capturing run compiled.
+
+The materialized copy is mandatory, not an optimization: the live carry
+buffers are DONATED into the next chunk dispatch, so the capture must
+``np.asarray`` them onto the host before the loop re-dispatches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from stable_diffusion_webui_distributed_tpu.cache import keys as cache_keys
+from stable_diffusion_webui_distributed_tpu.cache.store import BoundedStore
+from stable_diffusion_webui_distributed_tpu.runtime import config
+
+_STORE = BoundedStore("prefix", 0)
+
+_lock = threading.Lock()
+_resumed = 0  # guarded-by: _lock
+_captured = 0  # guarded-by: _lock
+
+_tls = threading.local()  # per-thread resume note for the journal
+
+
+def _cap_bytes() -> int:
+    return int(config.env_float("SDTPU_CACHE_PREFIX_MB", 128.0) * 1e6)
+
+
+def min_steps() -> int:
+    """Shallowest capture point: a prefix shorter than this saves too
+    little to be worth the host sync + bytes."""
+    return max(1, config.env_int("SDTPU_CACHE_PREFIX_MIN_STEPS", 4))
+
+
+def store() -> BoundedStore:
+    _STORE.max_bytes = _cap_bytes()
+    return _STORE
+
+
+class PrefixPlan:
+    """Per-range prefix state the engine threads through its chunk loop:
+    the key, an optional resume point found at entry, and whether this
+    range has captured yet (one capture per range)."""
+
+    __slots__ = ("key", "cadence", "sc_active", "cfg_stop", "end",
+                 "resume", "captured")
+
+    def __init__(self, key: str, cadence: int, sc_active: bool,
+                 cfg_stop: int, end: int) -> None:
+        self.key = key
+        self.cadence = cadence
+        self.sc_active = sc_active
+        self.cfg_stop = cfg_stop
+        self.end = end
+        self.resume: Optional[Tuple[int, Tuple]] = None  # (step, leaves)
+        self.captured = False
+
+
+def plan(engine: Any, payload: Any, *, batch: int, width: int, height: int,
+         steps: int, end: int, cadence: int, sc_active: bool,
+         precision: str, cfg_stop: int) -> Optional[PrefixPlan]:
+    """Build the range's prefix plan, resolving a resume point if a
+    usable captured prefix exists. Returns None when the range is not
+    prefix-shareable (multi-group requests: the latent batch is not the
+    whole request, so a group index would have to enter the key)."""
+    try:
+        total = int(payload.batch_size) * int(payload.n_iter)
+    except Exception:
+        return None
+    if int(batch) != total:
+        return None
+    key = cache_keys.prefix_key(
+        payload, model_fp=cache_keys.model_fingerprint(engine),
+        batch=batch, width=width, height=height, steps=steps,
+        cadence=cadence, sc_active=sc_active, precision=precision)
+    p = PrefixPlan(key, int(cadence), bool(sc_active), int(cfg_stop),
+                   int(end))
+    ent = store().get(key)
+    if ent is not None:
+        k = int(ent["step"])
+        # usable only if it actually skips work AND the shared prefix ran
+        # full CFG under this request's cutoff too
+        if 0 < k < p.end and k <= p.cfg_stop:
+            p.resume = (k, ent["leaves"])
+            global _resumed
+            with _lock:
+                _resumed += 1
+            _tls.note = {"step": k, "key": key[:16]}
+            _count("resumed")
+    return p
+
+
+def maybe_capture(p: PrefixPlan, pos: int, carry_leaves: Tuple) -> None:
+    """Capture the carry at chunk boundary ``pos`` if this is the range's
+    designated split point (``stepcache.prefix_boundary``). Never
+    overwrites a deeper capture with a shallower one — resumable depth
+    only grows."""
+    from stable_diffusion_webui_distributed_tpu.pipeline import stepcache
+
+    if p.captured or pos >= p.end:
+        return
+    if not stepcache.prefix_boundary(pos, p.cadence, p.cfg_stop,
+                                     min_steps()):
+        return
+    p.captured = True
+    prev = store().peek(p.key)
+    if prev is not None and int(prev["step"]) >= pos:
+        return
+    leaves = tuple(np.asarray(a) for a in carry_leaves)
+    nbytes = sum(int(a.nbytes) for a in leaves)
+    if store().put(p.key, {"step": int(pos), "leaves": leaves}, nbytes):
+        global _captured
+        with _lock:
+            _captured += 1
+        _count("captured")
+
+
+def take_resume_note() -> Optional[Dict[str, Any]]:
+    """Drain this thread's resume note — the dispatcher's journal feed
+    for ``prefix_resumed``."""
+    note = getattr(_tls, "note", None)
+    _tls.note = None
+    return note
+
+
+def _count(outcome: str) -> None:
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        obs_prom.cache_count("prefix", outcome)
+    except Exception:
+        pass
+
+
+def summary() -> Dict[str, Any]:
+    st = store().stats()
+    with _lock:
+        st["resumed"] = _resumed
+        st["captured"] = _captured
+    return st
+
+
+def clear() -> None:
+    global _resumed, _captured
+    _STORE.clear()
+    with _lock:
+        _resumed = 0
+        _captured = 0

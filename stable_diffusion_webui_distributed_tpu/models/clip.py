@@ -27,6 +27,23 @@ def _act(name: str):
     raise ValueError(f"unknown activation {name}")
 
 
+def tower_fingerprint(cfg: Optional[CLIPTextConfig]) -> tuple:
+    """Architecture identity of one text tower for content addressing.
+
+    The embed cache (cache/keys.py) folds this into every conditioning
+    key: two engines whose towers differ in ANY field that changes the
+    computed hidden states (depth, width, activation, skip semantics,
+    projection) must never share cached conditioning, even if their
+    model names collide. ``None`` (no second tower) fingerprints as the
+    empty tuple so SD1.x and SDXL keys can't alias.
+    """
+    if cfg is None:
+        return ()
+    return (cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size,
+            cfg.num_layers, cfg.num_heads, cfg.max_length, cfg.hidden_act,
+            cfg.projection_dim, cfg.default_skip, cfg.layernorm_skipped)
+
+
 class CLIPAttention(nn.Module):
     cfg: CLIPTextConfig
     dtype: jnp.dtype = jnp.float32

@@ -55,6 +55,10 @@ EVENTS = frozenset({
     "merged",
     "completed",
     "failed",
+    # caching tier (cache/, emitted by the dispatcher)
+    "embed_cache_hit",
+    "result_dedupe_hit",
+    "prefix_resumed",
     # scheduler tier (World/Job)
     "planned",
     "job_dispatched",

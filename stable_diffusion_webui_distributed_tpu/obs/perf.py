@@ -306,7 +306,7 @@ class PerfLedger:
             compiles = {k: dict(c) for k, c in self._compiles.items()}
             evicted, slo_evicted = self._groups_evicted, self._slo_evicted
             device_kind = self._device_kind or ""
-        return {
+        out = {
             "enabled": enabled(),
             "device_kind": device_kind,
             "peak_flops_bf16": peak_flops_for(device_kind, "bf16"),
@@ -317,6 +317,16 @@ class PerfLedger:
             "slo_evicted": slo_evicted,
             "slo_target": self.slo_target,
         }
+        try:
+            # caching tier (SDTPU_CACHE): hit/miss/bytes per layer ride
+            # along in the perf body so one scrape answers "is the cache
+            # pulling its weight"; {"enabled": False} when gated off
+            from stable_diffusion_webui_distributed_tpu import cache
+            out["cache"] = (cache.summary() if cache.enabled()
+                            else {"enabled": False})
+        except Exception:  # noqa: BLE001 — perf body stays best-effort
+            out["cache"] = {"enabled": False}
+        return out
 
     def clear(self) -> None:
         with self._lock:

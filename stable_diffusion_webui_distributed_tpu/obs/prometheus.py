@@ -238,6 +238,7 @@ def clear_histograms() -> None:
     for c in WORKER_COUNTERS.values():
         c.clear()
     WATCHDOG_COUNTER.clear()
+    CACHE_COUNTER.clear()
     with _WORKER_LOCK:
         _WORKER_LATENCY_EWMA.clear()
 
@@ -364,6 +365,23 @@ WATCHDOG_COUNTER = LabeledCounter(
     "sdtpu_watchdog_stalls_total",
     "Dispatches or remote jobs that exceeded k x their ETA "
     "(SDTPU_WATCHDOG_FACTOR).", ("name",))
+
+# -- caching tier (cache/: embed dedupe, result dedupe, prefix sharing) ------
+
+#: Cache events by layer (embed_pos/embed_neg/result/prefix) and outcome
+#: (hit/miss/joined/resumed/captured). The cache modules feed this through
+#: :func:`cache_count`; /internal/metrics and /internal/cache render it.
+CACHE_COUNTER = LabeledCounter(
+    "sdtpu_cache_events_total",
+    "Caching-tier events (SDTPU_CACHE) by layer and outcome.",
+    ("layer", "outcome"))
+
+
+def cache_count(layer: str, outcome: str, n: float = 1.0) -> None:
+    """One caching-tier event: ``layer`` names the cache (embed_pos,
+    embed_neg, result, prefix), ``outcome`` what happened there (hit,
+    miss, joined, resumed, captured)."""
+    CACHE_COUNTER.inc(n, layer=layer, outcome=outcome)
 
 _WORKER_LOCK = threading.Lock()
 #: per-worker generate-latency EWMA gauge values
@@ -656,6 +674,7 @@ def render() -> str:
     for c in WORKER_COUNTERS.values():
         lines.extend(c.render())
     lines.extend(WATCHDOG_COUNTER.render())
+    lines.extend(CACHE_COUNTER.render())
     with _WORKER_LOCK:
         worker_lat = dict(_WORKER_LATENCY_EWMA)
     _labeled_family(

@@ -215,6 +215,12 @@ class Engine:
         self._cond_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._cond_epoch = 0
         self._COND_CACHE_MAX = 64
+        # weights-identity epoch for the cache tier (cache/keys.py
+        # model_fingerprint): bumped whenever the served weights change
+        # under one model_name — LoRA merges AND VAE swaps — so every
+        # content-addressed artifact computed under the old weights
+        # retires by key, with no invalidation walk
+        self._model_epoch = 0
         # Cooperative chunk-boundary preemption (fleet/policy.py): when a
         # preemptible job runs, the fleet gate installs an object with
         # should_yield()/yield_device() here; the denoise loop polls it
@@ -921,6 +927,7 @@ class Engine:
         # TE weights changed: conds computed under the old merge are stale
         self._cond_epoch += 1
         self._cond_cache.clear()
+        self._model_epoch += 1
 
     def _apply_prompt_loras(self, payload: GenerationPayload) -> None:
         """Activate adapters named in the prompt. The payload keeps its tags
@@ -953,6 +960,7 @@ class Engine:
         self._base_params = {**self._base_params, "vae": target}
         self.params = {**self.params, "vae": target}
         self._blank_cond_cache.clear()  # conditioning latents are VAE-derived
+        self._model_epoch += 1  # decoded bytes change: retire cached results
 
     # -- ControlNet ---------------------------------------------------------
 
@@ -1089,18 +1097,39 @@ class Engine:
         store_gen = (self.embedding_store.generation
                      if self.embedding_store is not None else 0)
 
-        def cached_enc(raw, ids_c, w_c, inj_c):
+        # cache tier (cache/embed.py): with SDTPU_CACHE=1 the process-wide
+        # content-addressed store supersedes the per-engine LRU below —
+        # same texts, byte-capped, with per-half hit accounting. Gate off
+        # (default): embed_cache stays None and the path is untouched.
+        embed_cache = None
+        from stable_diffusion_webui_distributed_tpu.cache import (
+            keys as cache_keys,
+        )
+
+        if cache_keys.enabled():
+            from stable_diffusion_webui_distributed_tpu.cache import (
+                embed as embed_cache,
+            )
+
+        def encode_fresh(ids_c, w_c, inj_c):
+            pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
+            return enc(te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
+                       *inj_arrays(inj_c))
+
+        def cached_enc(raw, ids_c, w_c, inj_c, negative=False):
             # cross-request cache (webui's cached_c/uc): same text at the
             # same clip_skip/chunk-count under the same TE weights and
             # embedding files encodes to the same conditioning
+            if embed_cache is not None:
+                return embed_cache.lookup_or_encode(
+                    self, raw, skip, n, negative,
+                    lambda: encode_fresh(ids_c, w_c, inj_c))
             key = (raw, skip, n, self._cond_epoch, store_gen)
             hit = self._cond_cache.get(key)
             if hit is not None:
                 self._cond_cache.move_to_end(key)
                 return hit
-            pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
-            out = enc(te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
-                      *inj_arrays(inj_c))
+            out = encode_fresh(ids_c, w_c, inj_c)
             self._cond_cache[key] = out
             if len(self._cond_cache) > self._COND_CACHE_MAX:
                 self._cond_cache.popitem(last=False)
@@ -1116,7 +1145,7 @@ class Engine:
             pooled_c = pooleds[0] if len(pooleds) == 1 \
                 else jnp.concatenate(pooleds, 0)
             ctx_u, pooled_u = cached_enc(payload.negative_prompt,
-                                         ids_u, w_u, inj_u)
+                                         ids_u, w_u, inj_u, negative=True)
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _embedding_counts(self):
@@ -1414,9 +1443,53 @@ class Engine:
             valid = jnp.asarray(False)
         dispatched = []  # (start, length, cached) — FLOPs accounting
 
+        # Denoise prefix sharing (cache/prefix.py, SDTPU_CACHE): only for
+        # ranges where a captured prefix can be BYTE-identical — the plain
+        # txt2img base range with nothing that injects per-step state the
+        # capture can't carry (masks, inpaint conditioning, ControlNet
+        # windows) and nothing already consumed (start_step 0). The
+        # non-sync path never paces on fences, so a capture's host
+        # materialization has no safe point there.
+        prefix_plan = None
+        if (job == "txt2img" and sync and start_step == 0 and not masked
+                and not inpainting and not controls and end > 0):
+            from stable_diffusion_webui_distributed_tpu.cache import (
+                keys as cache_keys,
+            )
+
+            if cache_keys.enabled():
+                from stable_diffusion_webui_distributed_tpu.cache import (
+                    prefix as cache_prefix,
+                )
+
+                prefix_plan = cache_prefix.plan(
+                    self, payload, batch=batch, width=width, height=height,
+                    steps=steps, end=end,
+                    cadence=(sc.cadence if use_cache else 1),
+                    sc_active=use_cache, precision=prec.name,
+                    cfg_stop=cfg_stop)
+
         self.state.begin(job, end - start_step)
         done = 0
         pos = start_step
+        if prefix_plan is not None and prefix_plan.resume is not None:
+            # resume mid-trajectory: the captured carry (latent + full
+            # multistep history) re-placed on the mesh replaces the fresh
+            # init_carry; the loop re-enters the same chunk executables a
+            # continuous run would use at this boundary. The deep-feature
+            # cache stays invalid — prefix_boundary only blessed split
+            # points where the continuous run refreshes anyway.
+            k, leaves = prefix_plan.resume
+            carry = kd.Carry(
+                self._place_batch(jnp.asarray(leaves[0])),
+                self._place_batch(jnp.asarray(leaves[1])),
+                jnp.asarray(leaves[2]),
+                self._place_batch(jnp.asarray(leaves[3])),
+                self._place_batch(jnp.asarray(leaves[4])),
+                jnp.asarray(leaves[5]))
+            pos = k
+            done = k
+            self.state.step(done)
         # Depth-1 pipelining: dispatch chunk i while chunk i-1 still runs
         # on-device, so the host->device roundtrip (expensive through a
         # chip relay) overlaps compute. Interrupt latency stays <= 2
@@ -1499,6 +1572,13 @@ class Engine:
             dispatched.append((pos, length, cached_chunk))
             pending = (fence, length)
             pos += length
+            if prefix_plan is not None and not prefix_plan.captured:
+                # capture at the designated chunk boundary: np.asarray
+                # materializes host copies of the carry NOW — the next
+                # dispatch donates these buffers, after which they are
+                # gone. The implied device sync is the price of the
+                # gated-on path only.
+                cache_prefix.maybe_capture(prefix_plan, pos, tuple(carry))
         if sync and pending is not None:
             pending[0].block_until_ready()
             done += pending[1]

@@ -302,6 +302,21 @@ def fix_seed(seed: Optional[int]) -> int:
     return int(seed) % 2**32
 
 
+def canonical_dump(payload: "GenerationPayload") -> Dict[str, Any]:
+    """The payload as a fingerprint-stable dict (cache/keys.py hashes it).
+
+    Two requests that generate the same bytes must canonicalize to the
+    same dict regardless of how they were spelled: the pydantic dump
+    materializes every declared field (so omitted defaults equal
+    spelled-out ones) in declaration order (so construction order never
+    matters), and ``extra="allow"`` passthrough fields ride along — an
+    unknown field MIGHT change behavior downstream, so it must change
+    the fingerprint. Callers hash this only AFTER ``fix_seed`` and
+    ``apply_scripts``, when the payload describes the exact work.
+    """
+    return payload.model_dump()
+
+
 # --------------------------------------------------------------------------
 # image <-> base64 PNG (wire format parity with the reference)
 # --------------------------------------------------------------------------

@@ -114,6 +114,31 @@ def cutoff_step(sigmas: Sequence[float], cutoff_sigma: float) -> int:
     return min(max(n - j + 1, 0), n)
 
 
+def prefix_boundary(pos: int, cadence: int, cfg_stop: int,
+                    min_steps: int) -> bool:
+    """Is chunk boundary ``pos`` a legal denoise-prefix split point
+    (cache/prefix.py)?
+
+    Three byte-identity constraints, all derived from how the chunk loop
+    stitches state across dispatches:
+
+    - ``pos >= min_steps`` — a capture shallower than the configured
+      floor saves too little to pay its host sync;
+    - ``pos % cadence == 0`` — a resumed range re-enters with an INVALID
+      deep-feature cache, so its first step refreshes; a continuous run
+      refreshes at ``pos`` only when the cadence lands there. Off-cadence
+      splits would make the resumed tail diverge from the continuous one.
+    - ``pos <= cfg_stop`` — the shared prefix must have run full CFG:
+      past the cutoff the trajectory already depends on the divergent
+      truncation parameter the prefix key deliberately excludes.
+    """
+    if pos < max(1, int(min_steps)):
+        return False
+    if int(cadence) > 1 and pos % int(cadence) != 0:
+        return False
+    return pos <= int(cfg_stop)
+
+
 # -- host mirror of the in-graph schedule (FLOPs accounting) ---------------
 
 

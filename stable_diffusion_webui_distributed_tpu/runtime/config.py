@@ -168,6 +168,27 @@ def _logger():
 #   any ordering the static model missed fails the run. Off by default:
 #   nothing is patched and the lock path is byte-identical to stock
 #   threading. Test harness only — never set in production serving.
+# - ``SDTPU_CACHE`` (flag, default off): million-user caching tier
+#   (cache/). When 1, three layers arm over one bounded LRU store:
+#   content-addressed embedding dedupe over the CLIP text tower
+#   (keyed on prompt text + clip_skip + model/tower fingerprint),
+#   seed-keyed result dedupe with single-flight leader election
+#   (byte-exact payload repeats return cached images before bucketing,
+#   never consuming a dispatch slot or feeding queue-wait/ETA
+#   accounting), and denoise prefix sharing (requests identical up to
+#   step k resume from a mid-denoise carry captured at a step-cache
+#   chunk boundary). Off: nothing is cached and every path is
+#   byte-identical to the ungated build.
+# - ``SDTPU_CACHE_EMBED_MB`` (float MB, default 64): embed-cache byte
+#   cap. Oldest conditioning entries evict LRU past it.
+# - ``SDTPU_CACHE_RESULT_MB`` (float MB, default 256): result-dedupe
+#   byte cap over cached images + infotexts.
+# - ``SDTPU_CACHE_PREFIX_MB`` (float MB, default 128): prefix-latent
+#   byte cap; each entry holds one full sampler carry (latents +
+#   multistep history).
+# - ``SDTPU_CACHE_PREFIX_MIN_STEPS`` (int, default 4): shallowest
+#   denoise step a prefix may be captured or resumed at — captures
+#   shallower than this are noise-dominated and not worth the bytes.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -587,6 +587,17 @@ class ApiServer:
 
         return perf.LEDGER.summary()
 
+    def handle_cache(self) -> Dict[str, Any]:
+        """Caching-tier summary (cache/): per-layer entries/bytes/hit
+        rates for the embed, result-dedupe and prefix caches plus
+        single-flight counters. ``{"enabled": False}`` until
+        SDTPU_CACHE=1."""
+        from stable_diffusion_webui_distributed_tpu import cache
+
+        if not cache.enabled():
+            return {"enabled": False}
+        return cache.summary()
+
     def handle_executables(self) -> Dict[str, Any]:
         """Live compiled-executable census against the serving budget of
         <=2 step-cache x <=3 precision variants per shape bucket; the
@@ -841,6 +852,7 @@ class ApiServer:
             ("GET", "/internal/metrics"): self.handle_metrics,
             ("GET", "/internal/flightrec"): self.handle_flightrec,
             ("GET", "/internal/perf"): self.handle_perf,
+            ("GET", "/internal/cache"): self.handle_cache,
             ("GET", "/internal/executables"): self.handle_executables,
             ("GET", "/internal/autoscale"): self.handle_autoscale,
             ("GET", "/internal/profile"): self.handle_profile_get,

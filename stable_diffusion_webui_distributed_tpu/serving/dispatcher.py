@@ -197,26 +197,55 @@ class ServingDispatcher:
                     if degraded:
                         obs_journal.emit("degraded", rid,
                                          detail=str(degraded))
-            bypass = bool(payload.init_images or payload.enable_hr)
-            if bypass:
-                run, bucketed = payload.model_copy(), False
-                METRICS.record_request(False, bypassed=True)
-            else:
-                run, bucketed = self.bucketer.bucket_payload(payload)
-                METRICS.record_request(
-                    bucketed,
-                    padding_ratio=self.bucketer.padding_ratio(
-                        payload.width, payload.height))
-            if jr_on:
-                obs_journal.emit("bucketed", rid, bucketed=bucketed,
-                                 bypassed=bypass,
-                                 bucket=f"{run.width}x{run.height}")
+            # Result dedupe (cache/, SDTPU_CACHE): a byte-exact payload
+            # repeat is served from the cache HERE — before bucketing, so
+            # a hit never consumes a dispatch slot, feeds the queue-wait
+            # histogram, or skews the ETA calibration (the same accounting
+            # class the cancelled-ticket fix keeps clean). N concurrent
+            # identical requests elect one generating leader; the rest
+            # block on its flight and return copies of its result.
+            cache_mod = ckey = flight = None
+            from stable_diffusion_webui_distributed_tpu import (
+                cache as _cache_pkg,
+            )
 
-            ticket = Ticket(payload, run, job, bucketed, rid)
-            ticket.fleet_class = fleet_class
-            with self._lock:
-                self._tickets[rid] = ticket
+            if _cache_pkg.enabled():
+                cache_mod = _cache_pkg
+                ckey = _cache_pkg.keys.result_key(
+                    payload, _cache_pkg.keys.model_fingerprint(self.engine),
+                    job)
+                role, cached, flight = cache_mod.result_acquire(ckey)
+                if cached is not None:
+                    if jr_on:
+                        obs_journal.emit("result_dedupe_hit", rid,
+                                         mode=role, key=ckey[:16])
+                        obs_journal.emit(
+                            "completed", rid, images=len(cached.images),
+                            seeds=list(cached.seeds),
+                            infotexts=list(cached.infotexts))
+                    return cached.model_copy(deep=True)
+
+            ticket = None
             try:
+                bypass = bool(payload.init_images or payload.enable_hr)
+                if bypass:
+                    run, bucketed = payload.model_copy(), False
+                    METRICS.record_request(False, bypassed=True)
+                else:
+                    run, bucketed = self.bucketer.bucket_payload(payload)
+                    METRICS.record_request(
+                        bucketed,
+                        padding_ratio=self.bucketer.padding_ratio(
+                            payload.width, payload.height))
+                if jr_on:
+                    obs_journal.emit("bucketed", rid, bucketed=bucketed,
+                                     bypassed=bypass,
+                                     bucket=f"{run.width}x{run.height}")
+
+                ticket = Ticket(payload, run, job, bucketed, rid)
+                ticket.fleet_class = fleet_class
+                with self._lock:
+                    self._tickets[rid] = ticket
                 if self._coalescable(run):
                     self._run_grouped(ticket)
                 else:
@@ -228,6 +257,12 @@ class ServingDispatcher:
                             error=f"{type(ticket.error).__name__}: "
                                   f"{ticket.error}")
                     raise ticket.error
+                if flight is not None and self._cacheable(ticket):
+                    # the cache keeps its own deep copy: the one being
+                    # returned belongs to the caller, who may mutate it
+                    cache_mod.result_publish(
+                        ckey, flight, ticket.result.model_copy(deep=True))
+                    flight = None
                 if jr_on:
                     r = ticket.result
                     # journaled outcome for the replay byte-compare
@@ -238,8 +273,23 @@ class ServingDispatcher:
                         infotexts=list(r.infotexts) if r else [])
                 return ticket.result
             finally:
-                with self._lock:
-                    self._tickets.pop(rid, None)
+                if flight is not None:
+                    # leader left without publishing (failure, cancel,
+                    # partial output): wake followers empty-handed so
+                    # they re-elect rather than block forever
+                    cache_mod.result_abandon(ckey, flight)
+                if ticket is not None:
+                    with self._lock:
+                        self._tickets.pop(rid, None)
+
+    @staticmethod
+    def _cacheable(ticket: Ticket) -> bool:
+        """Only a COMPLETE result may enter the dedupe cache: a cancelled
+        or interrupted run returns fewer images than the payload asked
+        for, and serving that to a byte-exact repeat would be wrong."""
+        r = ticket.result
+        return (r is not None and not ticket.cancelled.is_set()
+                and len(r.images) == ticket.payload.total_images)
 
     def cancel(self, request_id: str) -> bool:
         """Cancel ONE queued/running request; its images are dropped at
@@ -568,6 +618,34 @@ class ServingDispatcher:
         except Exception:  # noqa: BLE001 — observability stays best-effort
             pass
 
+    def _drain_cache_notes(self, rid: str, *, embed: bool = True,
+                           prefix: bool = True) -> None:
+        """Journal cache-layer activity at the dispatcher tier.
+
+        The engine records embed-cache hits and prefix resumes in
+        thread-local notes on the generating thread; this drains them on
+        that same thread — always, so a note can never leak into the
+        next request served by it — and emits journal events only when
+        journaling is on. Best-effort: a finished request never fails on
+        observability.
+        """
+        try:
+            from stable_diffusion_webui_distributed_tpu import cache
+            if not cache.enabled():
+                return
+            jr_on = obs_journal.enabled()
+            if embed:
+                pos_hits, neg_hits = cache.embed_layer.take_request_hits()
+                if jr_on and (pos_hits or neg_hits):
+                    obs_journal.emit("embed_cache_hit", rid,
+                                     positive=pos_hits, negative=neg_hits)
+            if prefix:
+                note = cache.prefix_layer.take_resume_note()
+                if jr_on and note:
+                    obs_journal.emit("prefix_resumed", rid, **note)
+        except Exception:  # noqa: BLE001 — observability stays best-effort
+            pass
+
     def _run_solo(self, ticket: Ticket) -> None:
         with self._device([ticket], ticket.run.total_images):
             try:
@@ -632,6 +710,7 @@ class ServingDispatcher:
             except BaseException as e:  # noqa: BLE001
                 ticket.error = e
             finally:
+                self._drain_cache_notes(ticket.request_id)
                 self._record_slo(ticket)
                 ticket.done.set()
 
@@ -688,6 +767,7 @@ class ServingDispatcher:
                 pin_index=p.same_seed))
             key_parts.append(engine._image_keys(p, 0, n_p))
             (cu, cc), (pu, pc) = engine.encode_prompts(p)
+            self._drain_cache_notes(t.request_id, prefix=False)
             ctx_rows.append(jnp.broadcast_to(cc, (n_p,) + cc.shape[1:]))
             pooled_rows.append(jnp.broadcast_to(pc, (n_p,) + pc.shape[1:]))
             if ctx_u is None:
@@ -724,6 +804,7 @@ class ServingDispatcher:
         latents = engine._denoise_range(
             rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
             width, height, 0, rp.steps, "txt2img", None, None, ())
+        self._drain_cache_notes(live[0].request_id, embed=False)
         if perf_on:
             obs_perf.LEDGER.record_dispatch(
                 bucket=f"{width}x{height}", cadence=int(g.key[8]),

@@ -1,0 +1,53 @@
+"""CA001 fixture: payload hashing / key construction outside cache/keys.py.
+
+Loaded by tests/test_lint.py under a serving/ path (outside the
+sanctioned cache/keys.py and obs/journal.py modules), so every payload
+digest and hand-built cache-key tuple below is flagged unless
+marker-exempt.
+"""
+
+import hashlib
+import json
+
+def result_key(payload):
+    # BAD (line 14): payload dump hashed directly — a forked key mint
+    return hashlib.sha256(
+        json.dumps(payload.model_dump()).encode()).hexdigest()
+
+
+def embed_key(req):
+    # BAD (line 20): prompt attribute digested outside the key module
+    return hashlib.md5(req.prompt.encode()).hexdigest()
+
+
+def lookup(cache, payload):
+    # BAD (line 25): hand-built payload key tuple fed to a cache store
+    return cache.get((payload.prompt, payload.seed))
+
+
+def publish(result_store, payload, value):
+    # BAD (line 30): same shape on the put side
+    result_store.put((payload.negative_prompt, payload.steps), value)
+
+
+def canonical(payload):
+    # OK: keys minted through the sanctioned module
+    from stable_diffusion_webui_distributed_tpu.cache import keys
+
+    return keys.result_key(payload, (), "txt2img")
+
+
+def etag(payload):
+    # OK: deliberate non-key digest, marker-exempt
+    return hashlib.sha256(payload.prompt.encode())  # sdtpu-lint: cachekey
+
+
+def file_hash(path):
+    # OK: hashing non-payload bytes is not key minting
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def plain_dict(d, key):
+    # OK: tuple key into a non-cache receiver
+    return d.get((key, 0))

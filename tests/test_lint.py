@@ -71,7 +71,7 @@ class TestRepoGate:
         for rule in ("TP001", "TP002", "TP003", "TP004", "RC001", "RC002",
                      "RC003", "EV001", "OB001", "OB002", "OB003", "LK001",
                      "LK002", "LK003", "LK004", "DN001", "FL001", "AL001",
-                     "AL002"):
+                     "AL002", "CA001"):
             assert rule in RULES and RULES[rule]
 
 
@@ -212,6 +212,30 @@ class TestFixtures:
         # the bad literals still fire; "completed"-class names would not
         assert {f for f in found if f[0] == "OB003"} == {
             ("OB003", 12), ("OB003", 17), ("OB003", 19)}
+
+    def test_cache_family(self):
+        # CA001: payload hashing and hand-built cache keys outside
+        # cache/keys.py. The fixture analyzes under a serving/ path —
+        # outside the sanctioned modules — so both offense shapes fire.
+        rel = "stable_diffusion_webui_distributed_tpu/serving/cache_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "cache_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert found == {
+            ("CA001", 14),  # payload.model_dump() sha256'd directly
+            ("CA001", 20),  # .prompt digested outside the key module
+            ("CA001", 25),  # hand-built key tuple into a cache .get
+            ("CA001", 30),  # same shape on the .put side
+        }
+        # the keys.result_key call, the marker-exempt digest, file
+        # hashing, and tuple keys into non-cache receivers stay clean
+
+    def test_cache_rule_exempts_key_module(self):
+        # the same offenses under the sanctioned cache/keys.py path are
+        # the key mint itself: zero CA001 findings
+        rel = "stable_diffusion_webui_distributed_tpu/cache/keys.py"
+        mod = load_module(os.path.join(FIXTURES, "cache_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert not {f for f in found if f[0] == "CA001"}
 
     def test_donation_family(self):
         found = _rule_lines(_fixture_findings("donate_bad.py"))

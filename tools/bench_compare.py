@@ -53,6 +53,13 @@ THRESHOLDS = {
     # without fixing or allowlisting it (wall time is trajectory-only —
     # machine-dependent, never gated)
     "lint_finding_count": ("up", "abs", 0.0),
+    # caching-tier rows (bench.py run_cache): the redundant mix is fixed,
+    # so hit rates and the prefix FLOP cut are structural — meaningful
+    # movement means a key family broke (over-keying kills dedupe) or the
+    # resume point moved
+    "embed_cache_hit_rate": ("down", "abs", 0.05),
+    "result_dedupe_hit_rate": ("down", "abs", 0.05),
+    "prefix_flops_reduction_pct": ("down", "abs", 5.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back
@@ -60,6 +67,8 @@ THRESHOLDS = {
 _VALUE_ALIASES = {
     "serving_coalesce_factor": "coalesce_factor",
     "tiny_serving_coalesce_factor": "coalesce_factor",
+    "cache_embed_hit_rate": "embed_cache_hit_rate",
+    "tiny_cache_embed_hit_rate": "embed_cache_hit_rate",
 }
 
 
