@@ -941,6 +941,137 @@ def run_serving(tiny):
     }
 
 
+def run_ragged(tiny):
+    """--ragged: ragged-dispatch microbench (SDTPU_RAGGED). Three phases
+    over one mixed-HEIGHT workload (8 requests, 4 heights, one width):
+
+    - fine_ladder: classic dispatch, one ladder entry per height — zero
+      padding bought with one chunk compile PER height;
+    - coarse_classic: classic dispatch, one coarse bucket — one compile,
+      every short request pays the full ladder-padding tax;
+    - ragged: the same coarse bucket under SDTPU_RAGGED — one compile AND
+      ~no compute padding (true row counts ride as traced data, the
+      attention kernel masks the tail).
+
+    Counts and ratios, not wall-clock — meaningful on CPU. Writes
+    BENCH_ragged.json and appends a "ragged" row to BENCH_LEDGER.jsonl
+    (tools/bench_compare.py gates avg_padding_ratio, token_padding_ratio,
+    chunk_compiles and the census alarm)."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.obs import perf as obs_perf
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    if tiny or dev.platform == "cpu":
+        bucket_w, heights, steps = 64, [64, 48, 32, 16], 4
+        family = C.TINY
+    else:
+        bucket_w, heights, steps = 512, [512, 384, 256, 128], 20
+        family = C.SD15
+    fine = [(bucket_w, hh) for hh in heights]
+    coarse = [(bucket_w, max(heights))]
+
+    def phase(ladder, ragged):
+        engine = _make_engine(family)
+        bucketer = ShapeBucketer(shapes=ladder, batches=[1])
+        dispatcher = ServingDispatcher(engine, bucketer=bucketer,
+                                       window=0.0)
+        METRICS.clear()
+        obs_perf.LEDGER.clear()
+        errs = []
+        with _EnvPatch(SDTPU_PERF="1",
+                       SDTPU_RAGGED="1" if ragged else None):
+            for i in range(8):
+                hh = heights[i % len(heights)]
+                p = GenerationPayload(
+                    prompt="bench ragged cow " + "moo " * (i % 4),
+                    steps=steps, width=bucket_w, height=hh, seed=300 + i,
+                    sampler_name="Euler a")
+                try:
+                    dispatcher.submit(p)
+                except Exception as e:  # noqa: BLE001 — in the JSON line
+                    errs.append(repr(e))
+            census = obs_perf.executables_census(engine)
+        s = METRICS.summary()
+        groups = obs_perf.LEDGER.summary()["groups"]
+        tok = [g["token_padding_ratio"] for g in groups
+               if g.get("token_padding_ratio")]
+        return {
+            "chunk_compiles": s["compiles"].get("chunk", 0),
+            "avg_padding_ratio": round(s["avg_padding_ratio"] or 1.0, 4),
+            "unet_flops_per_image": s["unet_flops_per_image"],
+            "dispatches": s["dispatches"],
+            "token_padding_ratio": round(sum(tok) / len(tok), 4)
+            if tok else None,
+            "census_alarm": bool(census["alarm"]),
+            "errors": errs,
+        }
+
+    t0 = time.time()
+    fine_classic = phase(fine, ragged=False)
+    coarse_classic = phase(coarse, ragged=False)
+    ragged = phase(coarse, ragged=True)
+    wall = time.time() - t0
+    if ragged["errors"] or fine_classic["errors"] \
+            or coarse_classic["errors"]:
+        _dump_flightrec("ragged")
+    out = {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "ragged_padding_ratio",
+        "value": ragged["avg_padding_ratio"],
+        "unit": "padded_px/true_px",
+        "vs_baseline": coarse_classic["avg_padding_ratio"],
+        "chunk_compiles": ragged["chunk_compiles"],
+        "chunk_compiles_fine_ladder": fine_classic["chunk_compiles"],
+        "chunk_compiles_coarse_classic": coarse_classic["chunk_compiles"],
+        "avg_padding_ratio": ragged["avg_padding_ratio"],
+        "classic_coarse_padding_ratio":
+            coarse_classic["avg_padding_ratio"],
+        "token_padding_ratio": ragged["token_padding_ratio"],
+        "census_alarm": int(ragged["census_alarm"]),
+        "unet_flops_per_image": ragged["unet_flops_per_image"],
+        "phases": {"fine_ladder": fine_classic,
+                   "coarse_classic": coarse_classic, "ragged": ragged},
+        "requests": 8,
+        "bucket": f"{bucket_w}x{max(heights)}",
+        "heights": heights,
+        "wall_s": round(wall, 2),
+        "device": dev.device_kind,
+        "errors": (fine_classic["errors"] + coarse_classic["errors"]
+                   + ragged["errors"]),
+    }
+    base = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(base, "BENCH_ragged.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    row = _ledger_row("ragged", {
+        "chunk_compiles": ragged["chunk_compiles"],
+        "chunk_compiles_fine_ladder": fine_classic["chunk_compiles"],
+        "avg_padding_ratio": ragged["avg_padding_ratio"],
+        "classic_coarse_padding_ratio":
+            coarse_classic["avg_padding_ratio"],
+        "token_padding_ratio": ragged["token_padding_ratio"],
+        "census_alarm": int(ragged["census_alarm"]),
+        "unet_flops_per_image": ragged["unet_flops_per_image"],
+    }, dev.device_kind, tiny, time.time())
+    with open(os.path.join(base, "BENCH_LEDGER.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return out
+
+
 def _percentile(samples, q):
     """Nearest-rank percentile over a list of seconds (0.0 when empty)."""
     if not samples:
@@ -1488,6 +1619,12 @@ def main() -> None:
                          "per-layer hit rates, FLOPs/image delta for a "
                          "prefix-resumed denoise, e2e p50/p95; writes "
                          "BENCH_cache.json + a ledger row (CPU-safe)")
+    ap.add_argument("--ragged", action="store_true",
+                    help="ragged-dispatch microbench: mixed-height "
+                         "workload under a fine ladder, a coarse classic "
+                         "bucket, and SDTPU_RAGGED — compile counts + "
+                         "padding ratios; writes BENCH_ragged.json + a "
+                         "ledger row (CPU-safe)")
     ap.add_argument("--watchdog", action="store_true",
                     help="hang-watchdog/requeue structural microbench "
                          "(stub workers, no device); writes "
@@ -1540,6 +1677,8 @@ def main() -> None:
             print(json.dumps(run_watchdog(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
+        elif args.ragged:
+            print(json.dumps(run_ragged(tiny)))
         elif args.deepcache:
             print(json.dumps(run_deepcache(tiny)))
         elif args.int8:

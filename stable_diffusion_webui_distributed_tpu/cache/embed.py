@@ -69,7 +69,16 @@ def lookup_or_encode(engine: Any, text: str, clip_skip: int, chunks: int,
     """One conditioning lookup: cached device arrays on a hit, else run
     ``encode`` and publish its output. Accounting (layer counters,
     prometheus, the per-thread journal note) never raises into the
-    encode path."""
+    encode path.
+
+    ``chunks`` is the 77-token chunk count the entry was encoded at. The
+    classic path passes the request max (cond and uncond padded to agree);
+    the ragged-conditioning path (SDTPU_RAGGED) passes the prompt's TRUE
+    chunk count and pads the *encoded* rows afterwards — so one cache entry
+    serves the same prompt in any group composition instead of one entry
+    per group-max it ever appeared under. The keyspaces coincide safely:
+    encoding a prompt at its true count is byte-identical to the classic
+    encode whose max happens to equal it."""
     key = cache_keys.embed_key(
         text, clip_skip, chunks,
         cache_keys.model_fingerprint(engine),

@@ -158,3 +158,22 @@ class CLIPTextModel(nn.Module):
             pooled = nn.Dense(c.projection_dim, use_bias=False,
                               dtype=self.dtype, name="text_projection")(pooled)
         return context.astype(self.dtype), pooled.astype(self.dtype)
+
+
+def pad_encoded_context(ctx: jax.Array, n_chunks: int,
+                        tokens_per_chunk: int = 77) -> jax.Array:
+    """Zero-pad an encoded ``(B, L, D)`` context along the sequence axis to
+    ``n_chunks * tokens_per_chunk`` rows.
+
+    Ragged conditioning encodes every prompt at its TRUE chunk count (so the
+    embed cache key no longer depends on whatever the longest prompt in the
+    group happened to be) and pads the *encoded* rows up to the group's
+    context length afterwards. The padded rows are excluded from
+    cross-attention by the per-row ``ctx_true`` mask, so their value never
+    matters — zeros keep them inert in any unmasked consumer.
+    """
+    want = n_chunks * tokens_per_chunk
+    have = ctx.shape[1]
+    if have >= want:
+        return ctx
+    return jnp.pad(ctx, ((0, 0), (0, want - have), (0, 0)))

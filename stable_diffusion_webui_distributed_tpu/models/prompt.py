@@ -190,6 +190,22 @@ def tokenize_with_embeddings(
     return ids, weights, injections
 
 
+def true_token_count(ids: np.ndarray, eos: int) -> int:
+    """Meaningful tokens in a tokenized (n_chunks, 77) prompt: BOS + content
+    + the closing EOS per chunk; the trailing EOS fill is padding. This is
+    the numerator of the ``token_padding_ratio`` gauge (denominator: the
+    request's padded ``n_chunks * 77``) and the true-cost unit the ragged
+    conditioning path stops paying for.
+    """
+    total = 0
+    for row in ids:
+        tail = row[1:]          # skip BOS (BOS == EOS id is never emitted)
+        eos_at = np.flatnonzero(tail == eos)
+        content = int(eos_at[0]) if eos_at.size else CHUNK_CONTENT
+        total += 2 + content    # BOS + content + closing EOS
+    return total
+
+
 def pad_chunks(a: np.ndarray, wa: np.ndarray, n: int, eos: int,
                bos: int) -> Tuple[np.ndarray, np.ndarray]:
     """Grow (chunks, 77) ids/weights to ``n`` chunks with empty windows —

@@ -86,10 +86,17 @@ class Attention(nn.Module):
     """Self- or cross-attention over flattened spatial tokens.
 
     ``impl``: "xla" (compiler-fused), "flash" (Pallas online-softmax kernel
-    for the latent self-attention hot spot), or "ring" (sequence-parallel
+    for the latent self-attention hot spot), "ring" (sequence-parallel
     over the mesh's ``sp`` axis for token counts beyond one chip — requires
-    ``mesh``). Cross-attention's 77-token context always takes the XLA path,
-    as does any shape the chosen impl can't tile.
+    ``mesh``), or "ragged" (per-row true-length masked kernel,
+    ops/ragged_attention.py). Cross-attention's 77-token context always
+    takes the XLA path, as does any shape the chosen impl can't tile.
+
+    ``true_len`` (traced (B,) int32, optional) forces the ragged path
+    regardless of ``impl``: for self-attention the row's valid spatial
+    prefix, for cross-attention the row's valid context prefix — the
+    ragged-dispatch contract where heterogeneous rows share one
+    bucket-shaped executable.
     """
 
     num_heads: int
@@ -99,7 +106,8 @@ class Attention(nn.Module):
     quant_linears: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None,
+                 true_len: Optional[jax.Array] = None) -> jax.Array:
         B, T, C = x.shape
         head_dim = C // self.num_heads
         qz = self.quant_linears
@@ -122,7 +130,25 @@ class Attention(nn.Module):
               if (self.impl == "ring" and self.mesh is not None) else 1)
         dp_ok = (self.mesh is None
                  or B % max(1, self.mesh.shape.get("dp", 1)) == 0)
-        if self.impl == "ring" and context is None and sp > 1 \
+        if context is None and (true_len is not None
+                                or self.impl == "ragged"):
+            from stable_diffusion_webui_distributed_tpu.ops.ragged_attention import (
+                ragged_attention,
+            )
+
+            tl = (true_len if true_len is not None
+                  else jnp.full((B,), T, jnp.int32))
+            out = ragged_attention(q, k, v, tl, scale=1.0 / head_dim**0.5)
+        elif context is not None and true_len is not None:
+            # ragged cross-attention: mask padded context rows; the 77·n
+            # token context is small, so the dense masked form suffices
+            from stable_diffusion_webui_distributed_tpu.ops.ragged_attention import (
+                ragged_attention_reference,
+            )
+
+            out = ragged_attention_reference(q, k, v, true_len,
+                                             scale=1.0 / head_dim**0.5)
+        elif self.impl == "ring" and context is None and sp > 1 \
                 and T % sp == 0 and dp_ok:
             from stable_diffusion_webui_distributed_tpu.ops.ring_attention import (
                 ring_attention,
@@ -167,17 +193,21 @@ class TransformerBlock(nn.Module):
     quant_linears: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, context: jax.Array,
+                 true_len: Optional[jax.Array] = None,
+                 ctx_true: Optional[jax.Array] = None) -> jax.Array:
         C = x.shape[-1]
         qz = self.quant_linears
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           impl=self.attention_impl, mesh=self.mesh,
                           quant_linears=qz, name="attn1")(
-            nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+            nn.LayerNorm(dtype=jnp.float32, name="ln1")(x),
+            true_len=true_len,
         )
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           quant_linears=qz, name="attn2")(
-            nn.LayerNorm(dtype=jnp.float32, name="ln2")(x), context
+            nn.LayerNorm(dtype=jnp.float32, name="ln2")(x), context,
+            true_len=ctx_true,
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln3")(x)
         h = GEGLU(4 * C, dtype=self.dtype, quant_linears=qz,
@@ -198,9 +228,15 @@ class SpatialTransformer(nn.Module):
     quant_linears: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, context: jax.Array,
+                 true_rows: Optional[jax.Array] = None,
+                 ctx_true: Optional[jax.Array] = None) -> jax.Array:
         B, H, W, C = x.shape
         residual = x
+        # row-major flatten: a valid spatial prefix of true_rows rows is a
+        # valid token prefix of true_rows * W tokens
+        true_len = (None if true_rows is None
+                    else jnp.minimum(true_rows, H).astype(jnp.int32) * W)
         h = GroupNorm32(name="norm")(x).reshape(B, H * W, C)
         h = _linear(self.quant_linears, C, dtype=self.dtype,
                     name="proj_in")(h)
@@ -211,7 +247,7 @@ class SpatialTransformer(nn.Module):
             h = block(self.num_heads, dtype=self.dtype,
                       attention_impl=self.attention_impl, mesh=self.mesh,
                       quant_linears=self.quant_linears,
-                      name=f"block_{i}")(h, context)
+                      name=f"block_{i}")(h, context, true_len, ctx_true)
         h = _linear(self.quant_linears, C, dtype=self.dtype,
                     name="proj_out")(h)
         return residual + h.reshape(B, H, W, C)
@@ -318,9 +354,15 @@ class UNet(nn.Module):
         control_residuals: Optional[Tuple[jax.Array, ...]] = None,
         cache: Optional[jax.Array] = None,
         cache_mode: Optional[str] = None,
+        true_rows: Optional[jax.Array] = None,
+        ctx_true: Optional[jax.Array] = None,
     ) -> jax.Array:
         c = self.cfg
         assert cache_mode in (None, "deep", "reuse"), cache_mode
+        if true_rows is not None or ctx_true is not None:
+            # ragged dispatch rides the plain full forward only — the
+            # engine disables the step cache for ragged chunks
+            assert cache_mode is None, "ragged rows exclude the step cache"
         if cache_mode is not None:
             assert cache_supported(c), \
                 "step cache needs a level below CACHE_SPLIT"
@@ -361,6 +403,14 @@ class UNet(nn.Module):
         n_levels = len(c.block_out_channels)
         down_levels = split if cache_mode == "reuse" else n_levels
         last_ds = split - 1 if cache_mode == "reuse" else n_levels - 1
+        # Per-level valid-row counts: each stride-2 Downsample follows the
+        # ceil-halving arithmetic, so rows_lvl[level] is the valid spatial
+        # prefix at that level's resolution (shared by down, mid, up).
+        rows_lvl = None
+        if true_rows is not None:
+            rows_lvl = [true_rows.astype(jnp.int32)]
+            for _ in range(n_levels - 1):
+                rows_lvl.append((rows_lvl[-1] + 1) // 2)
         skips = [x]
         for level, (ch, depth) in enumerate(zip(
                 c.block_out_channels[:down_levels],
@@ -374,7 +424,10 @@ class UNet(nn.Module):
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
                         self.attention_impl, self.mesh,
                         quant_linears=self.quant_linears,
-                        name=f"down_{level}_attn_{i}")(x, context)
+                        name=f"down_{level}_attn_{i}")(
+                        x, context,
+                        None if rows_lvl is None else rows_lvl[level],
+                        ctx_true)
                 skips.append(x)
             if level < last_ds:
                 x = Downsample(ch, dtype=self.dtype,
@@ -393,7 +446,9 @@ class UNet(nn.Module):
                     c.mid_block_depth, self.heads_for(mid_ch), self.use_remat,
                     self.dtype, self.attention_impl, self.mesh,
                     quant_linears=self.quant_linears,
-                    name="mid_attn")(x, context)
+                    name="mid_attn")(
+                    x, context,
+                    None if rows_lvl is None else rows_lvl[-1], ctx_true)
             x = ResBlock(mid_ch, dtype=self.dtype,
                          quant_convs=self.quant_convs,
                          name="mid_res_1")(x, temb)
@@ -435,7 +490,10 @@ class UNet(nn.Module):
                         depth, self.heads_for(ch), self.use_remat, self.dtype,
                         self.attention_impl, self.mesh,
                         quant_linears=self.quant_linears,
-                        name=f"up_{level}_attn_{i}")(x, context)
+                        name=f"up_{level}_attn_{i}")(
+                        x, context,
+                        None if rows_lvl is None else rows_lvl[level],
+                        ctx_true)
             if level > 0:
                 x = Upsample(ch, dtype=self.dtype,
                              quant_convs=self.quant_convs,

@@ -137,10 +137,20 @@ class PerfLedger:
     def record_dispatch(self, *, bucket: str, cadence: int, precision: str,
                         device_s: float, flops: float, requests: int,
                         batch_raw: int, batch_run: int, true_pixels: int,
-                        padded_pixels: int) -> None:
+                        padded_pixels: int, masked_pixels: int = 0,
+                        true_tokens: int = 0, padded_tokens: int = 0
+                        ) -> None:
         """One device dispatch: host-observed seconds + the FLOPs priced
         for the same denoise range + true-vs-padded shape accounting.
-        No-op (and never raises) when ``SDTPU_PERF`` is off."""
+        No-op (and never raises) when ``SDTPU_PERF`` is off.
+
+        ``padded_pixels`` counts everything RESIDENT in the dispatch
+        (bucket area x batch_run); ``masked_pixels`` is the slice of that
+        the ragged attention kernel masks instead of attending to —
+        resident HBM but no attention FLOPs — so the summary can split
+        masked padding from compute padding. ``true_tokens`` /
+        ``padded_tokens`` carry the conditioning's true-vs-padded token
+        counts behind the ``token_padding_ratio`` gauge."""
         if not enabled():
             return
         try:
@@ -155,7 +165,8 @@ class PerfLedger:
                         self._groups_evicted += 1
                     g = {"dispatches": 0, "requests": 0, "device_s": 0.0,
                          "flops": 0.0, "true_pixels": 0, "padded_pixels": 0,
-                         "batch_raw": 0, "batch_run": 0}
+                         "batch_raw": 0, "batch_run": 0, "masked_pixels": 0,
+                         "true_tokens": 0, "padded_tokens": 0}
                     self._groups[key] = g
                 else:
                     self._groups.move_to_end(key)
@@ -167,6 +178,9 @@ class PerfLedger:
                 g["padded_pixels"] += int(padded_pixels)
                 g["batch_raw"] += int(batch_raw)
                 g["batch_run"] += int(batch_run)
+                g["masked_pixels"] += int(masked_pixels)
+                g["true_tokens"] += int(true_tokens)
+                g["padded_tokens"] += int(padded_tokens)
                 compiles_total = sum(int(c["count"])
                                      for c in self._compiles.values())
                 self._last_dispatch = self._dispatch_entry(
@@ -261,6 +275,12 @@ class PerfLedger:
             mfu = g["flops"] / g["device_s"] / peak
         true_px, padded_px = g["true_pixels"], g["padded_pixels"]
         ratio = (padded_px / true_px) if true_px else None
+        # ragged split (defaulted 0 so pre-ragged rows read identically):
+        # masked pixels are resident-but-not-attended — subtracting them
+        # gives the padding you actually pay attention FLOPs for
+        masked_px = int(g.get("masked_pixels", 0))
+        true_tok = int(g.get("true_tokens", 0))
+        padded_tok = int(g.get("padded_tokens", 0))
         return {
             "bucket": key[0], "cadence": key[1], "precision": key[2],
             "dispatches": int(g["dispatches"]),
@@ -273,6 +293,11 @@ class PerfLedger:
             else None,
             "batch_raw": int(g["batch_raw"]),
             "batch_run": int(g["batch_run"]),
+            "masked_pixels": masked_px,
+            "compute_padding_ratio": ((padded_px - masked_px) / true_px)
+            if true_px else None,
+            "token_padding_ratio": (padded_tok / true_tok)
+            if true_tok else None,
         }
 
     def _slo_row(self, key: Tuple[str, str],

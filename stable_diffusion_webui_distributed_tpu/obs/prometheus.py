@@ -576,6 +576,15 @@ def _render_perf(lines: List[str]) -> None:
         lines, "sdtpu_perf_padding_waste", "gauge",
         "Fraction of dispatched pixels that were bucket padding.",
         [(body(g), g["padding_waste"]) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_compute_padding_ratio", "gauge",
+        "Attention-computed pixels / true-requested pixels by group "
+        "(masked ragged rows excluded from the numerator).",
+        [(body(g), g.get("compute_padding_ratio")) for g in groups])
+    _labeled_family(
+        lines, "sdtpu_perf_token_padding_ratio", "gauge",
+        "Padded conditioning tokens / true prompt tokens by group.",
+        [(body(g), g.get("token_padding_ratio")) for g in groups])
 
     def slo_body(r):
         return (f'tenant="{_label(r["tenant"])}",'

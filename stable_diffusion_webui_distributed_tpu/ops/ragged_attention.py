@@ -1,0 +1,193 @@
+"""Pallas ragged attention: per-row true lengths over bucket-padded tokens.
+
+Ragged dispatch ("Ragged Paged Attention", PAPERS.md arxiv 2604.15464) lets
+heterogeneous requests share ONE bucket-shaped executable: every batch row
+carries its true token count as traced data, and the attention kernel masks
+the padded tail in-block instead of the bucketer rounding every request up
+the shape ladder. Spatial rows are padded at the BOTTOM (row-major flatten),
+so the valid tokens of each batch row form a prefix — the mask is a single
+``position < true_len`` compare per tile, and k-tiles that start past the
+longest-needed position are skipped outright (no tail FLOPs on TPU).
+
+Two entry points:
+
+- ``ragged_attention_reference`` — dense XLA masked attention. This is BOTH
+  the CPU/tier-1 execution path (bit-exact by construction: the fallback IS
+  the reference) and the oracle the pallas kernel is tested against.
+- ``ragged_attention`` — the pallas kernel, same online-softmax blockwise
+  form as ``ops/flash_attention.py`` (grid ``(B*H, T/block_q, S/block_k)``,
+  VMEM (m, l, acc) scratch), extended with a scalar-prefetched per-(b·h)
+  ``true_len`` vector, ``pl.when``-skipped fully-masked k-tiles, and a
+  finalize that zeroes query rows at or past ``true_len``.
+
+Masked scores use a large-negative constant (not ``-inf``): ``exp(-1e30 - m)``
+underflows to exactly ``0.0`` in f32, while ``-inf`` arithmetic can surface
+NaN through ``inf - inf`` when a whole tile is masked.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: additive mask for padded key positions — exp() underflows to exact 0.0
+#: in f32 without the NaN hazards of -inf
+MASK_VALUE = -1e30
+
+
+def ragged_attention_reference(
+    q: jax.Array,              # (B, T, H, D)
+    k: jax.Array,              # (B, S, H, D)
+    v: jax.Array,              # (B, S, H, D)
+    true_len: jax.Array,       # (B,) int32 — valid KEY prefix per row
+    scale: float | None = None,
+    q_true_len: jax.Array | None = None,   # (B,) valid QUERY prefix; None=all
+) -> jax.Array:
+    """Dense XLA masked attention — the oracle and the CPU execution path.
+
+    Keys/values at positions ``>= true_len[b]`` are excluded from the
+    softmax; query rows at positions ``>= q_true_len[b]`` (when given) are
+    zeroed — their content is bucket padding and downstream consumers mask
+    them anyway, but pinning them to 0 keeps padded tails from drifting
+    through residual streams. Rows must have ``true_len >= 1``.
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts",
+        q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    kmask = jnp.arange(s, dtype=jnp.int32)[None, :] < true_len[:, None]
+    scores = jnp.where(kmask[:, None, None, :], scores, MASK_VALUE)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    if q_true_len is not None:
+        qmask = (jnp.arange(t, dtype=jnp.int32)[None, :]
+                 < q_true_len[:, None])
+        out = jnp.where(qmask[:, :, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def _ragged_kernel(tl_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, scale: float, block_q: int, block_k: int):
+    """One (batch*head, q-tile, k-tile) step of the ragged online softmax."""
+    bh = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    tl = tl_ref[bh]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Fold only k-tiles that overlap the valid prefix: a tile starting at or
+    # past true_len is entirely padding and contributes nothing — skipping it
+    # is where the ragged FLOP savings come from.
+    @pl.when(j * block_k < tl)
+    def _fold():
+        q = q_ref[0].astype(jnp.float32) * scale        # (block_q, D)
+        k_blk = k_ref[0].astype(jnp.float32)            # (block_k, D)
+        v_blk = v_ref[0].astype(jnp.float32)
+
+        s = q @ k_blk.T                                 # (block_q, block_k)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(kpos < tl, s, MASK_VALUE)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + p @ v_blk
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[:]
+        qpos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        valid = (qpos < tl) & (l > 0.0)
+        o_ref[0] = jnp.where(
+            valid, acc_ref[:] / jnp.where(l > 0.0, l, 1.0),
+            0.0).astype(o_ref.dtype)
+
+
+def _ragged_bhtd(q, k, v, tl_bh, scale, block_q, block_k, interpret):
+    """(BH, T, D) x (BH, S, D) with per-BH true_len -> (BH, T, D)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    s_len = k.shape[1]
+    kernel = functools.partial(_ragged_kernel, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, t // block_q, s_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i, j, *_: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),   # unnormalized acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tl_bh, q, k, v)
+
+
+def ragged_attention(
+    q: jax.Array,              # (B, T, H, D)
+    k: jax.Array,              # (B, S, H, D)
+    v: jax.Array,              # (B, S, H, D)
+    true_len: jax.Array,       # (B,) int32 — valid prefix per batch row
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Self-attention over bucket-padded tokens with per-row true lengths.
+
+    Dispatches to the pallas kernel on TPU (or under ``interpret=True`` for
+    tests); everywhere else — and whenever the sequence doesn't tile — runs
+    the dense masked reference, so the CPU tier-1 path is the oracle itself.
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    true_len = true_len.astype(jnp.int32)
+    on_tpu = jax.default_backend() == "tpu"
+    # Off-TPU the default is the dense reference (bit-exact tier-1 path);
+    # interpret=True opts into the emulated pallas kernel for kernel tests.
+    use_pallas = on_tpu or interpret is True
+    if interpret is None:
+        interpret = not on_tpu
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    if t % block_q or s % block_k or not use_pallas:
+        return ragged_attention_reference(q, k, v, true_len, scale,
+                                          q_true_len=true_len)
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    tl_bh = jnp.repeat(true_len, h)                     # (B*H,)
+    out = _ragged_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), tl_bh, scale,
+                       block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

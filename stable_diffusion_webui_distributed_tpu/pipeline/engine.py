@@ -366,7 +366,8 @@ class Engine:
 
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
                          added_u, added_c, controls=(), total_steps=1,
-                         inpaint_cond=None, unet=None, controlnet=None):
+                         inpaint_cond=None, unet=None, controlnet=None,
+                         ragged=None):
         """Closure: x0-prediction denoiser with classifier-free guidance and
         optional ControlNet residual injection.
 
@@ -376,7 +377,13 @@ class Engine:
         serializes exactly these fields, control_net.py:20-79).
 
         ``unet``/``controlnet`` select a precision module variant
-        (:meth:`_modules_for`); None keeps the policy-default modules."""
+        (:meth:`_modules_for`); None keeps the policy-default modules.
+
+        ``ragged``: ``(true_rows, ctx_true_u, ctx_true_c)`` traced (B,)
+        int32 vectors for ragged dispatch — valid latent rows per batch
+        row plus valid context tokens per CFG half. The CFG batch doubling
+        duplicates ``true_rows`` and interleaves the two context lengths
+        exactly like the contexts themselves."""
         unet = unet if unet is not None else self.unet
         controlnet = (controlnet if controlnet is not None
                       else self.controlnet_module)
@@ -426,8 +433,15 @@ class Engine:
                 cond2 = batch_concat(
                     [inpaint_cond, inpaint_cond]).astype(both.dtype)
                 unet_in = channel_concat([both, cond2])
+            ragged_kw = {}
+            if ragged is not None:
+                true_rows, ctx_true_u, ctx_true_c = ragged
+                ragged_kw = {
+                    "true_rows": batch_concat([true_rows, true_rows]),
+                    "ctx_true": batch_concat([ctx_true_u, ctx_true_c]),
+                }
             out = unet.apply(unet_params, unet_in, tb, ctx, added,
-                             control_residuals=residuals)
+                             control_residuals=residuals, **ragged_kw)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
             guided = out_u + cfg_scale * (out_c - out_u)
             if v_pred:
@@ -442,6 +456,7 @@ class Engine:
                   height: int, batch: int, length: int,
                   masked: bool, n_controls: int = 0,
                   inpaint: bool = False,
+                  ragged: bool = False,
                   step_cache: bool = False,
                   precision: str = "") -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
@@ -459,6 +474,17 @@ class Engine:
         ControlNet chunks never take the cached path (the chunk loop
         routes active-CN windows to the plain executable).
 
+        ``ragged`` selects the ragged-dispatch variant: per-row
+        ``true_rows``/``ctx_true_u``/``ctx_true_c`` length vectors are
+        TRACED trailing arguments (lengths must never enter this key —
+        a static length would re-fragment the executable cache back into
+        the ladder; sdtpu-lint RC001 fixture ``ragged_bad.py``), and the
+        sampler step re-zeroes latent rows past ``true_rows`` so
+        ancestral noise injection cannot leak into the masked tail. The
+        ragged bit sits BEFORE the step_cache/precision axes so the
+        census parser (obs/perf.py census_from_keys: ident = k[1:-2])
+        keeps attributing budget per bucket identity.
+
         Both variants return ``(carry..., fence)`` where ``fence`` is a
         tiny data-dependent output: the host paces progress/interrupt on
         it because the carry's INPUT buffers are donated into the next
@@ -469,11 +495,47 @@ class Engine:
             precision, self._default_precision.name)
         unet, cn_module = self._modules_for(prec)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
-               masked, n_controls, inpaint, self.family.name, step_cache,
-               prec)
+               masked, n_controls, inpaint, self.family.name, ragged,
+               step_cache, prec)
         if step_cache:
+            assert not ragged, "ragged chunks disable the step cache"
             return self._cached(key, lambda: self._build_stepcache_chunk(
                 spec, steps, batch, length, masked, inpaint, unet=unet))
+        if ragged:
+            def build_ragged():
+                sigmas = kd.build_sigmas(spec, self.schedule, steps)
+
+                def run_chunk(unet_params, carry, start, ctx_u, ctx_c, cfg,
+                              image_keys, added_u, added_c, true_rows,
+                              ctx_true_u, ctx_true_c):
+                    denoise = self._make_denoise_fn(
+                        unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
+                        total_steps=steps, unet=unet, controlnet=cn_module,
+                        ragged=(true_rows, ctx_true_u, ctx_true_c))
+                    base_step = kd.make_sampler_step(
+                        spec, denoise, sigmas, image_keys)
+                    lat_h = carry.x.shape[1]
+                    row_mask = (jnp.arange(lat_h, dtype=jnp.int32)[None, :]
+                                < true_rows[:, None])[:, :, None, None]
+
+                    def step(carry, i):
+                        carry2, _ = base_step(carry, i)
+                        # ancestral samplers inject fresh noise everywhere;
+                        # re-zero the masked tail so padded rows stay
+                        # exactly 0 into every conv of the next step —
+                        # the row-independence invariant solo==group
+                        # byte identity rests on
+                        carry2 = carry2._replace(
+                            x=jnp.where(row_mask, carry2.x, 0.0))
+                        return carry2, ()
+
+                    idx = start + jnp.arange(length)
+                    carry, _ = jax.lax.scan(step, carry, idx)
+                    return carry, carry.x.reshape(-1)[:1]
+
+                return jax.jit(run_chunk, donate_argnums=(1,))
+
+            return self._cached(key, build_ragged)
 
         def build():
             sigmas = kd.build_sigmas(spec, self.schedule, steps)
@@ -1034,7 +1096,8 @@ class Engine:
 
     # -- prompt conditioning -----------------------------------------------
 
-    def encode_prompts(self, payload: GenerationPayload, prompts=None):
+    def encode_prompts(self, payload: GenerationPayload, prompts=None,
+                       ragged=False):
         """Conditioning for the request.
 
         Default: one prompt -> ctx (1, L, D), broadcast over the batch in
@@ -1043,6 +1106,14 @@ class Engine:
         prompts encoded once, all chunk-padded to one context length.
         Textual-inversion mentions resolve against the embedding store
         (models/embeddings.py) and ride as injection arrays.
+
+        ``ragged`` (SDTPU_RAGGED conditioning): each prompt encodes at its
+        TRUE chunk count (the embed cache keys on it — one entry per
+        prompt, not per group max) and the *encoded* rows are zero-padded
+        to the request context length; returns an extra
+        ``(ctx_true_u, ctx_true_c)`` pair of valid token counts that the
+        denoiser masks cross-attention with. Zero-padded rows are never
+        attended to, so the pad value is inert.
         """
         from stable_diffusion_webui_distributed_tpu.models.embeddings import (
             build_injection_arrays,
@@ -1076,9 +1147,9 @@ class Engine:
                if self.family.text_encoder_2 else 0)
         width = ids_u.shape[1]
 
-        def inj_arrays(injections):
+        def inj_arrays(injections, n_enc):
             mask, val_l, val_g = build_injection_arrays(
-                injections, n, width, self.embedding_store, h_l, h_g)
+                injections, n_enc, width, self.embedding_store, h_l, h_g)
             return (jnp.asarray(mask), jnp.asarray(val_l),
                     jnp.asarray(val_g))
 
@@ -1111,41 +1182,61 @@ class Engine:
                 embed as embed_cache,
             )
 
-        def encode_fresh(ids_c, w_c, inj_c):
-            pi, wi = pad_chunks(ids_c, w_c, n, eos, bos)
+        def encode_fresh(ids_c, w_c, inj_c, n_enc):
+            pi, wi = pad_chunks(ids_c, w_c, n_enc, eos, bos)
             return enc(te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
-                       *inj_arrays(inj_c))
+                       *inj_arrays(inj_c, n_enc))
 
-        def cached_enc(raw, ids_c, w_c, inj_c, negative=False):
+        def cached_enc(raw, ids_c, w_c, inj_c, negative=False, n_enc=None):
             # cross-request cache (webui's cached_c/uc): same text at the
             # same clip_skip/chunk-count under the same TE weights and
-            # embedding files encodes to the same conditioning
+            # embedding files encodes to the same conditioning. The ragged
+            # path keys on the TRUE chunk count (n_enc), so one entry
+            # serves the prompt under any group composition.
+            n_enc = n if n_enc is None else n_enc
             if embed_cache is not None:
                 return embed_cache.lookup_or_encode(
-                    self, raw, skip, n, negative,
-                    lambda: encode_fresh(ids_c, w_c, inj_c))
-            key = (raw, skip, n, self._cond_epoch, store_gen)
+                    self, raw, skip, n_enc, negative,
+                    lambda: encode_fresh(ids_c, w_c, inj_c, n_enc))
+            key = (raw, skip, n_enc, self._cond_epoch, store_gen)
             hit = self._cond_cache.get(key)
             if hit is not None:
                 self._cond_cache.move_to_end(key)
                 return hit
-            out = encode_fresh(ids_c, w_c, inj_c)
+            out = encode_fresh(ids_c, w_c, inj_c, n_enc)
             self._cond_cache[key] = out
             if len(self._cond_cache) > self._COND_CACHE_MAX:
                 self._cond_cache.popitem(last=False)
             return out
 
+        from stable_diffusion_webui_distributed_tpu.models.clip import (
+            pad_encoded_context,
+        )
+
         with trace.STATS.timer("text_encode"):
             ctxs, pooleds = [], []
             for (ids_c, w_c, inj_c), raw in zip(toks, cleaned):
-                ctx, pooled = cached_enc(raw, ids_c, w_c, inj_c)
+                ctx, pooled = cached_enc(
+                    raw, ids_c, w_c, inj_c,
+                    n_enc=int(ids_c.shape[0]) if ragged else n)
+                if ragged:
+                    ctx = pad_encoded_context(ctx, n, width)
                 ctxs.append(ctx)
                 pooleds.append(pooled)
             ctx_c = ctxs[0] if len(ctxs) == 1 else jnp.concatenate(ctxs, 0)
             pooled_c = pooleds[0] if len(pooleds) == 1 \
                 else jnp.concatenate(pooleds, 0)
-            ctx_u, pooled_u = cached_enc(payload.negative_prompt,
-                                         ids_u, w_u, inj_u, negative=True)
+            ctx_u, pooled_u = cached_enc(
+                payload.negative_prompt, ids_u, w_u, inj_u, negative=True,
+                n_enc=int(ids_u.shape[0]) if ragged else n)
+            if ragged:
+                ctx_u = pad_encoded_context(ctx_u, n, width)
+        if ragged:
+            # valid context tokens per CFG half (single-prompt path only —
+            # the dispatcher's coalescable gate excludes all_prompts)
+            ctx_true = (int(ids_u.shape[0]) * width,
+                        int(toks[0][0].shape[0]) * width)
+            return (ctx_u, ctx_c), (pooled_u, pooled_c), ctx_true
         return (ctx_u, ctx_c), (pooled_u, pooled_c)
 
     def _embedding_counts(self):
@@ -1180,6 +1271,46 @@ class Engine:
         lengths.append(tokenize_with_embeddings(
             self.tokenizer, payload.negative_prompt, counts)[0].shape[0])
         return int(max(lengths))
+
+    def request_token_stats(self, payload: GenerationPayload,
+                            chunks: Optional[int] = None):
+        """(true_tokens, padded_tokens) for the request's conditioning —
+        the perf ledger's ``token_padding_ratio`` feed. True tokens are
+        BOS + content + closing EOS per chunk of the prompt and negative
+        prompt (models/prompt.py ``true_token_count``); padded tokens are
+        both halves grown to ``chunks`` (default: the request max) times
+        the 77-token window. Tokenizes again, so callers gate on
+        SDTPU_PERF."""
+        from stable_diffusion_webui_distributed_tpu.models.lora import (
+            extract_lora_tags,
+        )
+        from stable_diffusion_webui_distributed_tpu.models.prompt import (
+            tokenize_with_embeddings, true_token_count,
+        )
+
+        counts = self._embedding_counts()
+        eos = self.tokenizer.eos
+        ids_c, _, _ = tokenize_with_embeddings(
+            self.tokenizer, extract_lora_tags(payload.prompt)[0], counts)
+        ids_u, _, _ = tokenize_with_embeddings(
+            self.tokenizer, payload.negative_prompt, counts)
+        if chunks is None:
+            chunks = max(ids_c.shape[0], ids_u.shape[0],
+                         int(payload.context_chunks or 0))
+        width = ids_c.shape[1]
+        true = true_token_count(ids_c, eos) + true_token_count(ids_u, eos)
+        return true, 2 * int(chunks) * int(width)
+
+    def _ragged_plan(self, payload: GenerationPayload):
+        """(true_w, true_h) when this execution payload carries the ragged
+        marker (serving/bucketer.py ``bucket_payload(ragged=True)``); None
+        otherwise. The marker is only minted for dispatcher-coalescable
+        txt2img work, so the ragged denoise never meets hires, refiner
+        handoffs, masks, inpainting conditioning or ControlNet."""
+        wh = (payload.override_settings or {}).get("ragged_true_wh")
+        if not wh:
+            return None
+        return int(wh[0]), int(wh[1])
 
     def _added_cond(self, pooled_u, pooled_c, width, height,
                     aesthetic_score: float = 6.0):
@@ -1363,7 +1494,7 @@ class Engine:
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
                        mask_lat, init_lat, controls=(), end_step=None,
-                       inpaint_cond=None, sync=True):
+                       inpaint_cond=None, sync=True, ragged=None):
         """Obs-span wrapper around the chunk loop: one ``denoise_range``
         span (host-side perf_counter, no extra device sync) grouping the
         per-chunk ``denoise_chunk`` leaf spans StageStats feeds in."""
@@ -1377,12 +1508,12 @@ class Engine:
             return self._denoise_range_timed(
                 payload, x, image_keys, conds, pooleds, width, height,
                 start_step, steps, job, mask_lat, init_lat, controls,
-                end_step, inpaint_cond, sync)
+                end_step, inpaint_cond, sync, ragged)
 
     def _denoise_range_timed(self, payload, x, image_keys, conds, pooleds,
                              width, height, start_step, steps, job,
                              mask_lat, init_lat, controls=(), end_step=None,
-                             inpaint_cond=None, sync=True):
+                             inpaint_cond=None, sync=True, ragged=None):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
@@ -1392,7 +1523,13 @@ class Engine:
         ``sync=False`` (parallel/stage_pipeline.py) skips every
         ``block_until_ready`` so the host can keep dispatching to OTHER
         device groups while this one chews — progress then reports at
-        group granularity and interrupt latency grows to a full range."""
+        group granularity and interrupt latency grows to a full range.
+
+        ``ragged``: ``(true_rows, ctx_true_u, ctx_true_c)`` traced (B,)
+        int32 vectors (serving/dispatcher.py ragged mode). Routes every
+        chunk to the ragged executable variant; the step cache and prefix
+        sharing are disabled for ragged ranges (their carries assume the
+        dense row layout end to end)."""
         if kd.resolve_sampler(payload.sampler_name).adaptive:
             return self._denoise_adaptive(
                 payload, x, image_keys, conds, pooleds, width, height,
@@ -1430,7 +1567,11 @@ class Engine:
         cfg_stop = stepcache.cutoff_step(
             np.asarray(kd.build_sigmas(spec, self.schedule, steps)),
             sc.cutoff_sigma)
-        use_cache = sc.active and cache_supported(self.family.unet)
+        if ragged is not None:
+            assert not masked and not inpainting and not controls, \
+                "ragged dispatch covers the plain txt2img path only"
+        use_cache = (sc.active and cache_supported(self.family.unet)
+                     and ragged is None)
         cache = valid = None
         if use_cache:
             # [uncond; cond] deep-feature rows; a fresh range starts
@@ -1452,7 +1593,8 @@ class Engine:
         # materialization has no safe point there.
         prefix_plan = None
         if (job == "txt2img" and sync and start_step == 0 and not masked
-                and not inpainting and not controls and end > 0):
+                and not inpainting and not controls and end > 0
+                and ragged is None):
             from stable_diffusion_webui_distributed_tpu.cache import (
                 keys as cache_keys,
             )
@@ -1546,11 +1688,18 @@ class Engine:
             fn = self._chunk_fn(payload.sampler_name, steps, width, height,
                                 batch, length, masked=masked,
                                 n_controls=len(active), inpaint=inpainting,
+                                ragged=ragged is not None,
                                 step_cache=cached_chunk,
                                 precision=prec.name)
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate(f"denoise[{pos}:{pos + length}]"):
-                if cached_chunk:
+                if ragged is not None:
+                    true_rows, ctx_true_u, ctx_true_c = ragged
+                    carry, fence = fn(
+                        self.params["unet"], carry, jnp.int32(pos), ctx_u,
+                        ctx_c, cfg, image_keys, au, ac, true_rows,
+                        ctx_true_u, ctx_true_c)
+                elif cached_chunk:
                     carry, cache, valid, fence = fn(
                         self.params["unet"], carry, cache, valid,
                         jnp.int32(pos), ctx_u, ctx_c, cfg, image_keys,
@@ -1673,11 +1822,25 @@ class Engine:
 
         controls = self._prepare_controls(payload, width, height)
         refiner = self._refiner_engine(payload)
+        # ragged solo dispatch (SDTPU_RAGGED): the bucketer stamped the
+        # true requested shape; denoise at the bucket shape with the true
+        # latent row count as traced data. Guarded by the same exclusions
+        # the dispatcher's coalescable gate applies, so a hand-built
+        # marker on ineligible work degrades to the classic path.
+        ragged_wh = None
+        if not (payload.all_prompts or payload.enable_hr or refiner
+                or controls or self.family.inpaint):
+            ragged_wh = self._ragged_plan(payload)
         conds = pooleds = ref_cond = None
+        ctx_true = None
         if not payload.all_prompts:
             # conditioning resolved ONCE per request, not per batch group;
             # per-image prompts resolve per group in the loop instead
-            conds, pooleds = self.encode_prompts(payload)
+            if ragged_wh is not None:
+                conds, pooleds, ctx_true = self.encode_prompts(
+                    payload, ragged=True)
+            else:
+                conds, pooleds = self.encode_prompts(payload)
             ref_cond = refiner.encode_prompts(payload) if refiner else None
         out = GenerationResult(parameters=payload.model_dump())
 
@@ -1699,11 +1862,29 @@ class Engine:
                 # SURVEY.md §7 layer 5; extra images cost FLOPs once, a new
                 # compile costs minutes)
                 gen_n = group
-            noise = rng.batch_noise(
-                payload.seed, payload.subseed, payload.subseed_strength,
-                pos, gen_n, (h, w, C),
-                seed_resize=self._seed_resize_latent(payload),
-                pin_index=payload.same_seed)
+            ragged = None
+            if ragged_wh is not None:
+                # true latent rows (ceil: a partial row still needs its
+                # pixels); noise drawn at the TRUE height and zero-padded
+                # so the masked tail starts exactly 0 and row content is
+                # independent of the bucket height the request landed in
+                f = self.family.vae_scale_factor
+                tr = min(h, -(-ragged_wh[1] // f))
+                noise = rng.batch_noise(
+                    payload.seed, payload.subseed, payload.subseed_strength,
+                    pos, gen_n, (tr, w, C),
+                    seed_resize=self._seed_resize_latent(payload),
+                    pin_index=payload.same_seed)
+                noise = jnp.pad(noise, ((0, 0), (0, h - tr), (0, 0), (0, 0)))
+                ragged = (jnp.full((gen_n,), tr, jnp.int32),
+                          jnp.full((gen_n,), ctx_true[0], jnp.int32),
+                          jnp.full((gen_n,), ctx_true[1], jnp.int32))
+            else:
+                noise = rng.batch_noise(
+                    payload.seed, payload.subseed, payload.subseed_strength,
+                    pos, gen_n, (h, w, C),
+                    seed_resize=self._seed_resize_latent(payload),
+                    pin_index=payload.same_seed)
             x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
             keys = self._image_keys(payload, pos, gen_n)
             if payload.all_prompts:
@@ -1714,7 +1895,7 @@ class Engine:
             latents = self._split_denoise(
                 payload, x, keys, conds, pooleds, width, height, job,
                 controls, refiner, ref_cond, payload.steps, 0,
-                inpaint_cond=inp)
+                inpaint_cond=inp, ragged=ragged)
             out_w, out_h = width, height
             if payload.enable_hr and not self.state.flag.interrupted:
                 latents, out_w, out_h = self._hires_pass(
@@ -1740,7 +1921,7 @@ class Engine:
 
     def _split_denoise(self, payload, x, keys, conds, pooleds, width, height,
                        job, controls, refiner, ref_cond, steps, start_step,
-                       inpaint_cond=None):
+                       inpaint_cond=None, ragged=None):
         """Denoise [start_step, steps) with an optional refiner handoff: the
         base model runs up to the switch point, then the refiner — its own
         text conditioning and aesthetic micro-conditioning — finishes on the
@@ -1753,7 +1934,9 @@ class Engine:
             return self._denoise_range(payload, x, keys, conds, pooleds,
                                        width, height, start_step, steps, job,
                                        None, None, controls,
-                                       inpaint_cond=inpaint_cond)
+                                       inpaint_cond=inpaint_cond,
+                                       ragged=ragged)
+        assert ragged is None  # refiner handoff is ragged-ineligible
         switch = int(steps * payload.refiner_switch_at)
         switch = max(start_step, min(steps - 1, switch))
         latents = x

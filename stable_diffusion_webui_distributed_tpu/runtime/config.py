@@ -72,6 +72,23 @@ def _logger():
 #   only): extra precision rungs the AOT warmup sweep pre-builds per
 #   bucket (serving/warmup.py) — precision is a static compile-key axis.
 #
+# Ragged-dispatch knobs (serving/bucketer.py, ops/ragged_attention.py;
+# README "Ragged dispatch"):
+#
+# - ``SDTPU_RAGGED`` (flag, default off): true-length batching. On,
+#   coalescable txt2img requests match a bucket on WIDTH only and run at
+#   the tallest ladder height for that width; each batch row carries its
+#   true latent-row count and true conditioning-token counts as TRACED
+#   int32 vectors, the attention kernels mask the padded tail, and the
+#   serving layer crops top-aligned. Heterogeneous heights thereby share
+#   ONE chunk executable per width class instead of one per ladder rung.
+#   Off (the default), the classic area-ladder path runs byte-identical
+#   to the unragged build (hash-pinned in tests/test_ragged.py).
+# - ``SDTPU_RAGGED_LADDER`` (comma WxH list, default "" = the regular
+#   bucket ladder): an explicitly coarse shape list ragged matching
+#   scans instead — the knob that collapses a fine classic ladder down
+#   to one bucket per width class without touching classic traffic.
+#
 # Observability knobs (obs/ package; README "Observability"):
 #
 # - ``SDTPU_OBS`` (flag, default on): per-request span tracing. Spans are

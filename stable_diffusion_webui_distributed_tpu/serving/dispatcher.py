@@ -64,7 +64,7 @@ from stable_diffusion_webui_distributed_tpu.obs import (
 )
 from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
 from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
-    ShapeBucketer,
+    ShapeBucketer, ragged_enabled,
 )
 from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
 
@@ -232,11 +232,21 @@ class ServingDispatcher:
                     run, bucketed = payload.model_copy(), False
                     METRICS.record_request(False, bypassed=True)
                 else:
-                    run, bucketed = self.bucketer.bucket_payload(payload)
+                    ragged = ragged_enabled() \
+                        and self._ragged_eligible(payload)
+                    run, bucketed = self.bucketer.bucket_payload(
+                        payload, ragged=ragged)
+                    # batch-ladder padding folds into the ratio only for
+                    # work that pads ALONE up the ladder; coalescable rows
+                    # fill via merging, so charging bucket_batch(n)/n to
+                    # them would book phantom waste
+                    solo_batch = None if self._coalescable(run) \
+                        else payload.total_images
                     METRICS.record_request(
                         bucketed,
                         padding_ratio=self.bucketer.padding_ratio(
-                            payload.width, payload.height))
+                            payload.width, payload.height,
+                            batch=solo_batch))
                 if jr_on:
                     obs_journal.emit("bucketed", rid, bucketed=bucketed,
                                      bypassed=bypass,
@@ -458,6 +468,19 @@ class ServingDispatcher:
             return False
         return p.total_images <= self.max_batch
 
+    def _ragged_eligible(self, p) -> bool:
+        """May this payload run ragged (SDTPU_RAGGED)? The coalescable
+        exclusion set, plus step-cache work: a resolved cadence's deep-
+        feature carry assumes the dense row layout, so those requests
+        keep their classic executables and cadence semantics."""
+        from stable_diffusion_webui_distributed_tpu.pipeline import (
+            stepcache,
+        )
+
+        if stepcache.resolve(p).active:
+            return False
+        return self._coalescable(p)
+
     def _precision_name(self, run) -> str:
         """Resolved serving precision for a request (pipeline/precision.py)
         — the last group-key axis and the label on the dispatch span /
@@ -479,6 +502,10 @@ class ServingDispatcher:
         # step-cache knobs join the key: merged requests run ONE denoise
         # range, so they must agree on the resolved (bucketed) cadence and
         # CFG cutoff or the coalesced batch would change their outputs.
+        # The ragged marker joins too (as a bool, NOT the true shape —
+        # heterogeneous true shapes coalescing is the whole point): a
+        # ragged and a classic request at the same bucket run different
+        # executables, and SDTPU_RAGGED can flip mid-flight under tests.
         # The resolved precision name is the LAST axis (consumers read
         # key[-1]): int8 and bf16 requests coalesce separately — a merged
         # batch runs one chunk executable, and precision is static in it.
@@ -487,6 +514,7 @@ class ServingDispatcher:
                 int(run.width), int(run.height), float(run.cfg_scale),
                 run.negative_prompt or "", int(run.clip_skip or 0),
                 sc.cadence, sc.cutoff_sigma,
+                bool((run.override_settings or {}).get("ragged_true_wh")),
                 ServingDispatcher._precision_name(self, run))
 
     def _dispatch_eta(self, run, batch_size: int) -> Optional[float]:
@@ -693,17 +721,44 @@ class ServingDispatcher:
                     from stable_diffusion_webui_distributed_tpu.pipeline \
                         import stepcache
                     n_img = ticket.run.total_images
+                    # batch-ladder attribution (solo work pads alone): the
+                    # engine pad-and-drops a remainder group up to the
+                    # group size whenever the full-group executable exists
+                    # — _has_batch_bucket is the same predicate it used
+                    group = max(1, ticket.run.group_size
+                                or ticket.run.batch_size)
+                    full, rem = divmod(n_img, group)
+                    n_run = n_img
+                    if rem and (full > 0 or self.engine._has_batch_bucket(
+                            ticket.run.sampler_name, ticket.run.steps,
+                            ticket.run.width, ticket.run.height, group)):
+                        n_run = (full + 1) * group
+                    masked_px = 0
+                    wh = self.engine._ragged_plan(ticket.run)
+                    if wh is not None:
+                        f = self.engine.family.vae_scale_factor
+                        lat_h = ticket.run.height // f
+                        tr = min(lat_h, -(-wh[1] // f))
+                        masked_px = (lat_h - tr) * f \
+                            * ticket.run.width * n_run
+                    try:
+                        tok_t, tok_p = self.engine.request_token_stats(
+                            ticket.run)
+                    except Exception:  # noqa: BLE001 — telemetry passive
+                        tok_t = tok_p = 0
                     obs_perf.LEDGER.record_dispatch(
                         bucket=f"{ticket.run.width}x{ticket.run.height}",
                         cadence=int(stepcache.resolve(ticket.run).cadence),
                         precision=prec,
                         device_s=time.perf_counter() - t0_dev,
                         flops=METRICS.unet_flops_snapshot() - flops0,
-                        requests=1, batch_raw=n_img, batch_run=n_img,
+                        requests=1, batch_raw=n_img, batch_run=n_run,
                         true_pixels=ticket.payload.width
                         * ticket.payload.height * n_img,
                         padded_pixels=ticket.run.width
-                        * ticket.run.height * n_img)
+                        * ticket.run.height * n_run,
+                        masked_pixels=masked_px,
+                        true_tokens=tok_t, padded_tokens=tok_p)
                 if ticket.bucketed:
                     result = self._restore_solo(result, ticket)
                 ticket.result = result
@@ -753,20 +808,52 @@ class ServingDispatcher:
         # via payload.context_chunks)
         chunks = max(engine.request_context_chunks(p)
                      for p in (t.run for t in live))
+        # ragged group (SDTPU_RAGGED, a _group_key axis — uniform across
+        # the group): every ticket carries its true shape in the marker,
+        # noise is drawn at the TRUE latent rows and zero-padded to the
+        # shared bucket, and the per-row true lengths ride into the
+        # denoise as traced vectors — heterogeneous shapes, one executable
+        ragged_mode = engine._ragged_plan(rp) is not None
+        f = engine.family.vae_scale_factor
+        perf_on = obs_perf.enabled()
         counts, noise_parts, key_parts = [], [], []
         ctx_rows, pooled_rows = [], []
+        true_rows_l, ctx_true_u_l, ctx_true_c_l = [], [], []
+        true_tok = padded_tok = 0
         ctx_u = pooled_u = None
         for t in live:
             p = t.run.model_copy()
             p.context_chunks = chunks
             n_p = p.total_images
             counts.append(n_p)
-            noise_parts.append(rng.batch_noise(
-                p.seed, p.subseed, p.subseed_strength, 0, n_p, (h, w, C),
-                seed_resize=engine._seed_resize_latent(p),
-                pin_index=p.same_seed))
+            if ragged_mode:
+                tw, th = engine._ragged_plan(p) or (width, height)
+                tr = min(h, -(-th // f))
+                part = rng.batch_noise(
+                    p.seed, p.subseed, p.subseed_strength, 0, n_p,
+                    (tr, w, C), seed_resize=engine._seed_resize_latent(p),
+                    pin_index=p.same_seed)
+                noise_parts.append(jnp.pad(
+                    part, ((0, 0), (0, h - tr), (0, 0), (0, 0))))
+                (cu, cc), (pu, pc), (ct_u, ct_c) = engine.encode_prompts(
+                    p, ragged=True)
+                true_rows_l += [tr] * n_p
+                ctx_true_u_l += [ct_u] * n_p
+                ctx_true_c_l += [ct_c] * n_p
+            else:
+                noise_parts.append(rng.batch_noise(
+                    p.seed, p.subseed, p.subseed_strength, 0, n_p,
+                    (h, w, C), seed_resize=engine._seed_resize_latent(p),
+                    pin_index=p.same_seed))
+                (cu, cc), (pu, pc) = engine.encode_prompts(p)
+            if perf_on:
+                try:
+                    tt, pt = engine.request_token_stats(p, chunks=chunks)
+                    true_tok += tt
+                    padded_tok += pt
+                except Exception:  # noqa: BLE001 — telemetry stays passive
+                    pass
             key_parts.append(engine._image_keys(p, 0, n_p))
-            (cu, cc), (pu, pc) = engine.encode_prompts(p)
             self._drain_cache_notes(t.request_id, prefix=False)
             ctx_rows.append(jnp.broadcast_to(cc, (n_p,) + cc.shape[1:]))
             pooled_rows.append(jnp.broadcast_to(pc, (n_p,) + pc.shape[1:]))
@@ -790,6 +877,15 @@ class ServingDispatcher:
 
             noise, keys = _pad(noise), _pad(keys)
             ctx_c, pooled_c = _pad(ctx_c), _pad(pooled_c)
+            if ragged_mode:
+                true_rows_l += [true_rows_l[-1]] * pad
+                ctx_true_u_l += [ctx_true_u_l[-1]] * pad
+                ctx_true_c_l += [ctx_true_c_l[-1]] * pad
+        ragged_arg = None
+        if ragged_mode:
+            ragged_arg = (jnp.asarray(true_rows_l, jnp.int32),
+                          jnp.asarray(ctx_true_u_l, jnp.int32),
+                          jnp.asarray(ctx_true_c_l, jnp.int32))
 
         x = engine._place_batch(noise.astype(jnp.float32) * sigmas[0])
         # perf ledger (SDTPU_PERF): host-observed denoise seconds joined
@@ -797,15 +893,21 @@ class ServingDispatcher:
         # passive perf_counter reads, no extra device syncs, and with the
         # knob off record_dispatch is a no-op (dispatch stays byte-
         # identical to the uninstrumented path)
-        perf_on = obs_perf.enabled()
         if perf_on:
             flops0 = METRICS.unet_flops_snapshot()
             t0_dev = time.perf_counter()
         latents = engine._denoise_range(
             rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
-            width, height, 0, rp.steps, "txt2img", None, None, ())
+            width, height, 0, rp.steps, "txt2img", None, None, (),
+            ragged=ragged_arg)
         self._drain_cache_notes(live[0].request_id, embed=False)
         if perf_on:
+            # masked pixels: resident tail rows the ragged kernel skips —
+            # reported separately so padding attribution can split masked
+            # residency from compute padding
+            masked_px = 0
+            if ragged_mode:
+                masked_px = (h * b_run - sum(true_rows_l)) * f * width
             obs_perf.LEDGER.record_dispatch(
                 bucket=f"{width}x{height}", cadence=int(g.key[8]),
                 precision=str(g.key[-1]),
@@ -814,7 +916,9 @@ class ServingDispatcher:
                 requests=len(live), batch_raw=b_raw, batch_run=b_run,
                 true_pixels=sum(t.payload.width * t.payload.height * n_p
                                 for t, n_p in zip(live, counts)),
-                padded_pixels=width * height * b_run)
+                padded_pixels=width * height * b_run,
+                masked_pixels=masked_px,
+                true_tokens=true_tok, padded_tokens=padded_tok)
         entries = engine._queue_decoded(latents, 0, b_raw, width, height)
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)
@@ -834,7 +938,13 @@ class ServingDispatcher:
                     continue
                 out = GenerationResult(parameters=t.payload.model_dump())
                 ow, oh = t.payload.width, t.payload.height
-                if t.bucketed:
+                if t.bucketed and ragged_mode:
+                    # ragged rows are TOP-aligned (valid latent rows form
+                    # a prefix); only the width snap center-crops
+                    rows = np.stack(
+                        [self.bucketer.crop_ragged(im, ow, oh)
+                         for im in rows])
+                elif t.bucketed:
                     rows = np.stack(
                         [self.bucketer.crop(im, ow, oh) for im in rows])
                 engine._append_images(out, t.payload, rows, 0, n_p, ow, oh)
@@ -864,12 +974,15 @@ class ServingDispatcher:
 
         orig = ticket.payload
         bw, bh = ticket.run.width, ticket.run.height
+        crop = self.bucketer.crop_ragged \
+            if self.engine._ragged_plan(ticket.run) is not None \
+            else self.bucketer.crop
         for i, b64 in enumerate(result.images):
             arr = b64png_to_array(b64)
             if arr.shape[:2] != (bh, bw):
                 continue  # hires/second-pass output: not bucket-sized
             result.images[i] = array_to_b64png(
-                self.bucketer.crop(arr, orig.width, orig.height))
+                crop(arr, orig.width, orig.height))
             suffix = ""
             if i < len(result.infotexts) and \
                     result.infotexts[i].endswith(", DPM adaptive: incomplete"):

@@ -1,0 +1,26 @@
+"""Known-bad ragged-dispatch fixture (RC001).
+
+Per-row TRUE lengths are request-derived (the requested height maps to a
+valid latent-row prefix): pinning one as a jit STATIC argument mints a
+chunk executable per distinct request height — exactly the shape-ladder
+explosion ragged dispatch exists to kill. True lengths must travel as
+TRACED data (the clean variant below; ops/ragged_attention.py takes them
+as an int32 array), with only the bucket shape left static.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def chunk_bad(payload):
+    fn = jax.jit(lambda x, true_len: x * true_len, static_argnums=(1,))
+    true_len = payload.height
+    return fn(jnp.zeros(64), true_len)  # RC001: per-row length as static
+
+
+def chunk_clean(payload):
+    fn = jax.jit(lambda x, true_len: x * (jnp.arange(64) < true_len))
+    true_len = jnp.asarray(payload.height, jnp.int32)
+    return fn(jnp.zeros(64), true_len)  # clean: length rides as traced data

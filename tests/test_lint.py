@@ -114,6 +114,14 @@ class TestFixtures:
         found = _rule_lines(_fixture_findings("cadence_bad.py"))
         assert found == {("RC001", 24)}
 
+    def test_ragged_family(self):
+        # the ragged-dispatch length discipline: a request-derived
+        # per-row true length pinned static re-mints an executable per
+        # height (the ladder explosion ragged dispatch kills); the
+        # traced-int32 variant in the same fixture must stay clean
+        found = _rule_lines(_fixture_findings("ragged_bad.py"))
+        assert found == {("RC001", 20)}
+
     def test_precision_family(self):
         # the serving-precision discipline (RC003): raw env / override /
         # payload-attribute precision reads bypass the 3-rung ladder in

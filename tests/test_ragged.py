@@ -1,0 +1,304 @@
+"""Ragged dispatch: pallas ragged attention + true-length batching.
+
+Kernel half: the interpret-mode pallas kernel (ops/ragged_attention.py)
+against its dense masked reference across head dims, block shapes and
+non-divisor true lengths — plus the contract that the CPU default path IS
+the reference (bit-exact, so tier-1 goldens cannot drift).
+
+Serving half: under SDTPU_RAGGED, mixed-height traffic on one coarse
+bucket coalesces into ONE group and ONE chunk executable while every
+request stays byte-identical to running alone; with the knob unset the
+default path is hash-pinned via the goldens mechanism.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.ops.ragged_attention import (
+    ragged_attention, ragged_attention_reference,
+)
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload, b64png_to_array,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+from test_goldens import _check
+from test_pipeline import init_params
+
+RNG = np.random.default_rng(7)
+
+
+def qkv(b, t, h, d, s=None):
+    s = t if s is None else s
+    q = jnp.asarray(RNG.standard_normal((b, t, h, d), np.float32))
+    k = jnp.asarray(RNG.standard_normal((b, s, h, d), np.float32))
+    v = jnp.asarray(RNG.standard_normal((b, s, h, d), np.float32))
+    return q, k, v
+
+
+def tl(*lens):
+    return jnp.asarray(lens, jnp.int32)
+
+
+def payload(**kw):
+    defaults = dict(prompt="a cow", steps=4, width=32, height=32,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+class TestRaggedKernel:
+    # head dim 40 (SD15's 8-head 320-ch blocks) alongside the tiling-
+    # friendly powers of two
+    @pytest.mark.parametrize("d", [16, 32, 40, 64])
+    def test_matches_reference_across_head_dims(self, d):
+        q, k, v = qkv(3, 256, 2, d)
+        lens = tl(256, 130, 77)
+        out = ragged_attention(q, k, v, lens, block_q=128, block_k=128,
+                               interpret=True)
+        ref = ragged_attention_reference(q, k, v, lens, q_true_len=lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    # lengths that straddle tile boundaries every way a prefix can:
+    # exactly one tile, one past, one short, and a single valid token
+    @pytest.mark.parametrize("lens", [(256, 77, 130, 1),
+                                      (129, 128, 127, 255)])
+    def test_non_divisor_true_lengths(self, lens):
+        q, k, v = qkv(len(lens), 256, 2, 32)
+        out = ragged_attention(q, k, v, tl(*lens), block_q=128,
+                               block_k=128, interpret=True)
+        ref = ragged_attention_reference(q, k, v, tl(*lens),
+                                         q_true_len=tl(*lens))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_full_length_equals_dense(self):
+        # true_len == bucket: ragged must reduce to plain attention
+        q, k, v = qkv(2, 128, 4, 32)
+        out = ragged_attention(q, k, v, tl(128, 128), block_q=64,
+                               block_k=64, interpret=True)
+        dense = jax.nn.dot_product_attention(q, k, v, scale=1 / 32 ** 0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_mixed_rows_match_per_row_dense(self):
+        # each row's valid prefix equals dense attention over JUST that
+        # prefix, and the padded tail comes out exactly zero
+        q, k, v = qkv(4, 256, 2, 32)
+        lens = (256, 192, 100, 33)
+        out = np.asarray(ragged_attention(q, k, v, tl(*lens), block_q=64,
+                                          block_k=64, interpret=True))
+        for b, n in enumerate(lens):
+            dense = jax.nn.dot_product_attention(
+                q[b:b + 1, :n], k[b:b + 1, :n], v[b:b + 1, :n],
+                scale=1 / 32 ** 0.5)
+            np.testing.assert_allclose(out[b, :n], np.asarray(dense[0]),
+                                       rtol=2e-5, atol=2e-5)
+            assert np.all(out[b, n:] == 0.0)
+
+    def test_padded_kv_tail_is_inert(self):
+        # garbage in the padded k/v tail must not perturb valid outputs:
+        # masked probabilities are exactly 0.0, so the fold is bitwise
+        # identical
+        q, k, v = qkv(2, 128, 2, 16)
+        lens = tl(100, 64)
+        base = ragged_attention(q, k, v, lens, block_q=64, block_k=64,
+                                interpret=True)
+        k2 = k.at[0, 100:].set(1e4).at[1, 64:].set(-1e4)
+        v2 = v.at[0, 100:].set(-1e4).at[1, 64:].set(1e4)
+        pert = ragged_attention(q, k2, v2, lens, block_q=64, block_k=64,
+                                interpret=True)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(pert))
+
+    def test_non_tiling_falls_back_to_reference(self):
+        # t=100 doesn't tile at block 64 -> the dense reference runs, so
+        # equality is exact, not approximate
+        q, k, v = qkv(2, 100, 2, 16)
+        lens = tl(100, 40)
+        out = ragged_attention(q, k, v, lens, block_q=64, block_k=64,
+                               interpret=True)
+        ref = ragged_attention_reference(q, k, v, lens, q_true_len=lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_cpu_default_path_is_reference(self):
+        # off-TPU with interpret unspecified the execution path IS the
+        # oracle — the bit-exactness tier-1 goldens rely on
+        q, k, v = qkv(1, 128, 2, 16)
+        lens = tl(57)
+        out = ragged_attention(q, k, v, lens)
+        ref = ragged_attention_reference(q, k, v, lens, q_true_len=lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_jittable_with_traced_lengths(self):
+        # true_len must be usable as traced data (RC001: lengths are NOT
+        # compile-key statics) — one trace serves different length vectors
+        q, k, v = qkv(2, 128, 2, 16)
+        traces = []
+
+        @jax.jit
+        def f(a, b, c, n):
+            traces.append(None)
+            return ragged_attention(a, b, c, n, block_q=64, block_k=64,
+                                    interpret=True)
+
+        for lens in (tl(128, 7), tl(33, 90)):
+            ref = ragged_attention_reference(q, k, v, lens,
+                                             q_true_len=lens)
+            np.testing.assert_allclose(np.asarray(f(q, k, v, lens)),
+                                       np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+        assert len(traces) == 1  # second length vector reused the trace
+
+
+class TestRaggedBucketer:
+    def test_bucket_shape_ragged_tallest_in_width_class(self):
+        b = ShapeBucketer(shapes=[(64, 16), (64, 64), (96, 48)],
+                          batches=[1])
+        # width class 64 tops out at height 64 — every shorter request
+        # shares that executable
+        assert b.bucket_shape_ragged(64, 20) == (64, 64)
+        assert b.bucket_shape_ragged(48, 64) == (64, 64)
+        assert b.bucket_shape_ragged(80, 40) == (96, 48)
+        assert b.bucket_shape_ragged(80, 64) is None  # no class holds it
+
+    def test_ragged_ladder_env_override(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_RAGGED_LADDER", "64x64")
+        b = ShapeBucketer(shapes=[(32, 32), (48, 48)], batches=[1])
+        assert b.bucket_shape_ragged(40, 40) == (64, 64)
+        assert b.bucket_shape(40, 40) == (48, 48)  # classic path untouched
+
+    def test_padding_ratio_modes(self, monkeypatch):
+        b = ShapeBucketer(shapes=[(64, 64)], batches=[4])
+        monkeypatch.delenv("SDTPU_RAGGED", raising=False)
+        # classic: full area ratio; batch padding multiplies in when given
+        assert b.padding_ratio(32, 16) == pytest.approx(8.0)
+        assert b.padding_ratio(32, 16, batch=1) == pytest.approx(32.0)
+        assert b.padding_ratio(64, 64, batch=3) == pytest.approx(4 / 3)
+        # ragged: only the width snap is computed — tail rows are masked
+        monkeypatch.setenv("SDTPU_RAGGED", "1")
+        assert b.padding_ratio(32, 16) == pytest.approx(2.0)
+        assert b.padding_ratio(64, 16) == pytest.approx(1.0)
+
+    def test_marker_stamped_with_true_dims(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_RAGGED", "1")
+        b = ShapeBucketer(shapes=[(64, 64)], batches=[1])
+        run, bucketed = b.bucket_payload(payload(width=48, height=32),
+                                         ragged=True)
+        assert bucketed and (run.width, run.height) == (64, 64)
+        assert run.override_settings["ragged_true_wh"] == [48, 32]
+        # exact hit: still marked (shares the ragged executable), but the
+        # classic entry point never mints the marker
+        exact, _ = b.bucket_payload(payload(width=64, height=64),
+                                    ragged=True)
+        assert exact.override_settings["ragged_true_wh"] == [64, 64]
+        classic, _ = b.bucket_payload(payload(width=48, height=32))
+        assert "ragged_true_wh" not in (classic.override_settings or {})
+
+    def test_crop_ragged_top_aligned(self):
+        img = np.arange(64 * 64 * 3, dtype=np.int64).astype(
+            np.uint8).reshape(64, 64, 3)
+        back = ShapeBucketer.crop_ragged(img, 48, 32)
+        assert back.shape == (32, 48, 3)
+        # rows top-aligned (valid prefix), columns center-cropped
+        np.testing.assert_array_equal(back, img[:32, 8:56])
+        assert ShapeBucketer.crop_ragged(img, 64, 64) is img
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState())
+
+
+class TestRaggedDispatch:
+    # three heights in ONE 64-wide class: the whole point is that they
+    # share a single executable
+    SHAPES = [(64, 64), (64, 48), (48, 32)]
+
+    def _payloads(self):
+        return [payload(width=w, height=h, seed=200 + i,
+                        prompt=f"ragged cow {i}")
+                for i, (w, h) in enumerate(self.SHAPES)]
+
+    def test_mixed_heights_one_group_byte_exact(self, engine, monkeypatch):
+        monkeypatch.setenv("SDTPU_RAGGED", "1")
+        bucketer = ShapeBucketer(shapes=[(64, 64)], batches=[1, 2, 4])
+        coalesced = ServingDispatcher(engine, bucketer=bucketer,
+                                      window=0.6)
+        solo = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+
+        METRICS.clear()
+        results = [None] * len(self.SHAPES)
+        errors = []
+
+        def run(i, p):
+            try:
+                results[i] = coalesced.submit(p)
+            except Exception as e:  # noqa: BLE001 — surfaced by assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i, p))
+                   for i, p in enumerate(self._payloads())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        s = METRICS.summary()
+        # three raw shapes -> ONE group, ONE ragged chunk executable
+        assert s["dispatches"] == 1
+        assert s["coalesced_dispatches"] == 1
+        assert s["compiles"].get("chunk", 0) == 1
+
+        # every image cropped back to its requested size
+        for r, (w, h) in zip(results, self.SHAPES):
+            assert b64png_to_array(r.images[0]).shape == (h, w, 3)
+            assert f"Size: {w}x{h}" in r.infotexts[0]
+
+        # byte-identical to running each request alone (solo adds only
+        # the batch-1 variant of the same ragged executable)
+        for got, p in zip(results, self._payloads()):
+            want = solo.submit(p)
+            assert got.seeds == want.seeds
+            assert got.images == want.images  # pixel bytes, not shapes
+        assert METRICS.summary()["compiles"].get("chunk", 0) == 2
+
+    def test_stepcache_work_stays_classic(self, engine, monkeypatch):
+        # deep-feature carry assumes dense rows: a step-cache request is
+        # ragged-ineligible and must NOT carry the marker
+        monkeypatch.setenv("SDTPU_RAGGED", "1")
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(64, 64)], batches=[1]),
+            window=0.0)
+        p = payload(width=64, height=48,
+                    override_settings={"deepcache": 2})
+        assert not disp._ragged_eligible(p)
+        assert disp._ragged_eligible(payload(width=64, height=48))
+
+    def test_default_off_path_hash_pinned(self, engine, monkeypatch):
+        # SDTPU_RAGGED unset: the serving path must stay byte-identical
+        # across refactors — frozen through the goldens mechanism
+        monkeypatch.delenv("SDTPU_RAGGED", raising=False)
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(payload(width=32, height=32, seed=1234,
+                                prompt="a golden cow"))
+        _check("serving/ragged-off-default", r)

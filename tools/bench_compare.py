@@ -40,6 +40,11 @@ THRESHOLDS = {
     "chunk_compiles": ("up", "abs", 0.0),
     "coalesce_factor": ("down", "rel", 0.10),
     "avg_padding_ratio": ("up", "rel", 0.05),
+    # ragged rows (bench.py run_ragged): conditioning token padding is
+    # structural for the fixed prompt mix, and the census alarm firing at
+    # all means the executable budget contract broke
+    "token_padding_ratio": ("up", "rel", 0.05),
+    "census_alarm": ("up", "abs", 0.0),
     "bucket_hit_rate": ("down", "abs", 0.10),
     "unet_flops_per_image": ("up", "rel", 0.02),
     "slo_attainment": ("down", "abs", 0.10),
