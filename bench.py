@@ -1506,6 +1506,285 @@ def run_watchdog(tiny):
     return out
 
 
+def _scenario_mix(dispatcher, size, steps, n=4):
+    """Record the scenario base mix: ``n`` distinct requests through the
+    dispatcher with the journal on. Returns the journaled (payload,
+    arrival) mix every scenario replays scaled — and warms the engine's
+    executable so scenario latencies exclude compile time."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        journal as obs_journal,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        workload as sim_workload,
+    )
+
+    obs_journal.JOURNAL.clear()
+    for i in range(n):
+        dispatcher.submit(GenerationPayload(
+            prompt=f"scenario base mix {i}",
+            negative_prompt="blurry, low quality",
+            steps=steps, width=size, height=size, seed=400 + i,
+            sampler_name="Euler a", request_id=f"record-{i:03d}"))
+    snapshot = obs_journal.JOURNAL.snapshot()
+    mix = sim_workload.base_mix(snapshot["events"])
+    obs_journal.JOURNAL.clear()
+    return mix
+
+
+def _scenario_steady(engine, bucketer, mix, seed, slo_s):
+    """Steady-state: the recorded mix resampled to 3x its size at a
+    steady scaled rate through a fresh dispatcher."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        journal as obs_journal, perf as obs_perf,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        score as sim_score, workload as sim_workload,
+    )
+
+    spec = sim_workload.WorkloadSpec(seed=seed, count=3 * len(mix),
+                                     rate_scale=4.0)
+    plan = sim_workload.generate_plan(mix, spec)
+    obs_perf.LEDGER.clear()
+    dispatcher = ServingDispatcher(engine, bucketer=bucketer, window=0.02)
+    records = sim_workload.emit_open_loop(plan, dispatcher.submit)
+    events = obs_journal.JOURNAL.snapshot()["events"]
+    score = sim_score.score_run(
+        records, events=events, ledger=obs_perf.LEDGER.summary(),
+        slo_s_by_class={"interactive": slo_s})
+    score["plan_fingerprint"] = sim_workload.plan_fingerprint(plan)
+    obs_journal.JOURNAL.clear()
+    return score
+
+
+def _scenario_burst(engine, bucketer, mix, seed, slo_s):
+    """Flash burst under the fleet gate: diverse tenants/classes with a
+    simultaneous-arrival burst at mid-run; per-(tenant, class) SLO
+    attainment/burn comes from the real perf ledger."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        journal as obs_journal, perf as obs_perf,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        score as sim_score, workload as sim_workload,
+    )
+
+    spec = sim_workload.WorkloadSpec(
+        seed=seed + 1, count=2 * len(mix), rate_scale=2.0,
+        burst_size=4, burst_at=0.5,
+        tenants=["alice", "batch-corp"],
+        classes=["interactive", "batch"])
+    plan = sim_workload.generate_plan(mix, spec)
+    obs_perf.LEDGER.clear()
+    with _EnvPatch(SDTPU_FLEET="1", SDTPU_FLEET_QUANTUM_S="0",
+                   SDTPU_QUOTA_IPM="240", SDTPU_QUOTA_BURST="8",
+                   SDTPU_SLO_INTERACTIVE_S=str(slo_s)):
+        dispatcher = ServingDispatcher(engine, bucketer=bucketer,
+                                       window=0.02)
+        records = sim_workload.emit_open_loop(plan, dispatcher.submit)
+    events = obs_journal.JOURNAL.snapshot()["events"]
+    score = sim_score.score_run(
+        records, events=events, ledger=obs_perf.LEDGER.summary(),
+        slo_s_by_class={"interactive": slo_s, "batch": 4 * slo_s})
+    score["plan_fingerprint"] = sim_workload.plan_fingerprint(plan)
+    obs_journal.JOURNAL.clear()
+    return score
+
+
+def _scenario_chaos(seed):
+    """Chaos kill: stub two-worker World, a scripted kill on one worker
+    at request 1. The kill lands in the existing failure path, the
+    scheduler requeues the dead range onto the survivor, and the scorer
+    audits full recovery with zero double-merged images from the
+    journal + delivered result."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        journal as obs_journal,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend, StubBehavior, WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        chaos as sim_chaos, score as sim_score,
+    )
+
+    obs_journal.JOURNAL.clear()
+    w = World(ConfigModel())
+    w.add_worker(WorkerNode(
+        "survivor", StubBackend(StubBehavior(seconds_per_image=0.001)),
+        avg_ipm=2400.0))
+    w.add_worker(WorkerNode(
+        "victim", StubBackend(StubBehavior(seconds_per_image=0.001)),
+        avg_ipm=2400.0))
+    plan = sim_chaos.ChaosPlan(
+        [sim_chaos.Fault(kind="kill", worker="victim", at_request=1)],
+        seed=seed)
+    sim_chaos.arm(plan)
+    try:
+        p = GenerationPayload(prompt="chaos kill", steps=8, width=512,
+                              height=512, batch_size=4, seed=77,
+                              request_id="chaos-kill-000")
+        t0 = time.perf_counter()
+        result = w.execute(p)
+        latency = time.perf_counter() - t0
+    finally:
+        sim_chaos.disarm()
+    records = [{"request_id": "chaos-kill-000", "class": "interactive",
+                "tenant": "default", "status": "completed",
+                "expected": p.total_images,
+                "images": len(result.images), "latency_s": latency}]
+    events = obs_journal.JOURNAL.snapshot()["events"]
+    score = sim_score.score_run(records, events=events)
+    score["chaos_plan"] = plan.status()
+    obs_journal.JOURNAL.clear()
+    return score
+
+
+def _scenario_sweep(engine, mix, seed, size, slo_s):
+    """Capacity sweep: the same replayed mix under three candidate
+    configs (coalesce cadence x batch ladder); ranked by worst-class SLO
+    attainment, then p95, then compiles."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        perf as obs_perf,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        score as sim_score, sweep as sim_sweep, workload as sim_workload,
+    )
+
+    spec = sim_workload.WorkloadSpec(seed=seed + 2, count=2 * len(mix),
+                                     rate_scale=4.0)
+    plan = sim_workload.generate_plan(mix, spec)
+    configs = {
+        "solo_b1": {"window": 0.0, "batches": [1]},
+        "coalesce_b2": {"window": 0.02, "batches": [2]},
+        "coalesce_b4": {"window": 0.05, "batches": [4]},
+    }
+
+    def runner(name, cfg):
+        obs_perf.LEDGER.clear()
+        bucketer = ShapeBucketer(shapes=[(size, size)],
+                                 batches=list(cfg["batches"]))
+        dispatcher = ServingDispatcher(engine, bucketer=bucketer,
+                                       window=float(cfg["window"]))
+        records = sim_workload.emit_open_loop(plan, dispatcher.submit)
+        return sim_score.score_run(
+            records, ledger=obs_perf.LEDGER.summary(),
+            slo_s_by_class={"interactive": slo_s})
+
+    out = sim_sweep.run_sweep(configs, runner)
+    out["plan_fingerprint"] = sim_workload.plan_fingerprint(plan)
+    return out
+
+
+def run_scenarios(tiny):
+    """--scenarios: the scenario-matrix regression suite (sim/). Records
+    a small journal mix through the real dispatcher, then replays it
+    through three scenarios — steady state, flash burst under the fleet
+    gate, and a chaos worker-kill on the scheduler tier — scoring each
+    from the journal + perf ledger, and finishes with a capacity sweep
+    (coalesce cadence x batch ladder) over the same mix. Writes
+    BENCH_scenarios.json and appends one ledger row per scenario
+    (kinds scenario_steady / scenario_burst / scenario_chaos), all
+    gated by tools/bench_compare.py. Deterministic from SDTPU_SIM_SEED;
+    CPU-safe."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu import sim
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_int,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+
+    dev = jax.devices()[0]
+    cpu = tiny or dev.platform == "cpu"
+    family = C.TINY if cpu else C.SD15
+    size, steps = (64, 4) if cpu else (512, 20)
+    slo_s = 10.0 if cpu else 30.0
+    seed = env_int("SDTPU_SIM_SEED", 0)
+
+    with _EnvPatch(SDTPU_SIM="1", SDTPU_JOURNAL="1", SDTPU_PERF="1",
+                   SDTPU_CHUNK="2" if cpu else "5"):
+        engine = _make_engine(family)
+        bucketer = ShapeBucketer(shapes=[(size, size)], batches=[2])
+        recorder = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        mix = _scenario_mix(recorder, size, steps)
+        if not mix:
+            raise RuntimeError("journal recorded no replayable mix")
+
+        scenarios = {
+            "steady": _scenario_steady(engine, bucketer, mix, seed, slo_s),
+            "flash_burst": _scenario_burst(engine, bucketer, mix, seed,
+                                           slo_s),
+            "chaos_kill": _scenario_chaos(seed),
+        }
+        sweep = _scenario_sweep(engine, mix, seed, size, slo_s)
+        for name, score in scenarios.items():
+            sim.record_last_run(name, score)
+
+    out = {
+        "seed": seed,
+        "recorded_mix": len(mix),
+        "scenarios": scenarios,
+        "sweep": sweep,
+        "device": dev.device_kind,
+        "tiny": bool(tiny),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_scenarios.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"bench: scenario matrix written to {path} "
+          f"(gate with tools/bench_compare.py)", file=sys.stderr)
+
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        score as sim_score,
+    )
+
+    recorded_at = time.time()
+    rows = [
+        _ledger_row(f"scenario_{kind}",
+                    sim_score.ledger_metrics(scenarios[name]),
+                    dev.device_kind if name != "chaos_kill" else "stub",
+                    tiny, recorded_at)
+        for name, kind in (("steady", "steady"),
+                           ("flash_burst", "burst"),
+                           ("chaos_kill", "chaos"))
+    ]
+    lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LEDGER.jsonl")
+    with open(lpath, "a", encoding="utf-8") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"bench: {len(rows)} scenario ledger rows appended to {lpath}",
+          file=sys.stderr)
+    return out
+
+
 def _ledger_row(kind, metrics, device, tiny, recorded_at):
     """One append-only BENCH_LEDGER.jsonl row. ``schema`` versions the row
     shape; ``metrics`` holds only platform-independent structural numbers
@@ -1629,6 +1908,13 @@ def main() -> None:
                     help="hang-watchdog/requeue structural microbench "
                          "(stub workers, no device); writes "
                          "BENCH_watchdog.json (CPU-safe)")
+    ap.add_argument("--scenarios", action="store_true",
+                    help="scenario-matrix regression suite (sim/): "
+                         "record a journal mix, replay it through "
+                         "steady / flash-burst / chaos-kill scenarios "
+                         "and a capacity sweep; writes "
+                         "BENCH_scenarios.json + per-scenario ledger "
+                         "rows (CPU-safe)")
     ap.add_argument("--ledger", action="store_true",
                     help="run the serving, fleet and watchdog microbenches "
                          "with the perf ledger on and append structural "
@@ -1675,6 +1961,8 @@ def main() -> None:
             print(json.dumps(run_fleet(tiny)))
         elif args.watchdog:
             print(json.dumps(run_watchdog(tiny)))
+        elif args.scenarios:
+            print(json.dumps(run_scenarios(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
         elif args.ragged:

@@ -23,6 +23,12 @@ emitting an unregistered type raises, and lint rule OB003 enforces at the
 AST level that literals passed to ``emit()`` outside this module are
 members of :data:`EVENTS`.
 
+The ring silently drops history on runs longer than its capacity;
+``SDTPU_JOURNAL_SINK=<path>`` spills every ring-evicted event to that
+file as one JSONL line, so ring + sink together stay a complete record
+on long scenario runs (``tools/replay.py`` and ``sim/workload.py`` load
+sink files as well as snapshots).
+
 Served at ``GET /internal/journal[?request_id=]``.
 """
 
@@ -35,7 +41,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
-from ..runtime.config import env_flag, env_int
+from ..runtime.config import env_flag, env_int, env_str
 
 #: The closed set of journal event types. Serving-tier lifecycle first,
 #: then the scheduler/worker tier, then the health/watchdog plane.
@@ -68,6 +74,9 @@ EVENTS = frozenset({
     # health / watchdog plane
     "watchdog_stall",
     "worker_state",
+    # scenario engine / chaos tier (sim/chaos.py)
+    "fault_injected",
+    "fault_cleared",
 })
 
 DEFAULT_CAPACITY = 4096
@@ -79,6 +88,12 @@ _PARENT_INDEX_CAP = 256
 def enabled() -> bool:
     """Journal gate — re-read per call so tests can flip the env var."""
     return env_flag("SDTPU_JOURNAL", False)
+
+
+def sink_path() -> str:
+    """Spill file for ring-evicted events ('' = no sink). Re-read per
+    call so scenario runs can point successive phases at fresh files."""
+    return env_str("SDTPU_JOURNAL_SINK", "")
 
 
 def fingerprint(obj: Any) -> str:
@@ -99,6 +114,10 @@ class EventJournal:
         self._seq = 0                                      # guarded-by: _lock
         # request_id -> seq of its latest event, for causal chaining
         self._last_by_rid: OrderedDict = OrderedDict()     # guarded-by: _lock
+        # Sink spill state kept under its own lock so the file write
+        # never happens while _lock is held.
+        self._sink_lock = threading.Lock()
+        self._sink_spilled = 0                             # guarded-by: _sink_lock
 
     def emit(self, event: str, request_id: str,
              parent: Optional[int] = None,
@@ -116,6 +135,8 @@ class EventJournal:
                              f"add it to obs.journal.EVENTS")
         rid = str(request_id)
         t_mono = time.monotonic()
+        sink = sink_path()
+        spill = None
         with self._lock:
             self._seq += 1
             if parent is None:
@@ -128,12 +149,35 @@ class EventJournal:
                 "parent": parent,
                 "attrs": dict(attrs),
             }
+            if sink and len(self._events) == self._events.maxlen:
+                spill = self._events[0]
             self._events.append(entry)
             self._last_by_rid[rid] = self._seq
             self._last_by_rid.move_to_end(rid)
             while len(self._last_by_rid) > _PARENT_INDEX_CAP:
                 self._last_by_rid.popitem(last=False)
+        if spill is not None:
+            self._spill(sink, spill)
         return entry
+
+    def _spill(self, sink: str, entry: Dict[str, Any]) -> None:
+        """Best-effort JSONL append of one evicted event. Concurrent
+        evictions may land out of seq order; sink consumers sort by seq."""
+        try:
+            line = json.dumps(entry, sort_keys=True, default=str)
+            with self._sink_lock:
+                with open(sink, "a", encoding="utf-8") as fh:
+                    fh.write(line + "\n")
+                self._sink_spilled += 1
+        except OSError:
+            pass
+
+    def sink_status(self) -> Dict[str, Any]:
+        """Sink configuration + spill count (surfaced via /internal/sim;
+        kept out of snapshot(), whose schema is pinned by tests)."""
+        with self._sink_lock:
+            spilled = self._sink_spilled
+        return {"path": sink_path(), "spilled": spilled}
 
     def events_for(self, request_id: str) -> List[Dict[str, Any]]:
         """The journal slice for one request, in seq order."""
@@ -163,6 +207,8 @@ class EventJournal:
             self._events.clear()
             self._last_by_rid.clear()
             self._seq = 0
+        with self._sink_lock:
+            self._sink_spilled = 0
 
     def __len__(self) -> int:
         with self._lock:

@@ -239,6 +239,8 @@ def clear_histograms() -> None:
         c.clear()
     WATCHDOG_COUNTER.clear()
     CACHE_COUNTER.clear()
+    SIM_FAULT_COUNTER.clear()
+    set_sim_slo_burn(None)
     with _WORKER_LOCK:
         _WORKER_LATENCY_EWMA.clear()
 
@@ -382,6 +384,38 @@ def cache_count(layer: str, outcome: str, n: float = 1.0) -> None:
     embed_neg, result, prefix), ``outcome`` what happened there (hit,
     miss, joined, resumed, captured)."""
     CACHE_COUNTER.inc(n, layer=layer, outcome=outcome)
+
+
+# -- scenario engine (sim/: chaos injection + SLO scoring) -------------------
+
+#: Chaos faults actually delivered by sim/chaos.py, by fault kind
+#: (kill / stall / slow / http_error). Zero outside scenario runs.
+SIM_FAULT_COUNTER = LabeledCounter(
+    "sdtpu_sim_faults_total",
+    "Chaos faults injected by the scenario engine (SDTPU_SIM) by kind.",
+    ("kind",))
+
+_SIM_LOCK = threading.Lock()
+#: worst per-(tenant, class) SLO burn rate from the last scored scenario
+#: run; None until sim/score.py scores one, omitted from /internal/metrics
+#: while None.
+_SIM_SLO_BURN: Optional[float] = None  # guarded-by: _SIM_LOCK
+
+
+def sim_fault_count(kind: str, n: float = 1.0) -> None:
+    SIM_FAULT_COUNTER.inc(n, kind=kind)
+
+
+def set_sim_slo_burn(value: Optional[float]) -> None:
+    """Record the last scenario run's worst SLO burn rate (sim/score.py)."""
+    global _SIM_SLO_BURN
+    with _SIM_LOCK:
+        _SIM_SLO_BURN = None if value is None else float(value)
+
+
+def sim_slo_burn() -> Optional[float]:
+    with _SIM_LOCK:
+        return _SIM_SLO_BURN
 
 _WORKER_LOCK = threading.Lock()
 #: per-worker generate-latency EWMA gauge values
@@ -684,6 +718,12 @@ def render() -> str:
         lines.extend(c.render())
     lines.extend(WATCHDOG_COUNTER.render())
     lines.extend(CACHE_COUNTER.render())
+    lines.extend(SIM_FAULT_COUNTER.render())
+    burn = sim_slo_burn()
+    if burn is not None:
+        _scalar(lines, "sdtpu_sim_slo_burn", "gauge",
+                "Worst per-(tenant, class) SLO burn rate from the last "
+                "scored scenario run (sim/score.py).", burn)
     with _WORKER_LOCK:
         worker_lat = dict(_WORKER_LATENCY_EWMA)
     _labeled_family(

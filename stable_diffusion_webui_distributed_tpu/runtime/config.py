@@ -167,6 +167,21 @@ def _logger():
 # - ``SDTPU_JOURNAL_MAX`` (int, default 4096): journal ring capacity in
 #   events; oldest events are dropped first (the ring never blocks or
 #   grows unbounded).
+# - ``SDTPU_JOURNAL_SINK`` (path, default '' = off): JSONL spill file
+#   for ring-evicted journal events — each event the ring drops is
+#   appended as one JSON line (best-effort; write errors are swallowed),
+#   so ring + sink stay a complete record on runs longer than the ring.
+#   ``tools/replay.py`` and ``sim/workload.py`` load sink files directly.
+# - ``SDTPU_SIM`` (flag, default off): the scenario engine (sim/).
+#   When 1, chaos fault plans may be armed into the CHAOS_HOOK seams
+#   (scheduler/worker.py, scheduler/world.py, serving/dispatcher.py) and
+#   scenario runs are scored/recorded at ``/internal/sim``. Off (the
+#   default), sim.chaos.arm refuses, every hook stays None, and the
+#   serving/scheduler paths are byte-identical to the ungated build
+#   (hash-pinned in tests/test_sim.py).
+# - ``SDTPU_SIM_SEED`` (int, default 0): default seed for workload
+#   generation and chaos plans in ``bench.py --scenarios`` — one seed
+#   reproduces the whole scenario matrix byte-for-byte.
 # - ``SDTPU_HEARTBEAT_S`` (float seconds, default 0 = off): worker
 #   heartbeat prober period — a daemon sweep of ``ping_workers`` so an
 #   UNAVAILABLE remote recovers to IDLE (and its health window updates)

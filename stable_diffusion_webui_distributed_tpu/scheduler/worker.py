@@ -153,6 +153,14 @@ class WorkerHealth:
             }
 
 
+#: Sanctioned chaos-injection hook (sim/chaos.py). When armed, it is
+#: consulted inside :meth:`WorkerNode.request`'s try-block just before
+#: ``backend.generate`` — a raised exception lands in the existing
+#: failure/demote/requeue path, a sleep models a stall or slow worker.
+#: ``None`` (the default) costs one identity check on the hot path.
+CHAOS_HOOK = None
+
+
 class Backend(Protocol):
     """What a schedulable backend must provide."""
 
@@ -345,6 +353,9 @@ class WorkerNode:
             with obs_spans.span("worker.generate", worker=self.label,
                                 start=int(start_index), count=int(count),
                                 predicted_s=predicted) as wsp:
+                if CHAOS_HOOK is not None:
+                    CHAOS_HOOK("worker.generate", worker=self.label,
+                               payload=payload, count=int(count))
                 result = self.backend.generate(payload, start_index, count)
         except Exception as e:  # noqa: BLE001 — any backend failure demotes
             log.error("worker '%s' failed request: %s", self.label, e)

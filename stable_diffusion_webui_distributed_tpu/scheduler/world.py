@@ -84,6 +84,13 @@ class Job:
 #: (/root/reference/scripts/distributed.py:207-212).
 SELF_LOOPING_SCRIPTS = frozenset({"adetailer", "ddetailer", "ddsd"})
 
+#: Sanctioned chaos-injection hook (sim/chaos.py). When armed, it is
+#: consulted once at the top of :meth:`World.execute` per request —
+#: this is where step-indexed fault plans ("kill worker X at request N")
+#: advance their request counter. ``None`` (the default) costs one
+#: identity check.
+CHAOS_HOOK = None
+
 
 class World:
     """Backend registry + job planner + request executor."""
@@ -435,6 +442,8 @@ class World:
         )
 
         log = get_logger()
+        if CHAOS_HOOK is not None:
+            CHAOS_HOOK("world.execute", payload=payload)
         # a new top-level request resets the interrupt latch (webui clears
         # shared.state the same way at generation start) — otherwise a past
         # interrupt would make every remote's in-flight watchdog abort the

@@ -598,6 +598,14 @@ class ApiServer:
             return {"enabled": False}
         return cache.summary()
 
+    def handle_sim(self) -> Dict[str, Any]:
+        """Scenario-engine state (sim/): gate, journal sink spill status,
+        armed chaos plan, and the last scored run. ``enabled`` is False
+        until SDTPU_SIM=1 (the summary itself is always served)."""
+        from stable_diffusion_webui_distributed_tpu import sim
+
+        return sim.summary()
+
     def handle_executables(self) -> Dict[str, Any]:
         """Live compiled-executable census against the serving budget of
         <=2 step-cache x <=3 precision variants per shape bucket; the
@@ -853,6 +861,7 @@ class ApiServer:
             ("GET", "/internal/flightrec"): self.handle_flightrec,
             ("GET", "/internal/perf"): self.handle_perf,
             ("GET", "/internal/cache"): self.handle_cache,
+            ("GET", "/internal/sim"): self.handle_sim,
             ("GET", "/internal/executables"): self.handle_executables,
             ("GET", "/internal/autoscale"): self.handle_autoscale,
             ("GET", "/internal/profile"): self.handle_profile_get,

@@ -70,6 +70,13 @@ from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
 
 DEFAULT_COALESCE_WINDOW = 0.05
 
+#: Sanctioned chaos-injection hook (sim/chaos.py). When armed, it is
+#: consulted once per submitted request (after seed fixing, before any
+#: admission/journal work) so step-indexed fault plans advance their
+#: request counter on the serving path. ``None`` (the default) costs
+#: one identity check.
+CHAOS_HOOK = None
+
 
 def _coalesce_window(cfg=None) -> float:
     from stable_diffusion_webui_distributed_tpu.runtime.config import (
@@ -166,6 +173,8 @@ class ServingDispatcher:
         payload.subseed = fix_seed(payload.subseed)
 
         rid = str(getattr(payload, "request_id", "") or uuid.uuid4().hex)
+        if CHAOS_HOOK is not None:
+            CHAOS_HOOK("dispatcher.submit", payload=payload, rid=rid)
         # root the obs trace here for direct callers; HTTP ingress already
         # minted one for API traffic (maybe_request joins it)
         with obs_spans.maybe_request(rid, name=f"serve.{job}"):

@@ -1,0 +1,83 @@
+"""sim/ — scenario engine: journal-driven traffic simulation, chaos
+injection, and SLO-scored capacity regression.
+
+The observability plane made every request's lifecycle a replayable
+artifact (obs/journal.py + tools/replay.py) and MFU/SLO a live ledger
+(obs/perf.py); this package closes the observe→replay→perturb→score
+loop on top of them:
+
+- **workload** (:mod:`sim.workload`) — load a recorded journal (live
+  snapshot, snapshot file, or ``SDTPU_JOURNAL_SINK`` JSONL spill) or a
+  synthetic spec, and re-emit its request mix through the real
+  dispatcher/fleet path open-loop, with deterministic seeded transforms:
+  rate scaling, diurnal curves, flash bursts, shape/precision/tenant
+  diversity. A 200-request recording can drive a 5,000-request run.
+- **chaos** (:mod:`sim.chaos`) — a seeded, scenario-scripted fault plan
+  (worker kill, stall, slow response, transient HTTP error at request N)
+  delivered through the sanctioned ``CHAOS_HOOK`` seams in
+  ``scheduler/worker.py`` / ``scheduler/world.py`` /
+  ``serving/dispatcher.py``. Every delivered fault is journaled
+  (``fault_injected`` / ``fault_cleared``) and counted in
+  ``sdtpu_sim_faults_total{kind}``, so recovery is auditable.
+- **score** (:mod:`sim.score`) — score a run from the open-loop records
+  + journal + perf ledger: per-class p50/p95 and SLO attainment, requeue
+  recovery rate, double-merge audit, fault census, SLO burn, compile
+  census, padding ratios.
+- **sweep** (:mod:`sim.sweep`) — run the same replayed mix under
+  competing configs (bucket ladders, cadence policies, worker counts)
+  and emit a ranked recommendation.
+
+Everything rides on ``SDTPU_SIM`` (default OFF): chaos hooks refuse to
+arm without it and the default path is byte-identical (hash-pinned).
+``bench.py --scenarios`` runs the steady / flash-burst / chaos-kill
+matrix and commits ``BENCH_scenarios.json`` + per-scenario ledger rows
+gated by ``tools/bench_compare.py``. Live state at ``/internal/sim``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from stable_diffusion_webui_distributed_tpu.runtime.config import env_flag
+
+
+def enabled() -> bool:
+    """Scenario-engine gate — re-read per call so tests can flip it."""
+    return env_flag("SDTPU_SIM", False)
+
+
+_LOCK = threading.Lock()
+#: name + score of the most recently scored scenario run (sim/score.py
+#: records it); surfaced via /internal/sim.
+_LAST_RUN: Optional[Dict[str, Any]] = None  # guarded-by: _LOCK
+
+
+def record_last_run(name: str, score: Dict[str, Any]) -> None:
+    global _LAST_RUN
+    with _LOCK:
+        _LAST_RUN = {"name": str(name), "score": dict(score)}
+
+
+def last_run() -> Optional[Dict[str, Any]]:
+    with _LOCK:
+        return None if _LAST_RUN is None else dict(_LAST_RUN)
+
+
+def clear_last_run() -> None:
+    global _LAST_RUN
+    with _LOCK:
+        _LAST_RUN = None
+
+
+def summary() -> Dict[str, Any]:
+    """The ``/internal/sim`` document (schema pinned by tests)."""
+    from stable_diffusion_webui_distributed_tpu.obs.journal import JOURNAL
+    from stable_diffusion_webui_distributed_tpu.sim import chaos
+
+    return {
+        "enabled": enabled(),
+        "sink": JOURNAL.sink_status(),
+        "chaos": chaos.status(),
+        "last_run": last_run(),
+    }

@@ -29,3 +29,10 @@ obs_journal.emit("mysterious", "rid-2")  # sdtpu-lint: journal
 
 # OK: a plain string constant that is not a journal emit call at all
 NOTE = "completed"
+
+# Chaos-tier vocabulary pin (sim/chaos.py events): these fire here —
+# the standalone fixture analyzes with an empty registry — but are
+# accepted when analyzed beside obs/journal.py, which is the assertion
+# that fault_injected / fault_cleared joined the closed vocabulary.
+obs_journal.emit("fault_injected", "chaos-0", kind="kill")
+obs_journal.emit("fault_cleared", "chaos-0", kind="kill")

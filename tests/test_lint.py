@@ -201,6 +201,8 @@ class TestFixtures:
             ("OB003", 12),  # module-helper emit, unregistered literal
             ("OB003", 17),  # aliased helper emit inside a function
             ("OB003", 19),  # keyword spelling of the event argument
+            ("OB003", 37),  # chaos pin: unregistered without the registry
+            ("OB003", 38),  # chaos pin: unregistered without the registry
         }
         # dynamic event names, the marker-exempt literal, and plain
         # non-emit strings stay clean
@@ -217,7 +219,9 @@ class TestFixtures:
             os.path.join(FIXTURES, "journal_bad.py"),
             "stable_diffusion_webui_distributed_tpu/serving/jb.py")
         found = _rule_lines(analyze_modules([registry, caller]))
-        # the bad literals still fire; "completed"-class names would not
+        # the bad literals still fire; "completed"-class names would not,
+        # and the fault_injected/fault_cleared pins (lines 37-38) prove
+        # the chaos events are registered in the real vocabulary
         assert {f for f in found if f[0] == "OB003"} == {
             ("OB003", 12), ("OB003", 17), ("OB003", 19)}
 

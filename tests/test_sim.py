@@ -1,0 +1,513 @@
+"""Scenario engine (sim/): workload generation, chaos injection,
+scoring, sweep ranking, the journal sink, and window replay.
+
+Everything here is CPU-safe; the scheduler-tier scenarios run on stub
+workers (no device) and the byte-identity pin uses the TINY engine
+through the goldens mechanism. Covers:
+
+- deterministic workload generation (same seed → byte-identical plan),
+  the burst/diversity transforms, and loading a mix from a live
+  snapshot, a snapshot file, and a JSONL sink file;
+- the ``SDTPU_JOURNAL_SINK`` spill-on-evict contract: ring + sink stay
+  a complete record, and both ``tools/replay.py`` and the workload
+  loader read the sink;
+- chaos: arm refused at SDTPU_SIM=0, hooks None by default, a scripted
+  worker kill and a scripted stall both recovering to full delivery
+  with zero double-merged images, fault_injected/fault_cleared in the
+  journal, and ``sdtpu_sim_faults_total`` bumped;
+- scorer arithmetic against hand-built records/events/ledger and the
+  sweep ranking order;
+- ``GET /internal/sim`` exact-schema snapshot;
+- the SDTPU_SIM=0 default serving path hash-pinned via goldens.
+"""
+
+import json
+import sys
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu import sim
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs import prometheus as obs_prom
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.config import ConfigModel
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler import (
+    worker as worker_mod,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler import (
+    world as world_mod,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+    StubBackend, StubBehavior, WorkerNode,
+)
+from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+from stable_diffusion_webui_distributed_tpu.serving import (
+    dispatcher as dispatcher_mod,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from stable_diffusion_webui_distributed_tpu.sim import (
+    chaos as sim_chaos,
+    score as sim_score,
+    sweep as sim_sweep,
+    workload as sim_workload,
+)
+from test_goldens import _check
+from test_obsplane import call
+from test_pipeline import init_params
+
+sys.path.insert(0, "tools")
+
+import replay  # noqa: E402  (tools/ on path)
+
+
+def payload(**kw):
+    defaults = dict(prompt="p", steps=20, width=512, height=512,
+                    batch_size=4, seed=10)
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+def stub_world():
+    w = World(ConfigModel())
+    w.add_worker(WorkerNode(
+        "survivor", StubBackend(StubBehavior(seconds_per_image=0.001)),
+        avg_ipm=2400.0))
+    w.add_worker(WorkerNode(
+        "victim", StubBackend(StubBehavior(seconds_per_image=0.001)),
+        avg_ipm=2400.0))
+    return w
+
+
+@pytest.fixture()
+def journal_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_JOURNAL", "1")
+    obs_journal.JOURNAL.clear()
+    yield obs_journal.JOURNAL
+    obs_journal.JOURNAL.clear()
+
+
+@pytest.fixture()
+def sim_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_SIM", "1")
+    yield
+    sim_chaos.disarm()
+    sim.clear_last_run()
+
+
+# -- workload generator ------------------------------------------------------
+
+class TestWorkload:
+    def test_same_seed_identical_stream(self):
+        mix = sim_workload.synthetic_mix(4)
+        spec = sim_workload.WorkloadSpec(seed=7, count=20, rate_scale=3.0,
+                                         diurnal_amplitude=0.5,
+                                         burst_size=5)
+        a = sim_workload.generate_plan(mix, spec)
+        b = sim_workload.generate_plan(mix, spec)
+        assert [r.dump() for r in a] == [r.dump() for r in b]
+        assert sim_workload.plan_fingerprint(a) == \
+            sim_workload.plan_fingerprint(b)
+        other = sim_workload.generate_plan(
+            mix, sim_workload.WorkloadSpec(seed=8, count=20,
+                                           rate_scale=3.0,
+                                           diurnal_amplitude=0.5,
+                                           burst_size=5))
+        assert sim_workload.plan_fingerprint(a) != \
+            sim_workload.plan_fingerprint(other)
+
+    def test_scaling_burst_and_diversity(self):
+        mix = sim_workload.synthetic_mix(4)
+        spec = sim_workload.WorkloadSpec(
+            seed=3, count=12, burst_size=4, burst_at=0.5,
+            shapes=[(64, 64), (64, 48)],
+            precisions=["bf16", "int8"],
+            tenants=["alice", "bob"], classes=["interactive", "batch"])
+        plan = sim_workload.generate_plan(mix, spec)
+        assert len(plan) == 16  # count + burst riders
+        arrivals = [r.arrival_s for r in plan]
+        assert arrivals == sorted(arrivals)
+        # the burst is simultaneous: 4 extra requests share one arrival
+        from collections import Counter
+        top = Counter(arrivals).most_common(1)[0]
+        assert top[1] >= 4
+        assert {(r.payload.width, r.payload.height) for r in plan} <= \
+            {(64, 64), (64, 48)}
+        assert {r.payload.tenant for r in plan} <= \
+            {"alice", "bob", "default"}
+        # request ids are deterministic and unique
+        rids = [r.request_id for r in plan]
+        assert len(set(rids)) == len(rids)
+        assert all(rid.startswith("sim-3-") for rid in rids)
+
+    def test_mix_from_snapshot_events(self, journal_on):
+        dump = payload(seed=42).model_dump()
+        journal_on.emit("received", "r-1", payload=dump,
+                        fingerprint=obs_journal.fingerprint(dump))
+        journal_on.emit("completed", "r-1", seeds=[42])
+        mix = sim_workload.base_mix(journal_on.snapshot()["events"])
+        assert len(mix) == 1
+        assert mix[0][0]["seed"] == 42
+        assert mix[0][1] == 0.0  # arrivals normalized to t0
+
+
+# -- journal sink ------------------------------------------------------------
+
+class TestJournalSink:
+    def test_spill_on_evict_completes_the_record(self, tmp_path,
+                                                 monkeypatch):
+        sink = tmp_path / "journal.jsonl"
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK", str(sink))
+        j = obs_journal.EventJournal(capacity=4)
+        for i in range(10):
+            j.emit("received", f"r-{i}", idx=i)
+        # ring holds the newest 4; the sink holds the evicted 6
+        assert len(j) == 4
+        lines = sink.read_text().splitlines()
+        assert len(lines) == 6
+        spilled = [json.loads(ln) for ln in lines]
+        assert sorted(e["seq"] for e in spilled) == [1, 2, 3, 4, 5, 6]
+        assert j.sink_status() == {"path": str(sink), "spilled": 6}
+        # snapshot schema is unchanged by the sink
+        assert set(j.snapshot()) == {"enabled", "capacity", "count",
+                                     "total_emitted", "events"}
+
+    def test_no_sink_no_spill(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        monkeypatch.delenv("SDTPU_JOURNAL_SINK", raising=False)
+        j = obs_journal.EventJournal(capacity=2)
+        for i in range(5):
+            j.emit("received", f"r-{i}")
+        assert j.sink_status() == {"path": "", "spilled": 0}
+
+    def test_loaders_read_sink_and_snapshot(self, tmp_path, monkeypatch):
+        sink = tmp_path / "sink.jsonl"
+        snap_file = tmp_path / "snap.json"
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK", str(sink))
+        j = obs_journal.EventJournal(capacity=2)
+        for i in range(4):
+            dump = payload(seed=100 + i).model_dump()
+            j.emit("received", f"r-{i}", payload=dump)
+        snap_file.write_text(json.dumps(j.snapshot()))
+        # tools/replay normalizes both shapes to a snapshot dict
+        from_sink = replay.load_snapshot(str(sink))
+        from_file = replay.load_snapshot(str(snap_file))
+        assert [e["seq"] for e in from_sink["events"]] == [1, 2]
+        assert [e["seq"] for e in from_file["events"]] == [3, 4]
+        # the workload loader reads all three source kinds
+        assert len(sim_workload.load_events(str(sink))) == 2
+        assert len(sim_workload.load_events(str(snap_file))) == 2
+        assert len(sim_workload.load_events(j.snapshot())) == 2
+        # sink + ring together are the complete mix
+        events = sim_workload.load_events(str(sink)) + \
+            sim_workload.load_events(str(snap_file))
+        assert len(sim_workload.base_mix(events)) == 4
+
+
+# -- chaos injection ---------------------------------------------------------
+
+class TestChaos:
+    def test_hooks_none_by_default(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_SIM", raising=False)
+        assert worker_mod.CHAOS_HOOK is None
+        assert world_mod.CHAOS_HOOK is None
+        assert dispatcher_mod.CHAOS_HOOK is None
+
+    def test_arm_refused_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_SIM", raising=False)
+        plan = sim_chaos.ChaosPlan([sim_chaos.Fault(kind="kill")])
+        with pytest.raises(RuntimeError):
+            sim_chaos.arm(plan)
+        assert worker_mod.CHAOS_HOOK is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            sim_chaos.Fault(kind="meteor")
+
+    def test_kill_recovers_with_zero_double_merge(self, sim_on,
+                                                  journal_on):
+        w = stub_world()
+        plan = sim_chaos.ChaosPlan(
+            [sim_chaos.Fault(kind="kill", worker="victim", at_request=1)],
+            seed=11)
+        faults0 = obs_prom.SIM_FAULT_COUNTER.total()
+        sim_chaos.arm(plan)
+        try:
+            result = w.execute(payload(seed=50, steps=8,
+                                       request_id="kill-0"))
+        finally:
+            sim_chaos.disarm()
+        # full delivery, exact seed range, zero double-merge
+        assert sorted(result.seeds) == [50, 51, 52, 53]
+        assert len(result.images) == 4
+        assert len(set(result.images)) == 4
+        # the kill was delivered once, journaled, counted, and cleared
+        st = plan.status()
+        assert st["faults"][0]["injected"] == 1
+        assert st["faults"][0]["cleared"] is True
+        names = [e["event"] for e in journal_on.snapshot()["events"]]
+        assert "fault_injected" in names and "fault_cleared" in names
+        assert "requeued" in names  # the dead range moved to the survivor
+        assert obs_prom.SIM_FAULT_COUNTER.total() - faults0 == 1
+        # hooks are fully disarmed again
+        assert worker_mod.CHAOS_HOOK is None
+        assert world_mod.CHAOS_HOOK is None
+        assert dispatcher_mod.CHAOS_HOOK is None
+
+    def test_stall_recovers_via_watchdog(self, sim_on, journal_on,
+                                         monkeypatch):
+        monkeypatch.setenv("SDTPU_WATCHDOG_FACTOR", "2.0")
+        w = stub_world()
+        # the victim sleeps 1.2s before generating; its ETA at 2400 ipm
+        # is 0.025 s/image, so the watchdog (factor 2) latches long
+        # before the sleep ends and the range is requeued
+        plan = sim_chaos.ChaosPlan(
+            [sim_chaos.Fault(kind="stall", worker="victim", at_request=1,
+                             duration_s=1.2)], seed=12)
+        stalls0 = obs_prom.watchdog_stalls_total()
+        sim_chaos.arm(plan)
+        try:
+            result = w.execute(payload(seed=60, steps=8,
+                                       request_id="stall-0"))
+        finally:
+            sim_chaos.disarm()
+        assert sorted(result.seeds) == [60, 61, 62, 63]
+        assert len(result.images) == 4
+        assert len(set(result.images)) == 4
+        assert obs_prom.watchdog_stalls_total() > stalls0
+        names = [e["event"] for e in journal_on.snapshot()["events"]]
+        assert "fault_injected" in names
+
+    def test_http_error_clears_after_count(self, sim_on):
+        w = stub_world()
+        plan = sim_chaos.ChaosPlan(
+            [sim_chaos.Fault(kind="http_error", worker="victim",
+                             at_request=1, count=1)], seed=13)
+        sim_chaos.arm(plan)
+        try:
+            first = w.execute(payload(seed=70, steps=8))
+            # fault exhausted: the next request sails through unharmed
+            second = w.execute(payload(seed=80, steps=8))
+        finally:
+            sim_chaos.disarm()
+        assert sorted(first.seeds) == [70, 71, 72, 73]
+        assert sorted(second.seeds) == [80, 81, 82, 83]
+        assert plan.status()["faults"][0]["remaining"] == 0
+
+
+# -- scorer + sweep ----------------------------------------------------------
+
+class TestScorer:
+    def _records(self):
+        return [
+            {"class": "interactive", "status": "completed",
+             "latency_s": 1.0, "expected": 1, "images": 1},
+            {"class": "interactive", "status": "completed",
+             "latency_s": 3.0, "expected": 1, "images": 1},
+            {"class": "interactive", "status": "quota",
+             "latency_s": 0.0, "expected": 1, "images": 0},
+            {"class": "batch", "status": "completed",
+             "latency_s": 5.0, "expected": 4, "images": 5},
+            {"class": "batch", "status": "failed",
+             "latency_s": 9.0, "expected": 4, "images": 0},
+        ]
+
+    def _events(self):
+        return [
+            {"event": "fault_injected", "attrs": {"kind": "kill"}},
+            {"event": "fault_injected", "attrs": {"kind": "stall"}},
+            {"event": "fault_cleared", "attrs": {"kind": "kill"}},
+            {"event": "requeued", "attrs": {"worker": "survivor"}},
+            {"event": "job_failed", "attrs": {}},
+        ]
+
+    def _ledger(self):
+        return {
+            "slo": [{"tenant": "alice", "class": "interactive",
+                     "slo_s": 10.0, "total": 4, "met": 3,
+                     "attainment": 0.75, "burn_rate": 5.0},
+                    {"tenant": "bob", "class": "batch",
+                     "slo_s": 40.0, "total": 2, "met": 2,
+                     "attainment": 1.0, "burn_rate": 0.0}],
+            "compiles": {"chunk": {"count": 2}, "decode": {"count": 1}},
+            "groups": [{"dispatches": 3, "padding_ratio": 1.0},
+                       {"dispatches": 1, "padding_ratio": 2.0}],
+        }
+
+    def test_scorecard_math(self):
+        score = sim_score.score_run(
+            self._records(), events=self._events(),
+            ledger=self._ledger(),
+            slo_s_by_class={"interactive": 2.0})
+        assert score["requests"] == 5
+        inter = score["classes"]["interactive"]
+        assert inter["requests"] == 3
+        assert inter["completed"] == 2 and inter["throttled"] == 1
+        assert inter["p50_s"] == 1.0 and inter["p95_s"] == 3.0
+        assert inter["slo_attainment"] == 0.5  # 1.0s met, 3.0s missed
+        batch = score["classes"]["batch"]
+        assert batch["failed"] == 1
+        assert batch["slo_attainment"] is None  # no target given
+        assert score["faults"] == {"kill": 1, "stall": 1}
+        assert score["requeues"] == 1 and score["job_failures"] == 1
+        # 1+1+0+4+0 delivered (capped at expected) of 11 expected; the
+        # 5th batch image is a double merge
+        assert score["expected_images"] == 11
+        assert score["delivered_images"] == 6
+        assert score["double_merged_images"] == 1
+        assert score["requeue_recovery_rate"] == round(6 / 11, 6)
+        assert score["worst_slo_burn"] == 5.0
+        assert score["compiles"] == 3
+        assert score["avg_padding_ratio"] == 1.25
+        # the gauge latched the worst burn
+        assert obs_prom.sim_slo_burn() == 5.0
+
+    def test_clean_run_scores_full_recovery(self):
+        records = [{"class": "interactive", "status": "completed",
+                    "latency_s": 0.5, "expected": 2, "images": 2}]
+        score = sim_score.score_run(records)
+        assert score["requeue_recovery_rate"] == 1.0
+        assert score["double_merged_images"] == 0
+        assert score["faults"] == {}
+
+    def test_ledger_metrics_flatten(self):
+        score = sim_score.score_run(
+            self._records(), events=self._events(),
+            ledger=self._ledger(),
+            slo_s_by_class={"interactive": 2.0})
+        m = sim_score.ledger_metrics(score)
+        assert m["scenario_p95_s"] == 5.0   # worst class p95
+        assert m["slo_attainment"] == 0.5   # worst class attainment
+        assert m["double_merged_images"] == 1
+        assert m["faults_injected"] == 2
+        assert m["requeue_recovery_rate"] == round(6 / 11, 6)
+
+    def test_rank_prefers_attainment_then_p95_then_compiles(self):
+        def fake(att, p95, compiles):
+            return {"classes": {"interactive": {"slo_attainment": att,
+                                                "p50_s": p95,
+                                                "p95_s": p95}},
+                    "compiles": compiles}
+        out = sim_sweep.rank([
+            {"name": "slow_but_meets", "score": fake(1.0, 4.0, 9)},
+            {"name": "fast_but_misses", "score": fake(0.5, 1.0, 1)},
+            {"name": "meets_faster", "score": fake(1.0, 2.0, 5)},
+        ])
+        assert [r["name"] for r in out["ranked"]] == \
+            ["meets_faster", "slow_but_meets", "fast_but_misses"]
+        assert out["recommendation"] == "meets_faster"
+        # compiles break exact ties
+        tied = sim_sweep.rank([
+            {"name": "many_compiles", "score": fake(1.0, 2.0, 7)},
+            {"name": "few_compiles", "score": fake(1.0, 2.0, 2)},
+        ])
+        assert tied["recommendation"] == "few_compiles"
+
+
+# -- window replay (tools/replay.py) -----------------------------------------
+
+class TestWindowReplay:
+    def test_replays_all_requests_in_arrival_order(self, journal_on):
+        w = stub_world()
+        for i in range(3):
+            w.execute(payload(seed=100 + 10 * i, steps=8,
+                              request_id=f"win-{i}"))
+        snapshot = journal_on.snapshot()
+        rids = replay.request_ids(snapshot)
+        assert rids == ["win-0", "win-1", "win-2"]
+        # a fresh identical world replays every request byte-identically
+        w2 = stub_world()
+
+        def executor(dump):
+            return w2.execute(GenerationPayload(**dump))
+
+        report = replay.replay_window(snapshot, executor)
+        assert report["requests"] == 3
+        assert report["deterministic"] == 3
+        assert report["diverged"] == 0 and report["skipped"] == 0
+
+    def test_time_window_narrows(self, journal_on):
+        w = stub_world()
+        w.execute(payload(seed=1, steps=8, request_id="early"))
+        w.execute(payload(seed=2, steps=8, request_id="late"))
+        snapshot = journal_on.snapshot()
+        events = snapshot["events"]
+        late_t = min(e["t_mono"] for e in events
+                     if e["request_id"] == "late")
+        assert replay.request_ids(snapshot, t_min=late_t) == ["late"]
+        assert replay.request_ids(snapshot, t_max=late_t - 1e-9) == \
+            ["early"]
+
+
+# -- /internal/sim + default-path pins ---------------------------------------
+
+def make_world():
+    w = World(ConfigModel())
+    w.add_worker(WorkerNode("m", StubBackend(), master=True, avg_ipm=10.0))
+    return w
+
+
+@pytest.fixture(scope="class")
+def server():
+    srv = ApiServer(make_world(), state=GenerationState(),
+                    host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+class TestSimEndpoint:
+    def test_sim_endpoint_schema_snapshot(self, server, monkeypatch):
+        monkeypatch.delenv("SDTPU_SIM", raising=False)
+        out = call(server, "/internal/sim")
+        assert set(out) == {"enabled", "sink", "chaos", "last_run"}
+        assert out["enabled"] is False
+        assert set(out["sink"]) == {"path", "spilled"}
+        assert out["chaos"] == {"armed": False, "plan": None}
+        assert out["last_run"] is None
+
+    def test_sim_endpoint_reflects_state(self, server, monkeypatch):
+        monkeypatch.setenv("SDTPU_SIM", "1")
+        plan = sim_chaos.ChaosPlan(
+            [sim_chaos.Fault(kind="slow", worker="w0", duration_s=0.1)])
+        sim_chaos.arm(plan)
+        sim.record_last_run("steady", {"requests": 3})
+        try:
+            out = call(server, "/internal/sim")
+        finally:
+            sim_chaos.disarm()
+            sim.clear_last_run()
+        assert out["enabled"] is True
+        assert out["chaos"]["armed"] is True
+        assert out["chaos"]["plan"]["faults"][0]["kind"] == "slow"
+        assert out["last_run"]["name"] == "steady"
+
+
+class TestDefaultPathPinned:
+    def test_sim_off_serving_path_hash_pinned(self, monkeypatch):
+        # SDTPU_SIM unset: the serving path must stay byte-identical
+        # across sim/ refactors — frozen through the goldens mechanism
+        monkeypatch.delenv("SDTPU_SIM", raising=False)
+        monkeypatch.delenv("SDTPU_JOURNAL", raising=False)
+        engine = Engine(TINY, init_params(TINY), chunk_size=4,
+                        state=GenerationState())
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(GenerationPayload(
+            prompt="a golden scenario cow", width=32, height=32,
+            steps=4, seed=4321, sampler_name="Euler a"))
+        _check("serving/sim-off-default", r)

@@ -15,11 +15,16 @@ Usage:
   python tools/replay.py --source journal.json --request-id RID
   python tools/replay.py --source http://host:7860/internal/journal \
       --request-id RID --post http://host:7860
-  # --source accepts a saved snapshot file or a live /internal/journal
-  # URL; --post re-executes against a server and byte-compares.
+  # window replay: every request in recorded arrival order
+  python tools/replay.py --source journal.jsonl --all \
+      [--t-min S --t-max S] --post http://host:7860
+  # --source accepts a saved snapshot file, a JSONL sink file
+  # (SDTPU_JOURNAL_SINK spill), or a live /internal/journal URL;
+  # --post re-executes against a server and byte-compares.
 
 Library surface (used by tests and tooling): :func:`load_snapshot`,
-:func:`events_for`, :func:`reconstruct`, :func:`compare`.
+:func:`events_for`, :func:`reconstruct`, :func:`compare`,
+:func:`request_ids`, :func:`replay_window`.
 """
 
 from __future__ import annotations
@@ -59,13 +64,27 @@ class ReplayPlan:
 
 
 def load_snapshot(source: str) -> Dict[str, Any]:
-    """A journal snapshot from a saved JSON file or a live
-    ``/internal/journal`` URL."""
+    """A journal snapshot from a saved JSON file, a JSONL sink file
+    (``SDTPU_JOURNAL_SINK`` spill — one event per line, possibly out of
+    seq order), or a live ``/internal/journal`` URL. Always returns the
+    snapshot-dict shape with events sorted by seq."""
     if source.startswith(("http://", "https://")):
         with urllib.request.urlopen(source, timeout=10) as resp:
             return json.loads(resp.read().decode("utf-8"))
     with open(source, "r", encoding="utf-8") as fh:
-        return json.load(fh)
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        return doc
+    events = [json.loads(line) for line in text.splitlines()
+              if line.strip()]
+    events.sort(key=lambda e: e.get("seq", 0))
+    return {"enabled": True, "capacity": len(events),
+            "count": len(events), "total_emitted": len(events),
+            "events": events}
 
 
 def events_for(snapshot: Dict[str, Any],
@@ -152,6 +171,59 @@ def replay_with(plan: ReplayPlan, executor) -> Dict[str, Any]:
                    list(getattr(result, "infotexts", [])))
 
 
+def request_ids(snapshot: Dict[str, Any],
+                t_min: Optional[float] = None,
+                t_max: Optional[float] = None) -> List[str]:
+    """Distinct request ids in recorded arrival order (first-event
+    ``t_mono``), optionally windowed to arrivals in [t_min, t_max]."""
+    first_t: Dict[str, float] = {}
+    order: List[str] = []
+    for e in sorted(snapshot.get("events") or [],
+                    key=lambda ev: ev.get("seq", 0)):
+        rid = str(e.get("request_id", ""))
+        if rid and rid not in first_t:
+            first_t[rid] = float(e.get("t_mono", 0.0))
+            order.append(rid)
+    return [rid for rid in order
+            if (t_min is None or first_t[rid] >= t_min)
+            and (t_max is None or first_t[rid] <= t_max)]
+
+
+def replay_window(snapshot: Dict[str, Any], executor,
+                  t_min: Optional[float] = None,
+                  t_max: Optional[float] = None) -> Dict[str, Any]:
+    """Replay EVERY request in the (windowed) snapshot in recorded
+    arrival order, byte-comparing each against its journaled outcome.
+    Requests without a payload dump (ring-evicted, or journaled only as
+    a follower) are reported as skipped, not failed."""
+    results: List[Dict[str, Any]] = []
+    deterministic = 0
+    diverged = 0
+    skipped = 0
+    for rid in request_ids(snapshot, t_min=t_min, t_max=t_max):
+        plan = reconstruct(events_for(snapshot, rid))
+        if plan.payload is None \
+                or plan.outcome.get("status") != "completed":
+            skipped += 1
+            results.append({"request_id": rid, "skipped": True,
+                            "outcome": plan.outcome.get("status", "")})
+            continue
+        verdict = replay_with(plan, executor)
+        if verdict["deterministic"]:
+            deterministic += 1
+        else:
+            diverged += 1
+        results.append({"request_id": rid, "skipped": False,
+                        **verdict})
+    return {
+        "requests": len(results),
+        "deterministic": deterministic,
+        "diverged": diverged,
+        "skipped": skipped,
+        "results": results,
+    }
+
+
 def _post_executor(base_url: str):
     """Executor that re-POSTs the payload to a live server's txt2img."""
     def run(payload: Dict[str, Any]):
@@ -173,21 +245,47 @@ def _post_executor(base_url: str):
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--source", required=True,
-                    help="journal snapshot file or /internal/journal URL")
-    ap.add_argument("--request-id", required=True)
+                    help="journal snapshot file, JSONL sink file, or "
+                         "/internal/journal URL")
+    ap.add_argument("--request-id", default="",
+                    help="single-request replay (mutually exclusive "
+                         "with --all)")
+    ap.add_argument("--all", action="store_true",
+                    help="replay every request in recorded arrival order")
+    ap.add_argument("--t-min", type=float, default=None,
+                    help="window start (journal t_mono seconds)")
+    ap.add_argument("--t-max", type=float, default=None,
+                    help="window end (journal t_mono seconds)")
     ap.add_argument("--post", default="",
                     help="server base URL to re-execute against "
                          "(omit to only reconstruct)")
     args = ap.parse_args(argv)
+    if bool(args.request_id) == bool(args.all):
+        ap.error("exactly one of --request-id / --all is required")
 
     snapshot = load_snapshot(args.source)
+    if args.all:
+        if args.post:
+            report = replay_window(snapshot, _post_executor(args.post),
+                                   t_min=args.t_min, t_max=args.t_max)
+            ok = report["diverged"] == 0 and report["requests"] > 0
+        else:
+            rids = request_ids(snapshot, t_min=args.t_min,
+                               t_max=args.t_max)
+            plans = [reconstruct(events_for(snapshot, rid)).summary()
+                     for rid in rids]
+            report = {"requests": len(plans), "plans": plans}
+            ok = bool(plans)
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        return 0 if ok else 1
+
     events = events_for(snapshot, args.request_id)
     try:
         plan = reconstruct(events)
     except ValueError as e:
         print(json.dumps({"error": str(e)}), file=sys.stderr)
         return 2
-    report: Dict[str, Any] = {"plan": plan.summary()}
+    report = {"plan": plan.summary()}
     if args.post:
         report["replay"] = replay_with(plan, _post_executor(args.post))
         ok = report["replay"]["deterministic"]
