@@ -1653,6 +1653,63 @@ def _scenario_chaos(seed):
     return score
 
 
+def _scenario_chaos_stall(seed):
+    """Chaos stall: stub two-worker World with a tight watchdog factor;
+    the victim sleeps 1.2s before generating (ETA at 2400 ipm is
+    0.025 s/image) so the hang watchdog latches and the range requeues
+    onto the survivor — the same recipe as tests/test_sim.py's stall
+    scenario, scored for full recovery."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        journal as obs_journal,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend, StubBehavior, WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        chaos as sim_chaos, score as sim_score,
+    )
+
+    obs_journal.JOURNAL.clear()
+    with _EnvPatch(SDTPU_WATCHDOG_FACTOR="2.0"):
+        w = World(ConfigModel())
+        w.add_worker(WorkerNode(
+            "survivor", StubBackend(StubBehavior(seconds_per_image=0.001)),
+            avg_ipm=2400.0))
+        w.add_worker(WorkerNode(
+            "victim", StubBackend(StubBehavior(seconds_per_image=0.001)),
+            avg_ipm=2400.0))
+        plan = sim_chaos.ChaosPlan(
+            [sim_chaos.Fault(kind="stall", worker="victim", at_request=1,
+                             duration_s=1.2)],
+            seed=seed + 1)
+        sim_chaos.arm(plan)
+        try:
+            p = GenerationPayload(prompt="chaos stall", steps=8, width=512,
+                                  height=512, batch_size=4, seed=88,
+                                  request_id="chaos-stall-000")
+            t0 = time.perf_counter()
+            result = w.execute(p)
+            latency = time.perf_counter() - t0
+        finally:
+            sim_chaos.disarm()
+    records = [{"request_id": "chaos-stall-000", "class": "interactive",
+                "tenant": "default", "status": "completed",
+                "expected": p.total_images,
+                "images": len(result.images), "latency_s": latency}]
+    events = obs_journal.JOURNAL.snapshot()["events"]
+    score = sim_score.score_run(records, events=events)
+    score["chaos_plan"] = plan.status()
+    obs_journal.JOURNAL.clear()
+    return score
+
+
 def _scenario_sweep(engine, mix, seed, size, slo_s):
     """Capacity sweep: the same replayed mix under three candidate
     configs (coalesce cadence x batch ladder); ranked by worst-class SLO
@@ -1782,6 +1839,156 @@ def run_scenarios(tiny):
             f.write(json.dumps(row, sort_keys=True) + "\n")
     print(f"bench: {len(rows)} scenario ledger rows appended to {lpath}",
           file=sys.stderr)
+    return out
+
+
+def _alert_firings(history, start):
+    """Distinct rules that transitioned to firing in history[start:]."""
+    return sorted({e["rule"] for e in history[start:]
+                   if e.get("to") == "firing"})
+
+
+def run_alerts(tiny):
+    """--alerts: alert-engine validation against labeled ground truth.
+    Replays the scenario mix as a steady phase with the TSDB daemon +
+    alert engine live (every firing there is a false positive), then the
+    chaos kill and chaos stall scenarios bracketed by explicit TSDB
+    ticks (every injected fault window must raise a matching alert —
+    recall 1.0). Windows are compressed with SDTPU_ALERT_TIMESCALE so
+    the 5m/1h SRE pairs evaluate over seconds. Writes BENCH_alerts.json
+    (read by tools/alert_report.py) + an ``alerts`` ledger row with
+    alert_false_positives / alert_recall, both zero-movement gated by
+    tools/bench_compare.py. CPU-safe."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        alerts as obs_alerts, journal as obs_journal,
+        prometheus as obs_prom, tsdb as obs_tsdb,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_int,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        score as sim_score,
+    )
+
+    dev = jax.devices()[0]
+    cpu = tiny or dev.platform == "cpu"
+    family = C.TINY if cpu else C.SD15
+    size, steps = (64, 4) if cpu else (512, 20)
+    slo_s = 10.0 if cpu else 30.0
+    seed = env_int("SDTPU_SIM_SEED", 0)
+
+    with _EnvPatch(SDTPU_SIM="1", SDTPU_JOURNAL="1", SDTPU_PERF="1",
+                   SDTPU_CHUNK="2" if cpu else "5",
+                   SDTPU_TSDB="1", SDTPU_ALERTS="1",
+                   SDTPU_TSDB_INTERVAL_S="0.05",
+                   SDTPU_ALERT_TIMESCALE="0.01"):
+        obs_prom.clear_histograms()
+        obs_tsdb.reset()
+        obs_alerts.reset()
+        engine = _make_engine(family)
+        bucketer = ShapeBucketer(shapes=[(size, size)], batches=[2])
+        recorder = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        mix = _scenario_mix(recorder, size, steps)
+        if not mix:
+            raise RuntimeError("journal recorded no replayable mix")
+
+        def ticks(n, sleep_s=0.02):
+            # explicit cadence: back-to-back ticks would land at ~the
+            # same t_mono and rate() needs time separation
+            for _ in range(n):
+                obs_tsdb.tick()
+                time.sleep(sleep_s)
+
+        # phase 1 — steady traffic, daemon live: zero tolerated firings.
+        # The daemon also warms every anomaly rule's EWMA baseline past
+        # its warmup, which is what makes the fault phases detectable.
+        mark = len(obs_alerts.ENGINE.history())
+        obs_tsdb.start_daemon()
+        try:
+            steady = _scenario_steady(engine, bucketer, mix, seed, slo_s)
+        finally:
+            obs_tsdb.stop_daemon()
+        ticks(4)
+        history = obs_alerts.ENGINE.history()
+        fired_steady = _alert_firings(history, mark)
+
+        # phase 2 — chaos kill: the ConnectionError lands in the worker
+        # failure path, so the flat worker_failures_total rate jumps.
+        mark = len(history)
+        ticks(4)
+        chaos_kill = _scenario_chaos(seed)
+        ticks(4)
+        history = obs_alerts.ENGINE.history()
+        fired_kill = _alert_firings(history, mark)
+
+        # phase 3 — chaos stall: the hang watchdog latches, and any
+        # watchdog_stalls_total increase inside the fast window fires.
+        mark = len(history)
+        ticks(2)
+        chaos_stall = _scenario_chaos_stall(seed)
+        ticks(4)
+        history = obs_alerts.ENGINE.history()
+        fired_stall = _alert_firings(history, mark)
+
+        validation = sim_score.alert_validation([
+            {"name": "steady", "expected": [], "fired": fired_steady},
+            {"name": "chaos_kill",
+             "expected": ["error_rate_anomaly", "worker_flap"],
+             "fired": fired_kill},
+            {"name": "chaos_stall", "expected": ["watchdog_stall"],
+             "fired": fired_stall},
+        ])
+        alert_events = [
+            e for e in obs_journal.JOURNAL.snapshot()["events"]
+            if e.get("event", "").startswith("alert_")]
+        tsdb_stats = obs_tsdb.STORE.stats()
+        alert_state = obs_alerts.ENGINE.state()
+        obs_journal.JOURNAL.clear()
+        obs_tsdb.reset()
+        obs_alerts.reset()
+
+    out = {
+        "seed": seed,
+        "recorded_mix": len(mix),
+        "validation": validation,
+        "history": history,
+        "alert_journal_events": alert_events,
+        "alert_state": {n: r["state"]
+                        for n, r in alert_state["rules"].items()},
+        "steady": steady,
+        "chaos_kill": chaos_kill,
+        "chaos_stall": chaos_stall,
+        "tsdb": tsdb_stats,
+        "device": dev.device_kind,
+        "tiny": bool(tiny),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_alerts.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"bench: alert validation written to {path} "
+          f"(inspect with tools/alert_report.py)", file=sys.stderr)
+
+    recorded_at = time.time()
+    row = _ledger_row("alerts", {
+        "alert_false_positives": validation["alert_false_positives"],
+        "alert_recall": validation["alert_recall"],
+        "faults_injected": validation["faults"],
+    }, "stub", tiny, recorded_at)
+    lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LEDGER.jsonl")
+    with open(lpath, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"bench: alerts ledger row appended to {lpath}", file=sys.stderr)
     return out
 
 
@@ -1915,6 +2122,13 @@ def main() -> None:
                          "and a capacity sweep; writes "
                          "BENCH_scenarios.json + per-scenario ledger "
                          "rows (CPU-safe)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="alert-engine validation: steady scenario with "
+                         "the TSDB daemon + alert engine live (zero "
+                         "false-positive firings), then the chaos "
+                         "kill/stall scenarios (every fault window must "
+                         "raise a matching alert); writes "
+                         "BENCH_alerts.json + a ledger row (CPU-safe)")
     ap.add_argument("--ledger", action="store_true",
                     help="run the serving, fleet and watchdog microbenches "
                          "with the perf ledger on and append structural "
@@ -1963,6 +2177,8 @@ def main() -> None:
             print(json.dumps(run_watchdog(tiny)))
         elif args.scenarios:
             print(json.dumps(run_scenarios(tiny)))
+        elif args.alerts:
+            print(json.dumps(run_alerts(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
         elif args.ragged:

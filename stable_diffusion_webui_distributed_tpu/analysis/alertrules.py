@@ -1,0 +1,65 @@
+"""OB004: alert-rule registration outside the closed obs/alerts.py set.
+
+``obs/alerts.py`` owns the alert-rule registry: the closed rule set is
+what makes the chaos-validated recall/false-positive gate meaningful —
+``bench.py --alerts`` labels its phases against rule names it knows, the
+journal vocabulary pins ``alert_firing``/``alert_resolved`` payload
+shapes, and ``sdtpu_alert_state{rule}`` label cardinality stays bounded.
+A ``register_rule`` call anywhere else silently grows the evaluated set
+without the gate ever exercising the new detector, so this rule flags
+any ``register_rule(...)`` / ``AlertRule(...)`` registration spelled
+outside the registry module.
+
+Constructing an :class:`AlertRule` alone is fine anywhere (tests build
+throwaway rules constantly); only handing one to ``register_rule`` is
+confined. A deliberate out-of-module registration (e.g. a deployment
+plugin) opts out with ``# sdtpu-lint: alert`` on the line or the
+standalone comment line above, same marker discipline as OB001/EV001.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, ModuleInfo
+from .envrules import _enclosing_symbol
+
+MARKER_PREFIX = "sdtpu-lint:"
+MARKER = "alert"
+
+#: The module that owns the rule registry; everything inside it is exempt.
+REGISTRY_MODULE = "obs/alerts.py"
+
+#: The confined registration entry point (any dotted spelling).
+REGISTRATION_CALLS = ("register_rule",)
+
+
+def _exempt(mod: ModuleInfo, line: int) -> bool:
+    payload = mod.marker(line, MARKER_PREFIX)
+    return payload is not None and MARKER in payload.split()
+
+
+def check(modules: List[ModuleInfo]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.path.endswith(REGISTRY_MODULE):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name, _resolved = mod.call_name(node)
+            if not name:
+                continue
+            if name.rsplit(".", 1)[-1] not in REGISTRATION_CALLS:
+                continue
+            line = node.lineno
+            if _exempt(mod, line):
+                continue
+            findings.append(Finding(
+                "OB004", mod.path, line, _enclosing_symbol(mod, line),
+                "alert-rule registration outside obs/alerts.py; add the "
+                "rule to the closed registry there so the bench recall "
+                "gate exercises it (or mark a deliberate plugin site "
+                "with '# sdtpu-lint: alert')"))
+    return findings

@@ -40,6 +40,7 @@ RULES = {
     "OB001": "time.time() used for a duration on a serving/pipeline/obs path",
     "OB002": "ad-hoc Prometheus metric name outside the central registry",
     "OB003": "journal event literal outside the registered event set",
+    "OB004": "alert-rule registration outside the obs/alerts.py registry",
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",

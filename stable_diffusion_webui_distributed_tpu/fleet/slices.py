@@ -18,6 +18,12 @@ Signal: per-class fleet queue-wait p95 (``sdtpu_fleet_queue_wait_seconds``
 in obs/prometheus.py). Sustained p95 above ``SDTPU_AUTOSCALE_UP_S``
 asks for a replica; p95 below ``SDTPU_AUTOSCALE_DOWN_S`` with more than
 ``min_replicas`` releases one. A cooldown stops flapping.
+
+A second scale-up signal rides beside the point read: alert rules
+marked ``scale_up`` in obs/alerts.py (SLO burn, queue-wait anomaly)
+trigger a scale-up while firing even when the instantaneous p95 sits
+below the threshold — the windowed detectors see a trend the point
+read misses. Scale-down keeps its worker-health veto unchanged.
 """
 
 from __future__ import annotations
@@ -105,6 +111,8 @@ class AutoscaleEngine:
                  cooldown_s: Optional[float] = None,
                  clock=time.monotonic,
                  health_source: Optional[Callable[[], Dict[str, Dict]]]
+                 = None,
+                 alert_source: Optional[Callable[[], List[str]]]
                  = None) -> None:
         from stable_diffusion_webui_distributed_tpu.runtime.config import (
             env_float, env_int,
@@ -126,6 +134,10 @@ class AutoscaleEngine:
         #: is vetoed while any worker looks unhealthy, since the apparent
         #: headroom may just be capacity the fleet already lost
         self.health_source = health_source
+        #: alert feed (obs.alerts.scale_up_firing unless overridden):
+        #: firing scale_up-marked rules trigger a scale-up beside the
+        #: queue-wait point read; [] with SDTPU_ALERTS off
+        self.alert_source = alert_source or _default_alert_source
         self._lock = threading.Lock()
         self._hooks: List[Callable[[ScaleDecision], None]] = []  # guarded-by: _lock
         self._last_decision: Dict[str, float] = {}  # guarded-by: _lock
@@ -165,12 +177,21 @@ class AutoscaleEngine:
                 bad.append(label)
         return sorted(bad)
 
+    def firing_alerts(self) -> List[str]:
+        """Firing scale_up-marked alert rules (the alert feed); empty
+        when the feed fails or the alert engine is gated off."""
+        try:
+            return sorted(self.alert_source() or [])
+        except Exception:  # noqa: BLE001 — advisory feed, never fatal
+            return []
+
     def decide(self) -> List[ScaleDecision]:
         """One evaluation pass over every registered slice; returns (and
         dispatches to hooks) the decisions made this pass."""
         p95 = float(self.quantile_source())
         now = self._clock()
         unhealthy = self.unhealthy_workers()
+        alerts = self.firing_alerts()
         out: List[ScaleDecision] = []
         for name, info in self.registry.summary().items():
             with self._lock:
@@ -180,11 +201,16 @@ class AutoscaleEngine:
                 continue
             replicas = info["replicas"]
             decision = None
-            if p95 >= self.up_p95_s and replicas < info["max_replicas"]:
+            if (p95 >= self.up_p95_s or alerts) \
+                    and replicas < info["max_replicas"]:
+                if p95 >= self.up_p95_s:
+                    reason = (f"queue-wait p95 {p95:.2f}s "
+                              f">= {self.up_p95_s:.2f}s")
+                else:
+                    reason = (f"alert {','.join(alerts)} firing "
+                              f"(scale-up signal)")
                 decision = ScaleDecision(
-                    name, "up",
-                    f"queue-wait p95 {p95:.2f}s >= {self.up_p95_s:.2f}s",
-                    p95, replicas + 1)
+                    name, "up", reason, p95, replicas + 1)
             elif p95 <= self.down_p95_s and replicas > info["min_replicas"]:
                 if unhealthy:
                     # low queue wait with sick workers is not surplus
@@ -243,6 +269,7 @@ class AutoscaleEngine:
             "decisions_total": total,
             "decisions": entries,
             "unhealthy_workers": self.unhealthy_workers(),
+            "firing_alerts": self.firing_alerts(),
         }
 
 
@@ -273,3 +300,12 @@ def _default_quantile_source() -> float:
     )
 
     return obs_prom.fleet_queue_wait_p95()
+
+
+def _default_alert_source() -> List[str]:
+    """Firing scale_up-marked alert rules ([] with SDTPU_ALERTS off)."""
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        alerts as obs_alerts,
+    )
+
+    return obs_alerts.scale_up_firing()

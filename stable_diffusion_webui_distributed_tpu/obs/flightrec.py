@@ -70,10 +70,36 @@ class FlightRecorder:
             "perf": perf,
             "spans": list(events),
             "logs": lines_for_request(request_id),
+            # what the detectors saw (satellite: postmortem enrichment)
+            # — both None with the SDTPU_ALERTS / SDTPU_TSDB gates off
+            "alerts": self._alert_snapshot(),
+            "tsdb": self._tsdb_window(),
         }
         with self._lock:
             self._entries.append(entry)
         return entry
+
+    @staticmethod
+    def _alert_snapshot() -> Optional[Dict[str, Any]]:
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                alerts as obs_alerts,
+            )
+
+            return obs_alerts.state_snapshot()
+        except Exception:  # noqa: BLE001 — recorder must never fail
+            return None
+
+    @staticmethod
+    def _tsdb_window() -> Optional[Dict[str, Any]]:
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                tsdb as obs_tsdb,
+            )
+
+            return obs_tsdb.flight_window()
+        except Exception:  # noqa: BLE001 — recorder must never fail
+            return None
 
     def dump(self) -> Dict[str, Any]:
         """All retained entries, oldest first (the /internal/flightrec

@@ -36,12 +36,13 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
-from ..runtime.config import env_flag, env_int, env_str
+from ..runtime.config import env_flag, env_float, env_int, env_str
 
 #: The closed set of journal event types. Serving-tier lifecycle first,
 #: then the scheduler/worker tier, then the health/watchdog plane.
@@ -77,6 +78,9 @@ EVENTS = frozenset({
     # scenario engine / chaos tier (sim/chaos.py)
     "fault_injected",
     "fault_cleared",
+    # alerting plane (obs/alerts.py state machine)
+    "alert_firing",
+    "alert_resolved",
 })
 
 DEFAULT_CAPACITY = 4096
@@ -94,6 +98,16 @@ def sink_path() -> str:
     """Spill file for ring-evicted events ('' = no sink). Re-read per
     call so scenario runs can point successive phases at fresh files."""
     return env_str("SDTPU_JOURNAL_SINK", "")
+
+
+def sink_max_bytes() -> int:
+    """Size cap for the spill file (SDTPU_JOURNAL_SINK_MAX_MB); 0 =
+    unbounded. Past the cap the sink rotates once: the current file is
+    renamed to ``<sink>.1`` (replacing any previous ``.1``) and writing
+    restarts on a fresh file, so a long scenario run keeps at most
+    2 x cap bytes on disk."""
+    mb = env_float("SDTPU_JOURNAL_SINK_MAX_MB", 0.0)
+    return max(0, int(mb * 1024 * 1024))
 
 
 def fingerprint(obj: Any) -> str:
@@ -118,6 +132,9 @@ class EventJournal:
         # never happens while _lock is held.
         self._sink_lock = threading.Lock()
         self._sink_spilled = 0                             # guarded-by: _sink_lock
+        self._sink_bytes = 0                               # guarded-by: _sink_lock
+        self._sink_rotations = 0                           # guarded-by: _sink_lock
+        self._sink_seen = ""                               # guarded-by: _sink_lock
 
     def emit(self, event: str, request_id: str,
              parent: Optional[int] = None,
@@ -162,22 +179,47 @@ class EventJournal:
 
     def _spill(self, sink: str, entry: Dict[str, Any]) -> None:
         """Best-effort JSONL append of one evicted event. Concurrent
-        evictions may land out of seq order; sink consumers sort by seq."""
+        evictions may land out of seq order; sink consumers sort by seq.
+        With ``SDTPU_JOURNAL_SINK_MAX_MB`` set, a write that would push
+        the file past the cap first rotates it to ``<sink>.1`` (single
+        rollover; ``tools/replay.py`` loads the pair in order)."""
         try:
-            line = json.dumps(entry, sort_keys=True, default=str)
+            line = json.dumps(entry, sort_keys=True, default=str) + "\n"
+            cap = sink_max_bytes()
             with self._sink_lock:
+                if sink != self._sink_seen:
+                    # fresh sink path: adopt whatever is already on disk
+                    # so the cap covers pre-existing bytes too
+                    self._sink_seen = sink
+                    try:
+                        self._sink_bytes = os.path.getsize(sink)
+                    except OSError:
+                        self._sink_bytes = 0
+                if cap > 0 and self._sink_bytes > 0 \
+                        and self._sink_bytes + len(line) > cap:
+                    try:
+                        os.replace(sink, sink + ".1")
+                        self._sink_rotations += 1
+                        self._sink_bytes = 0
+                    except OSError:
+                        pass  # keep appending; rotation is best-effort
                 with open(sink, "a", encoding="utf-8") as fh:
-                    fh.write(line + "\n")
+                    fh.write(line)
                 self._sink_spilled += 1
+                self._sink_bytes += len(line)
         except OSError:
             pass
 
     def sink_status(self) -> Dict[str, Any]:
-        """Sink configuration + spill count (surfaced via /internal/sim;
-        kept out of snapshot(), whose schema is pinned by tests)."""
+        """Sink configuration + spill/rotation accounting (surfaced via
+        /internal/sim; kept out of snapshot(), whose schema is pinned by
+        tests)."""
         with self._sink_lock:
             spilled = self._sink_spilled
-        return {"path": sink_path(), "spilled": spilled}
+            nbytes = self._sink_bytes
+            rotations = self._sink_rotations
+        return {"path": sink_path(), "spilled": spilled,
+                "bytes": nbytes, "rotations": rotations}
 
     def events_for(self, request_id: str) -> List[Dict[str, Any]]:
         """The journal slice for one request, in seq order."""
@@ -209,6 +251,9 @@ class EventJournal:
             self._seq = 0
         with self._sink_lock:
             self._sink_spilled = 0
+            self._sink_bytes = 0
+            self._sink_rotations = 0
+            self._sink_seen = ""
 
     def __len__(self) -> int:
         with self._lock:

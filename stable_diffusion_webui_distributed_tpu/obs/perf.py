@@ -138,7 +138,8 @@ class PerfLedger:
                         device_s: float, flops: float, requests: int,
                         batch_raw: int, batch_run: int, true_pixels: int,
                         padded_pixels: int, masked_pixels: int = 0,
-                        true_tokens: int = 0, padded_tokens: int = 0
+                        true_tokens: int = 0, padded_tokens: int = 0,
+                        hbm: Optional[Dict[str, int]] = None
                         ) -> None:
         """One device dispatch: host-observed seconds + the FLOPs priced
         for the same denoise range + true-vs-padded shape accounting.
@@ -150,7 +151,13 @@ class PerfLedger:
         resident HBM but no attention FLOPs — so the summary can split
         masked padding from compute padding. ``true_tokens`` /
         ``padded_tokens`` carry the conditioning's true-vs-padded token
-        counts behind the ``token_padding_ratio`` gauge."""
+        counts behind the ``token_padding_ratio`` gauge.
+
+        ``hbm`` is the device-memory sample for this dispatch
+        (``obs/tsdb.dispatch_memory_sample()``: bytes_in_use /
+        peak_bytes_in_use / live_buffers keys as available) — ``None``
+        on CPU or when memory_stats is unsupported, and the group row
+        then reports null watermarks rather than fabricating them."""
         if not enabled():
             return
         try:
@@ -181,6 +188,17 @@ class PerfLedger:
                 g["masked_pixels"] += int(masked_pixels)
                 g["true_tokens"] += int(true_tokens)
                 g["padded_tokens"] += int(padded_tokens)
+                if hbm:
+                    # watermark semantics: keep the highest peak / latest
+                    # in-use the group has seen (never fabricated on CPU)
+                    if hbm.get("peak_bytes_in_use") is not None:
+                        g["hbm_peak_bytes"] = max(
+                            int(g.get("hbm_peak_bytes", 0)),
+                            int(hbm["peak_bytes_in_use"]))
+                    if hbm.get("bytes_in_use") is not None:
+                        g["hbm_bytes_in_use"] = int(hbm["bytes_in_use"])
+                    if hbm.get("live_buffers") is not None:
+                        g["live_buffers"] = int(hbm["live_buffers"])
                 compiles_total = sum(int(c["count"])
                                      for c in self._compiles.values())
                 self._last_dispatch = self._dispatch_entry(
@@ -298,6 +316,11 @@ class PerfLedger:
             if true_px else None,
             "token_padding_ratio": (padded_tok / true_tok)
             if true_tok else None,
+            # device-memory watermark (defaulted None: CPU rows and
+            # pre-telemetry rows read identically — never fabricated)
+            "hbm_peak_bytes": g.get("hbm_peak_bytes"),
+            "hbm_bytes_in_use": g.get("hbm_bytes_in_use"),
+            "live_buffers": g.get("live_buffers"),
         }
 
     def _slo_row(self, key: Tuple[str, str],

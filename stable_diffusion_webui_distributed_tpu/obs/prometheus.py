@@ -240,6 +240,9 @@ def clear_histograms() -> None:
     WATCHDOG_COUNTER.clear()
     CACHE_COUNTER.clear()
     SIM_FAULT_COUNTER.clear()
+    ALERT_COUNTER.clear()
+    with _ALERT_LOCK:
+        _ALERT_STATE.clear()
     set_sim_slo_burn(None)
     with _WORKER_LOCK:
         _WORKER_LATENCY_EWMA.clear()
@@ -384,6 +387,35 @@ def cache_count(layer: str, outcome: str, n: float = 1.0) -> None:
     embed_neg, result, prefix), ``outcome`` what happened there (hit,
     miss, joined, resumed, captured)."""
     CACHE_COUNTER.inc(n, layer=layer, outcome=outcome)
+
+
+# -- alerting plane (obs/alerts.py state machine) ----------------------------
+
+#: Alert state transitions by rule and state (firing / resolved); the
+#: alert engine feeds this through :func:`alert_count`.
+ALERT_COUNTER = LabeledCounter(
+    "sdtpu_alerts_total",
+    "Alert state transitions (SDTPU_ALERTS) by rule and state.",
+    ("rule", "state"))
+
+_ALERT_LOCK = threading.Lock()
+#: rule name -> 1.0 while firing, 0.0 after resolve; absent until the
+#: rule's first transition (the family renders only what happened).
+_ALERT_STATE: Dict[str, float] = {}  # guarded-by: _ALERT_LOCK
+
+
+def alert_count(rule: str, state: str, n: float = 1.0) -> None:
+    ALERT_COUNTER.inc(n, rule=rule, state=state)
+
+
+def set_alert_state(rule: str, value: float) -> None:
+    with _ALERT_LOCK:
+        _ALERT_STATE[str(rule)] = float(value)
+
+
+def alert_states() -> Dict[str, float]:
+    with _ALERT_LOCK:
+        return dict(_ALERT_STATE)
 
 
 # -- scenario engine (sim/: chaos injection + SLO scoring) -------------------
@@ -683,33 +715,29 @@ def render() -> str:
             "Mean dispatched UNet FLOPs per output image.",
             s["unet_flops_per_image"])
 
-    lines.append("# HELP sdtpu_stage_compiles_total XLA stage builds "
-                 "(one compile each) by stage kind.")
-    lines.append("# TYPE sdtpu_stage_compiles_total counter")
-    for kind in sorted(s["compiles"]):
-        lines.append(f'sdtpu_stage_compiles_total{{kind="{_label(kind)}"}} '
-                     f'{_fmt(s["compiles"][kind])}')
-    lines.append("# HELP sdtpu_stage_cache_hits_total Compiled-stage cache "
-                 "hits by stage kind.")
-    lines.append("# TYPE sdtpu_stage_cache_hits_total counter")
-    for kind in sorted(s["cache_hits"]):
-        lines.append(f'sdtpu_stage_cache_hits_total{{kind="{_label(kind)}"}}'
-                     f' {_fmt(s["cache_hits"][kind])}')
+    _labeled_family(
+        lines, "sdtpu_stage_compiles_total", "counter",
+        "XLA stage builds (one compile each) by stage kind.",
+        [(f'kind="{_label(kind)}"', s["compiles"][kind])
+         for kind in sorted(s["compiles"])])
+    _labeled_family(
+        lines, "sdtpu_stage_cache_hits_total", "counter",
+        "Compiled-stage cache hits by stage kind.",
+        [(f'kind="{_label(kind)}"', s["cache_hits"][kind])
+         for kind in sorted(s["cache_hits"])])
 
     timings = STATS.summary()
-    lines.append("# HELP sdtpu_stage_seconds Rolling stage wall-clock "
-                 "stats (StageStats window).")
-    lines.append("# TYPE sdtpu_stage_seconds gauge")
-    lines.append("# HELP sdtpu_stage_samples Rolling StageStats sample "
-                 "count per stage.")
-    lines.append("# TYPE sdtpu_stage_samples gauge")
-    for stage in sorted(timings):
-        st = timings[stage]
-        for stat in ("mean", "p50", "last"):
-            lines.append(f'sdtpu_stage_seconds{{stage="{_label(stage)}",'
-                         f'stat="{stat}"}} {_fmt(st[stat])}')
-        lines.append(f'sdtpu_stage_samples{{stage="{_label(stage)}"}} '
-                     f'{_fmt(st["count"])}')
+    _labeled_family(
+        lines, "sdtpu_stage_seconds", "gauge",
+        "Rolling stage wall-clock stats (StageStats window).",
+        [(f'stage="{_label(stage)}",stat="{stat}"', timings[stage][stat])
+         for stage in sorted(timings)
+         for stat in ("mean", "p50", "last")])
+    _labeled_family(
+        lines, "sdtpu_stage_samples", "gauge",
+        "Rolling StageStats sample count per stage.",
+        [(f'stage="{_label(stage)}"', timings[stage]["count"])
+         for stage in sorted(timings)])
 
     lines.extend(PRECISION_COUNTER.render())
     for c in FLEET_COUNTERS.values():
@@ -719,6 +747,13 @@ def render() -> str:
     lines.extend(WATCHDOG_COUNTER.render())
     lines.extend(CACHE_COUNTER.render())
     lines.extend(SIM_FAULT_COUNTER.render())
+    lines.extend(ALERT_COUNTER.render())
+    _labeled_family(
+        lines, "sdtpu_alert_state", "gauge",
+        "Current alert state by rule (1 = firing, 0 = resolved/ok); "
+        "rules absent until their first transition.",
+        [(f'rule="{_label(k)}"', v)
+         for k, v in sorted(alert_states().items())])
     burn = sim_slo_burn()
     if burn is not None:
         _scalar(lines, "sdtpu_sim_slo_burn", "gauge",

@@ -1,0 +1,481 @@
+"""In-process time-series store (SDTPU_TSDB): bounded metric history.
+
+``/internal/metrics`` renders *instantaneous* counter values; nothing in
+the plane can answer "when did queue-wait p95 start climbing?" or hand
+the autoscaler a windowed trend instead of a point read. This module
+keeps that history: a fixed-interval ring buffer per series, sampled by
+a daemon (or an explicit :func:`tick` for deterministic tests/bench)
+from the *existing* registered Prometheus families plus derived series:
+
+- ``queue_wait_p95_s`` / ``e2e_p95_s`` — rank-interpolated p95 over the
+  fixed-ladder histograms (sharper than the bucket-upper-bound estimate
+  ``Histogram.quantile`` serves);
+- ``slo_attainment.<tenant>.<class>`` / ``slo_burn.<tenant>.<class>`` —
+  per-tenant SLO rows from the perf ledger (plus ``slo_burn_worst``);
+- counter totals (requests, dispatches, compiles, worker failures,
+  UNAVAILABLE demotions, watchdog stalls) so windowed ``rate()`` /
+  ``increase()`` queries exist for the alert engine (obs/alerts.py);
+- ``hbm_bytes_in_use`` / ``hbm_peak_bytes`` / ``device_live_buffers``
+  — device-memory telemetry from ``jax.local_devices()[0]
+  .memory_stats()``. Null on CPU (no fabricated numbers): the series
+  simply never appears.
+
+Query primitives: :meth:`SeriesStore.rate`,
+:meth:`SeriesStore.avg_over_time`,
+:meth:`SeriesStore.quantile_over_time`, :meth:`SeriesStore.increase`.
+Served at ``GET /internal/tsdb`` (exact schema pinned by tests).
+
+Gated off by default: ``SDTPU_TSDB=1`` enables,
+``SDTPU_TSDB_INTERVAL_S`` sets the daemon cadence and
+``SDTPU_TSDB_POINTS`` the per-series ring depth. With the gate off no
+daemon starts, :func:`tick` is a no-op, and the serving path is
+byte-identical to the unsampled build (hash-pinned in
+tests/test_tsdb.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ..runtime.config import env_flag, env_float, env_int
+
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_POINTS = 512
+
+#: Bounded series-name namespace: adversarial tenant names must not grow
+#: the store without bound (same philosophy as SDTPU_PERF_GROUPS).
+_MAX_SERIES = 256
+
+#: Series the flight recorder snapshots into failure/stall entries —
+#: the postmortem view of "what the detectors saw" (satellite: flightrec
+#: enrichment). slo_burn.* / hbm_* series ride along by prefix.
+FLIGHT_SERIES: Tuple[str, ...] = (
+    "queue_wait_p95_s", "e2e_p95_s", "worker_failures_total",
+    "worker_unavailable_total", "watchdog_stalls_total",
+    "compiles_total", "slo_burn_worst")
+_FLIGHT_PREFIXES: Tuple[str, ...] = ("slo_burn.", "hbm_")
+_FLIGHT_POINTS = 64
+
+
+def enabled() -> bool:
+    """TSDB gate — re-read per call so tests can flip the env var."""
+    return env_flag("SDTPU_TSDB", False)
+
+
+def interval_s() -> float:
+    """Daemon sampling cadence (seconds)."""
+    return max(0.01, env_float("SDTPU_TSDB_INTERVAL_S", DEFAULT_INTERVAL_S))
+
+
+# -- derived-series math -----------------------------------------------------
+
+def quantile_from_counts(bounds: Tuple[float, ...], counts: List[int],
+                         n: int, q: float) -> float:
+    """Rank-interpolated quantile over cumulative-histogram bucket counts
+    (``counts`` per-bucket incl. the +Inf overflow slot, as
+    ``Histogram.snapshot`` returns them). Interpolates linearly inside
+    the bucket holding the target rank instead of reporting its upper
+    bound; the +Inf bucket clamps to the top finite bound."""
+    if n <= 0:
+        return 0.0
+    target = max(1.0, q * n)
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        if i >= len(bounds):
+            return float(bounds[-1])
+        hi = float(bounds[i])
+        if c > 0 and cum + c >= target:
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+        lo = hi
+    return float(bounds[-1])
+
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """HBM stats from the first addressable device, or None when the
+    backend has none to give (CPU, stubbed runtimes). Never fabricates
+    a number: a missing/empty ``memory_stats()`` reports None and no
+    ``hbm_*`` series is ever recorded for it."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        getter = getattr(dev, "memory_stats", None)
+        stats = getter() if callable(getter) else None
+    except Exception:  # noqa: BLE001 — telemetry stays passive
+        return None
+    if not stats:
+        return None
+    out: Dict[str, int] = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "num_allocs", "largest_alloc_size"):
+        if key in stats:
+            try:
+                out[key] = int(stats[key])
+            except (TypeError, ValueError):
+                continue
+    return out or None
+
+
+def live_buffer_count() -> Optional[int]:
+    """Count of live device arrays (the buffer census beside the HBM
+    watermark); None when the runtime can't enumerate them."""
+    try:
+        import jax
+
+        return len(jax.live_arrays())
+    except Exception:  # noqa: BLE001 — telemetry stays passive
+        return None
+
+
+# -- the store ---------------------------------------------------------------
+
+class SeriesStore:
+    """Bounded, lock-disciplined ring-buffer store: one fixed-depth ring
+    of (monotonic-time, value) samples per series name."""
+
+    def __init__(self, points: Optional[int] = None) -> None:
+        if points is None:
+            points = env_int("SDTPU_TSDB_POINTS", DEFAULT_POINTS)
+        self.points = max(8, int(points))
+        self._lock = threading.Lock()
+        # name -> ring of (t_mono, value)                guarded-by: _lock
+        self._series: "OrderedDict[str, Deque[Tuple[float, float]]]" = \
+            OrderedDict()
+        self._samples_total = 0                        # guarded-by: _lock
+        self._dropped_series = 0                       # guarded-by: _lock
+
+    def record(self, name: str, value: Any,
+               t: Optional[float] = None) -> None:
+        """Append one sample; silently drops non-numeric values and (once
+        the namespace cap is hit) samples for brand-new series."""
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        if t is None:
+            t = time.monotonic()
+        key = str(name)
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                if len(self._series) >= _MAX_SERIES:
+                    self._dropped_series += 1
+                    return
+                ring = deque(maxlen=self.points)
+                self._series[key] = ring
+            ring.append((float(t), v))
+            self._samples_total += 1
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._series)
+
+    def window(self, name: str, window_s: float,
+               now: Optional[float] = None) -> List[Tuple[float, float]]:
+        """Samples of ``name`` within the trailing ``window_s`` seconds
+        (oldest first); the whole ring when ``window_s`` <= 0."""
+        with self._lock:
+            ring = self._series.get(str(name))
+            samples = list(ring) if ring is not None else []
+        if not samples or window_s <= 0:
+            return samples
+        if now is None:
+            now = time.monotonic()
+        cutoff = now - float(window_s)
+        return [s for s in samples if s[0] >= cutoff]
+
+    def latest(self, name: str) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            ring = self._series.get(str(name))
+            return ring[-1] if ring else None
+
+    # -- windowed query primitives ----------------------------------------
+
+    def rate(self, name: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second increase of a counter series over the window
+        (prometheus ``rate()`` semantics, no reset handling — these
+        counters only reset with the process). None under 2 samples."""
+        w = self.window(name, window_s, now=now)
+        if len(w) < 2:
+            return None
+        dt = w[-1][0] - w[0][0]
+        if dt <= 0:
+            return None
+        return (w[-1][1] - w[0][1]) / dt
+
+    def increase(self, name: str, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Absolute increase of a counter series over the window; None
+        under 2 samples."""
+        w = self.window(name, window_s, now=now)
+        if len(w) < 2:
+            return None
+        return w[-1][1] - w[0][1]
+
+    def avg_over_time(self, name: str, window_s: float,
+                      now: Optional[float] = None) -> Optional[float]:
+        w = self.window(name, window_s, now=now)
+        if not w:
+            return None
+        return sum(v for _t, v in w) / len(w)
+
+    def quantile_over_time(self, name: str, q: float, window_s: float,
+                           now: Optional[float] = None) -> Optional[float]:
+        """Rank-interpolated q-quantile of the sampled values in the
+        window (None when empty)."""
+        w = self.window(name, window_s, now=now)
+        if not w:
+            return None
+        values = sorted(v for _t, v in w)
+        if len(values) == 1:
+            return values[0]
+        pos = max(0.0, min(1.0, float(q))) * (len(values) - 1)
+        i = int(pos)
+        frac = pos - i
+        if i + 1 >= len(values):
+            return values[-1]
+        return values[i] + (values[i + 1] - values[i]) * frac
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_once(self, now: Optional[float] = None) -> int:
+        """One sampling pass over every source; returns how many samples
+        landed. Reads only existing metric objects — never a device sync
+        beyond ``memory_stats()`` (a host-side allocator read)."""
+        if now is None:
+            now = time.monotonic()
+        recs: List[Tuple[str, Any]] = []
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                prometheus as obs_prom,
+            )
+
+            for key, series in (("queue_wait", "queue_wait_p95_s"),
+                                ("e2e", "e2e_p95_s")):
+                h = obs_prom.HISTOGRAMS[key]
+                counts, _total, n = h.snapshot()
+                if n > 0:
+                    recs.append((series, quantile_from_counts(
+                        h.bounds, counts, n, 0.95)))
+            recs.append(("worker_failures_total",
+                         obs_prom.WORKER_COUNTERS["failures"].total()))
+            recs.append(("worker_unavailable_total", sum(
+                v for k, v in
+                obs_prom.WORKER_COUNTERS["transitions"].snapshot().items()
+                if k and k[-1] == "UNAVAILABLE")))
+            recs.append(("watchdog_stalls_total",
+                         obs_prom.WATCHDOG_COUNTER.total()))
+        except Exception:  # noqa: BLE001 — sampling must never throw
+            pass
+        try:
+            from stable_diffusion_webui_distributed_tpu.serving.metrics \
+                import METRICS
+
+            s = METRICS.summary()
+            recs.append(("requests_total", s["requests"]))
+            recs.append(("dispatches_total", s["dispatches"]))
+            recs.append(("compiles_total", sum(s["compiles"].values())))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                perf as obs_perf,
+            )
+
+            worst = None
+            for row in obs_perf.LEDGER.summary()["slo"]:
+                tag = f'{row["tenant"]}.{row["class"]}'
+                recs.append((f"slo_attainment.{tag}", row["attainment"]))
+                burn = row["burn_rate"]
+                recs.append((f"slo_burn.{tag}", burn))
+                if burn is not None:
+                    worst = burn if worst is None else max(worst, burn)
+            if worst is not None:
+                recs.append(("slo_burn_worst", worst))
+        except Exception:  # noqa: BLE001
+            pass
+        mem = device_memory_stats()
+        if mem is not None:
+            if "bytes_in_use" in mem:
+                recs.append(("hbm_bytes_in_use", mem["bytes_in_use"]))
+            if "peak_bytes_in_use" in mem:
+                recs.append(("hbm_peak_bytes", mem["peak_bytes_in_use"]))
+            live = live_buffer_count()
+            if live is not None:
+                recs.append(("device_live_buffers", live))
+        landed = 0
+        for name, value in recs:
+            if value is None:
+                continue
+            self.record(name, value, t=now)
+            landed += 1
+        return landed
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self, max_points: Optional[int] = None,
+                 names: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Per-series sample dump (``samples`` oldest-first, trimmed to
+        the trailing ``max_points`` when given)."""
+        with self._lock:
+            items = [(k, list(ring)) for k, ring in self._series.items()
+                     if names is None or k in names]
+        out: Dict[str, Any] = {}
+        for name, samples in items:
+            if max_points is not None and len(samples) > max_points:
+                samples = samples[-max_points:]
+            out[name] = {
+                "count": len(samples),
+                "latest": list(samples[-1]) if samples else None,
+                "samples": [[t, v] for t, v in samples],
+            }
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"series": len(self._series),
+                    "samples_total": self._samples_total,
+                    "dropped_series": self._dropped_series}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._samples_total = 0
+            self._dropped_series = 0
+
+
+#: Process-wide store. Ring depth is resolved at construction; tests and
+#: bench call :func:`reset` after flipping the env knobs.
+STORE = SeriesStore()
+
+
+# -- sampling daemon ---------------------------------------------------------
+
+_DAEMON_LOCK = threading.Lock()
+_DAEMON: Optional["_Sampler"] = None  # guarded-by: _DAEMON_LOCK
+
+
+class _Sampler(threading.Thread):
+    """Fixed-interval sampling daemon; also drives the alert engine's
+    evaluation when SDTPU_ALERTS is on (one clock for both)."""
+
+    def __init__(self, store: SeriesStore, period_s: float) -> None:
+        super().__init__(name="sdtpu-tsdb-sampler", daemon=True)
+        self.store = store
+        self.period_s = period_s
+        # NOT named _stop: Thread.join() calls a private self._stop()
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            tick(store=self.store)
+            self._halt.wait(self.period_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def tick(store: Optional[SeriesStore] = None) -> int:
+    """One sample + alert-evaluation pass; no-op (0) with the gate off.
+    The daemon calls this on its cadence; tests and bench call it
+    directly for deterministic clocks."""
+    if not enabled():
+        return 0
+    if store is None:
+        store = STORE
+    landed = store.sample_once()
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            alerts as obs_alerts,
+        )
+
+        obs_alerts.evaluate()
+    except Exception:  # noqa: BLE001 — sampling must never throw
+        pass
+    return landed
+
+
+def start_daemon() -> bool:
+    """Start the sampling daemon (idempotent); False with the gate off."""
+    global _DAEMON
+    if not enabled():
+        return False
+    with _DAEMON_LOCK:
+        if _DAEMON is not None and _DAEMON.is_alive():
+            return True
+        _DAEMON = _Sampler(STORE, interval_s())
+        _DAEMON.start()
+    return True
+
+
+def stop_daemon() -> None:
+    global _DAEMON
+    with _DAEMON_LOCK:
+        daemon = _DAEMON
+        _DAEMON = None
+    if daemon is not None:
+        daemon.stop()
+        daemon.join(timeout=2.0)
+
+
+def reset() -> None:
+    """Stop the daemon and rebuild the store from the current env knobs
+    (tests/bench flip SDTPU_TSDB_POINTS between phases)."""
+    global STORE
+    stop_daemon()
+    STORE = SeriesStore()
+
+
+def dispatch_memory_sample() -> Optional[Dict[str, int]]:
+    """Per-dispatch device-memory read for the dispatcher: returns the
+    raw stats (for the perf ledger's group rows) and, when the TSDB gate
+    is on, records the HBM watermark + live-buffer census as series.
+    None on CPU — the ledger stores null, never a fabricated number."""
+    mem = device_memory_stats()
+    if mem is None:
+        return None
+    if enabled():
+        now = time.monotonic()
+        if "bytes_in_use" in mem:
+            STORE.record("hbm_bytes_in_use", mem["bytes_in_use"], t=now)
+        if "peak_bytes_in_use" in mem:
+            STORE.record("hbm_peak_bytes", mem["peak_bytes_in_use"], t=now)
+        live = live_buffer_count()
+        if live is not None:
+            STORE.record("device_live_buffers", live, t=now)
+    return mem
+
+
+def flight_window() -> Optional[Dict[str, Any]]:
+    """The bounded TSDB view the flight recorder attaches to failure and
+    watchdog-stall entries; None with the gate off (no-op enrichment)."""
+    if not enabled():
+        return None
+    keep = [n for n in STORE.names()
+            if n in FLIGHT_SERIES or n.startswith(_FLIGHT_PREFIXES)]
+    return {"interval_s": interval_s(),
+            "series": STORE.snapshot(max_points=_FLIGHT_POINTS,
+                                     names=keep)}
+
+
+def summary() -> Dict[str, Any]:
+    """The ``GET /internal/tsdb`` document (schema pinned by tests)."""
+    stats = STORE.stats()
+    with _DAEMON_LOCK:
+        daemon_alive = _DAEMON is not None and _DAEMON.is_alive()
+    return {
+        "enabled": enabled(),
+        "interval_s": interval_s(),
+        "points": STORE.points,
+        "daemon": daemon_alive,
+        "series_count": stats["series"],
+        "samples_total": stats["samples_total"],
+        "dropped_series": stats["dropped_series"],
+        "series": STORE.snapshot(),
+    }

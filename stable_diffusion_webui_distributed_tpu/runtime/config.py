@@ -221,6 +221,39 @@ def _logger():
 # - ``SDTPU_CACHE_PREFIX_MIN_STEPS`` (int, default 4): shallowest
 #   denoise step a prefix may be captured or resumed at — captures
 #   shallower than this are noise-dominated and not worth the bytes.
+# - ``SDTPU_JOURNAL_SINK_MAX_MB`` (float MB, default 0 = unbounded):
+#   size cap on the journal sink file. When the next spilled line would
+#   push the sink past the cap it rotates once via ``os.replace`` to
+#   ``<sink>.1`` (the previous ``.1`` is discarded — at most two files
+#   ever exist), so a long-running serving box keeps a bounded, recent
+#   tail. ``tools/replay.py`` loads the rotated pair as one contiguous
+#   stream; ``sink_status()`` reports bytes written and rotations.
+# - ``SDTPU_TSDB`` (flag, default off): in-process metric history
+#   (obs/tsdb.py) — a bounded ring buffer per series, sampled from the
+#   registered Prometheus families plus derived series (rank-
+#   interpolated queue-wait/e2e p95, per-tenant SLO burn, device-memory
+#   watermarks), served at ``GET /internal/tsdb`` and queried by the
+#   alert engine. Off (the default), no daemon starts, ``tick()`` is a
+#   no-op, and the serving path is byte-identical to the unsampled
+#   build (hash-pinned in tests/test_tsdb.py).
+# - ``SDTPU_TSDB_INTERVAL_S`` (float seconds, default 1.0, floor 0.01):
+#   sampling daemon cadence.
+# - ``SDTPU_TSDB_POINTS`` (int, default 512, floor 8): per-series ring
+#   depth; with the default 1s cadence that is ~8.5 minutes of history.
+# - ``SDTPU_ALERTS`` (flag, default off): the alert engine
+#   (obs/alerts.py) over the TSDB — multi-window multi-burn-rate SLO
+#   alerts, EWMA z-score anomaly detectors (queue wait, compile rate,
+#   error rate) and deterministic increase detectors (worker flap,
+#   watchdog stall) run through a pending/firing/resolved state machine.
+#   Transitions journal as ``alert_firing``/``alert_resolved``, export
+#   ``sdtpu_alert_state``/``sdtpu_alerts_total``, land firing flight-
+#   recorder entries, and feed the autoscaler's scale-up signal.
+#   Needs ``SDTPU_TSDB=1`` for data; off, ``evaluate()`` returns
+#   immediately and nothing changes.
+# - ``SDTPU_ALERT_TIMESCALE`` (float, default 1.0): multiplier on every
+#   rule's wall-clock windows so scenario runs compress the 5m/1h/6h
+#   SLO windows into seconds (``0.01`` -> 3s/36s/216s) without touching
+#   thresholds — ``bench.py --alerts`` validates with it.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -606,6 +606,21 @@ class ApiServer:
 
         return sim.summary()
 
+    def handle_tsdb(self) -> Dict[str, Any]:
+        """Metric-history store (obs/tsdb.py): gate, sampling cadence,
+        and the per-series ring contents. ``enabled`` is False until
+        SDTPU_TSDB=1 (the summary itself is always served)."""
+        from stable_diffusion_webui_distributed_tpu.obs import tsdb
+
+        return tsdb.summary()
+
+    def handle_alerts(self) -> Dict[str, Any]:
+        """Alert-engine state (obs/alerts.py): the closed rule registry,
+        per-rule pending/firing state, and the transition history."""
+        from stable_diffusion_webui_distributed_tpu.obs import alerts
+
+        return alerts.summary()
+
     def handle_executables(self) -> Dict[str, Any]:
         """Live compiled-executable census against the serving budget of
         <=2 step-cache x <=3 precision variants per shape bucket; the
@@ -862,6 +877,8 @@ class ApiServer:
             ("GET", "/internal/perf"): self.handle_perf,
             ("GET", "/internal/cache"): self.handle_cache,
             ("GET", "/internal/sim"): self.handle_sim,
+            ("GET", "/internal/tsdb"): self.handle_tsdb,
+            ("GET", "/internal/alerts"): self.handle_alerts,
             ("GET", "/internal/executables"): self.handle_executables,
             ("GET", "/internal/autoscale"): self.handle_autoscale,
             ("GET", "/internal/profile"): self.handle_profile_get,

@@ -60,6 +60,7 @@ from stable_diffusion_webui_distributed_tpu.obs import (
     journal as obs_journal,
     perf as obs_perf,
     prometheus as obs_prom,
+    tsdb as obs_tsdb,
     watchdog as obs_watchdog,
 )
 from stable_diffusion_webui_distributed_tpu.obs import spans as obs_spans
@@ -767,7 +768,10 @@ class ServingDispatcher:
                         padded_pixels=ticket.run.width
                         * ticket.run.height * n_run,
                         masked_pixels=masked_px,
-                        true_tokens=tok_t, padded_tokens=tok_p)
+                        true_tokens=tok_t, padded_tokens=tok_p,
+                        hbm=obs_tsdb.dispatch_memory_sample())
+                elif obs_tsdb.enabled():
+                    obs_tsdb.dispatch_memory_sample()
                 if ticket.bucketed:
                     result = self._restore_solo(result, ticket)
                 ticket.result = result
@@ -927,7 +931,12 @@ class ServingDispatcher:
                                 for t, n_p in zip(live, counts)),
                 padded_pixels=width * height * b_run,
                 masked_pixels=masked_px,
-                true_tokens=true_tok, padded_tokens=padded_tok)
+                true_tokens=true_tok, padded_tokens=padded_tok,
+                hbm=obs_tsdb.dispatch_memory_sample())
+        elif obs_tsdb.enabled():
+            # per-dispatch HBM watermark still lands in the TSDB series
+            # even when the perf ledger is off
+            obs_tsdb.dispatch_memory_sample()
         entries = engine._queue_decoded(latents, 0, b_raw, width, height)
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)

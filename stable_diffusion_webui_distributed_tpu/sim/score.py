@@ -142,6 +142,44 @@ def score_run(records: List[Dict[str, Any]],
     }
 
 
+def alert_validation(phases: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Score detector behavior against labeled ground truth (the
+    ``bench.py --alerts`` gate). Each phase is
+    ``{"name", "expected": [rule, ...], "fired": [rule, ...]}``: a phase
+    with no expected rules is steady traffic — every firing there is a
+    false positive — and a phase with expected rules is an injected
+    fault window, detected when any expected rule fired. Pure
+    arithmetic, unit-testable against hand-built phase lists."""
+    false_positives = 0
+    fp_rules: List[str] = []
+    fault_count = 0
+    detected = 0
+    rows: List[Dict[str, Any]] = []
+    for ph in phases:
+        fired = sorted(set(ph.get("fired") or []))
+        expected = sorted(set(ph.get("expected") or []))
+        row: Dict[str, Any] = {"name": str(ph.get("name", "")),
+                               "expected": expected, "fired": fired}
+        if not expected:
+            row["false_positives"] = len(fired)
+            false_positives += len(fired)
+            fp_rules.extend(fired)
+        else:
+            fault_count += 1
+            hit = bool(set(fired) & set(expected))
+            row["detected"] = hit
+            detected += 1 if hit else 0
+        rows.append(row)
+    return {
+        "phases": rows,
+        "alert_false_positives": false_positives,
+        "false_positive_rules": sorted(set(fp_rules)),
+        "faults": fault_count,
+        "detected": detected,
+        "alert_recall": (detected / fault_count) if fault_count else None,
+    }
+
+
 def ledger_metrics(score: Dict[str, Any]) -> Dict[str, Any]:
     """The bench_compare-gated flat view of a scorecard."""
     p95s = [row["p95_s"] for row in score["classes"].values()

@@ -1,0 +1,27 @@
+"""OB004 fixture: alert-rule registration outside obs/alerts.py.
+
+Loaded by tests/test_lint.py under a spoofed package-relative path so
+the alertrules pass sees it as package code.
+"""
+
+from stable_diffusion_webui_distributed_tpu.obs.alerts import (
+    AlertRule, register_rule,
+)
+
+# BAD (line 12): direct registration outside the closed registry
+register_rule(AlertRule(
+    name="rogue_rule", kind="increase", series="rogue_total",
+    description="unexercised by the bench recall gate"))
+
+
+def register_later(rule):
+    # BAD (line 19): aliased/indirect spelling inside a function scope
+    register_rule(rule)
+
+
+# OK: constructing a rule without registering it (tests do this freely)
+THROWAWAY = AlertRule(name="scratch", kind="anomaly", series="x",
+                      description="never registered")
+
+# OK: deliberate plugin-site registration, marker-exempt
+register_rule(THROWAWAY)  # sdtpu-lint: alert

@@ -36,3 +36,8 @@ NOTE = "completed"
 # that fault_injected / fault_cleared joined the closed vocabulary.
 obs_journal.emit("fault_injected", "chaos-0", kind="kill")
 obs_journal.emit("fault_cleared", "chaos-0", kind="kill")
+
+# Alerting-plane vocabulary pin (obs/alerts.py state machine): same
+# deal — flagged standalone, accepted beside the real registry.
+obs_journal.emit("alert_firing", "alert-slo", rule="slo_burn_fast")
+obs_journal.emit("alert_resolved", "alert-slo", rule="slo_burn_fast")

@@ -69,9 +69,9 @@ class TestRepoGate:
 
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "TP004", "RC001", "RC002",
-                     "RC003", "EV001", "OB001", "OB002", "OB003", "LK001",
-                     "LK002", "LK003", "LK004", "DN001", "FL001", "AL001",
-                     "AL002", "CA001"):
+                     "RC003", "EV001", "OB001", "OB002", "OB003", "OB004",
+                     "LK001", "LK002", "LK003", "LK004", "DN001", "FL001",
+                     "AL001", "AL002", "CA001"):
             assert rule in RULES and RULES[rule]
 
 
@@ -203,6 +203,8 @@ class TestFixtures:
             ("OB003", 19),  # keyword spelling of the event argument
             ("OB003", 37),  # chaos pin: unregistered without the registry
             ("OB003", 38),  # chaos pin: unregistered without the registry
+            ("OB003", 42),  # alert pin: unregistered without the registry
+            ("OB003", 43),  # alert pin: unregistered without the registry
         }
         # dynamic event names, the marker-exempt literal, and plain
         # non-emit strings stay clean
@@ -220,10 +222,34 @@ class TestFixtures:
             "stable_diffusion_webui_distributed_tpu/serving/jb.py")
         found = _rule_lines(analyze_modules([registry, caller]))
         # the bad literals still fire; "completed"-class names would not,
-        # and the fault_injected/fault_cleared pins (lines 37-38) prove
-        # the chaos events are registered in the real vocabulary
+        # the fault_injected/fault_cleared pins (lines 37-38) prove the
+        # chaos events are registered in the real vocabulary, and the
+        # alert_firing/alert_resolved pins (lines 42-43) the same for
+        # the alerting plane
         assert {f for f in found if f[0] == "OB003"} == {
             ("OB003", 12), ("OB003", 17), ("OB003", 19)}
+
+    def test_alert_family(self):
+        # OB004: register_rule calls are confined to obs/alerts.py. The
+        # fixture analyzes under a spoofed serving/ path — outside the
+        # registry module — so both registration shapes fire.
+        rel = "stable_diffusion_webui_distributed_tpu/serving/alert_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "alert_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert {f for f in found if f[0] == "OB004"} == {
+            ("OB004", 12),  # direct registration outside the registry
+            ("OB004", 19),  # indirect spelling inside a function
+        }
+        # bare AlertRule construction and the '# sdtpu-lint: alert'
+        # marker (deliberate plugin site) stay clean
+
+    def test_alert_rule_exempts_registry_module(self):
+        # the same calls inside obs/alerts.py are the registry's own
+        # closed rule set: zero OB004 findings
+        rel = "stable_diffusion_webui_distributed_tpu/obs/alerts.py"
+        mod = load_module(os.path.join(FIXTURES, "alert_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert not {f for f in found if f[0] == "OB004"}
 
     def test_cache_family(self):
         # CA001: payload hashing and hand-built cache keys outside

@@ -23,6 +23,7 @@ behind a real ApiServer). Covers:
 """
 
 import json
+import re
 import sys
 import threading
 import time
@@ -534,6 +535,35 @@ class TestStitch:
         assert rtt == 2000.0
         assert offset == 5000.0  # midpoint 6000 - remote 1000
 
+    def test_clock_offset_negative_when_remote_ahead(self):
+        # remote clock AHEAD of the local one: the correction must come
+        # out negative so remote timestamps shift BACK onto the local
+        # timeline
+        doc = {"clock_us": 10_000.0}
+        offset, rtt = obs_stitch.clock_offset_us(doc, 5000.0, 7000.0)
+        assert rtt == 2000.0
+        assert offset == -4000.0  # midpoint 6000 - remote 10000
+        # applying it lands the remote sample at the local RTT midpoint
+        events = []
+        obs_stitch.merge_remote(
+            events, {"traceEvents": [{"name": "g", "ts": 10_000.0}]},
+            "ahead", offset)
+        assert events[0]["ts"] == 6000.0
+
+    def test_clock_offset_asymmetric_rtt_error_bounded(self):
+        # the midpoint assumption is exact only for symmetric paths;
+        # with a lopsided round trip (the remote sample lands anywhere
+        # between send and receive) the placement error stays bounded by
+        # rtt/2 and the corrected sample stays inside [t0, t1]
+        t0, t1 = 5000.0, 7000.0
+        for outbound_frac in (0.0, 0.25, 0.9, 1.0):
+            remote = t0 + outbound_frac * (t1 - t0)  # true offset: zero
+            offset, rtt = obs_stitch.clock_offset_us(
+                {"clock_us": remote}, t0, t1)
+            assert rtt == 2000.0
+            assert abs(offset) <= rtt / 2.0
+            assert t0 <= remote + offset <= t1
+
     def test_merge_retags_and_shifts(self):
         events = []
         n = obs_stitch.merge_remote(
@@ -591,3 +621,115 @@ class TestStitch:
         # same request id -> same trace id field
         assert tp1.split("-")[1] == tp2.split("-")[1]
         assert obs_spans.traceparent() is None  # outside any request
+
+
+class TestPrometheusConformance:
+    """Strict text-format parse of the whole exposition: every sample
+    name must trace back to a registered family, every family gets
+    exactly one ``# HELP``/``# TYPE`` pair (emitted before its samples),
+    and label bodies must round-trip the escaping grammar."""
+
+    _SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                         r"(?:\{(.*)\})? (\S+)$")
+    _LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+    _LABEL_BODY = re.compile(r"^%s(?:,%s)*$" % (_LABEL, _LABEL))
+
+    @staticmethod
+    def _family(sample_name, registry):
+        """Collapse histogram sample suffixes onto the family name."""
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name[:-len(suffix)] \
+                if sample_name.endswith(suffix) else None
+            if base and registry.get(base, ("",))[0] == "histogram":
+                return base
+        return sample_name
+
+    def _seed_every_family_shape(self):
+        # one of each rendering path: bare histograms, labeled
+        # multi-instance histogram families, labeled counters, scalar
+        # gauges, the alert plane, and a label value that needs escaping
+        obs_prom.observe_hist("e2e", 0.5)
+        obs_prom.observe_hist("queue_wait", 0.1)
+        obs_prom.fleet_observe_queue_wait("interactive", 0.2)
+        obs_prom.fleet_observe_queue_wait("batch", 1.5)
+        obs_prom.observe_compile("unet", 2.5)
+        obs_prom.observe_compile("vae", 0.25)
+        obs_prom.fleet_count("admissions", **{"class": "interactive",
+                                              "decision": "accept"})
+        obs_prom.worker_count("failures", worker='w"eird\\label')
+        obs_prom.set_worker_latency("w1", 1.25)
+        obs_prom.alert_count("watchdog_stall", "firing")
+        obs_prom.set_alert_state("watchdog_stall", 1.0)
+        obs_prom.set_alert_state("slo_burn_fast", 0.0)
+
+    def test_exposition_parses_strictly(self):
+        obs_prom.clear_histograms()
+        self._seed_every_family_shape()
+        text = obs_prom.render()  # lazy families register on first render
+        registry = obs_prom.registered_metrics()
+        help_seen: dict = {}
+        type_seen: dict = {}
+        sampled: set = set()
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                _, _, name, help_text = line.split(" ", 3)
+                assert name not in help_seen, \
+                    f"duplicate # HELP for {name}"
+                assert name in registry, f"# HELP for unregistered {name}"
+                assert help_text == registry[name][1]
+                help_seen[name] = True
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, mtype = line.split(" ", 3)
+                assert name not in type_seen, \
+                    f"duplicate # TYPE for {name}"
+                assert mtype in ("counter", "gauge", "histogram")
+                assert registry.get(name, ("",))[0] == mtype
+                # HELP precedes TYPE precedes samples, per family
+                assert name in help_seen
+                assert name not in sampled
+                type_seen[name] = True
+                continue
+            assert not line.startswith("#"), f"stray comment: {line!r}"
+            m = self._SAMPLE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name, labels, value = m.groups()
+            family = self._family(name, registry)
+            assert family in registry, f"unregistered sample {name}"
+            assert family in type_seen, \
+                f"sample for {name} before its # TYPE header"
+            sampled.add(family)
+            if labels is not None:
+                assert self._LABEL_BODY.match(labels), \
+                    f"bad label body: {labels!r}"
+            float(value)  # bare ints, repr floats, NaN all parse
+        # every family that emitted samples carried exactly one header
+        # pair, and the header-only invariant holds the other way too
+        assert sampled <= set(type_seen) <= set(help_seen)
+        for name in ("sdtpu_request_e2e_seconds",
+                     "sdtpu_fleet_queue_wait_seconds",
+                     "sdtpu_compile_seconds",
+                     "sdtpu_fleet_admissions_total",
+                     "sdtpu_worker_failures_total",
+                     "sdtpu_alerts_total", "sdtpu_alert_state"):
+            assert name in sampled, f"expected family {name} missing"
+
+    def test_label_escaping_round_trips(self):
+        obs_prom.clear_histograms()
+        obs_prom.worker_count("failures", worker='w"eird\\label')
+        text = obs_prom.render()
+        # backslash first, then the quote — double-escaping would show
+        # as \\\" and a raw quote would break the sample grammar
+        assert 'worker="w\\"eird\\\\label"' in text
+        bad = [ln for ln in text.splitlines()
+               if ln and not ln.startswith("#")
+               and not self._SAMPLE.match(ln)]
+        assert bad == []
+
+    def test_registered_families_all_carry_help_text(self):
+        for name, (mtype, help_text) in \
+                obs_prom.registered_metrics().items():
+            assert mtype in ("counter", "gauge", "histogram"), name
+            assert help_text.strip(), f"{name} registered without help"

@@ -180,7 +180,9 @@ class TestJournalSink:
         assert len(lines) == 6
         spilled = [json.loads(ln) for ln in lines]
         assert sorted(e["seq"] for e in spilled) == [1, 2, 3, 4, 5, 6]
-        assert j.sink_status() == {"path": str(sink), "spilled": 6}
+        assert j.sink_status() == {"path": str(sink), "spilled": 6,
+                                   "bytes": sink.stat().st_size,
+                                   "rotations": 0}
         # snapshot schema is unchanged by the sink
         assert set(j.snapshot()) == {"enabled", "capacity", "count",
                                      "total_emitted", "events"}
@@ -191,7 +193,60 @@ class TestJournalSink:
         j = obs_journal.EventJournal(capacity=2)
         for i in range(5):
             j.emit("received", f"r-{i}")
-        assert j.sink_status() == {"path": "", "spilled": 0}
+        assert j.sink_status() == {"path": "", "spilled": 0,
+                                   "bytes": 0, "rotations": 0}
+
+    def test_size_cap_rotates_to_dot1(self, tmp_path, monkeypatch):
+        sink = tmp_path / "journal.jsonl"
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK", str(sink))
+        # cap ~= 7 event lines: the 10 evictions below rotate exactly
+        # once, so the .1 + live pair still holds the full record
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK_MAX_MB", "0.00076")
+        j = obs_journal.EventJournal(capacity=2)
+        for i in range(12):
+            j.emit("received", f"r-{i}", idx=i)
+        st = j.sink_status()
+        assert st["spilled"] == 10
+        assert st["rotations"] == 1
+        rotated = tmp_path / "journal.jsonl.1"
+        assert rotated.exists()
+        # single rollover: no .2 chain ever appears
+        assert not (tmp_path / "journal.jsonl.2").exists()
+        # the live file restarted under the cap; bytes tracks it exactly
+        assert st["bytes"] == sink.stat().st_size
+        assert 0 < st["bytes"] <= obs_journal.sink_max_bytes()
+        # tools/replay loads the rotated pair as one contiguous stream
+        snap = replay.load_snapshot(str(sink))
+        assert [e["seq"] for e in snap["events"]] == list(range(1, 11))
+
+    def test_rotated_pair_replays_all(self, tmp_path, monkeypatch):
+        sink = tmp_path / "journal.jsonl"
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK", str(sink))
+        monkeypatch.setenv("SDTPU_JOURNAL_SINK_MAX_MB", "0.002")
+        j = obs_journal.EventJournal(capacity=2)
+        for i in range(6):
+            dump = payload(seed=300 + i).model_dump()
+            j.emit("received", f"rot-{i}", payload=dump,
+                   fingerprint=obs_journal.fingerprint(dump))
+            j.emit("completed", f"rot-{i}", seeds=[300 + i])
+        assert j.sink_status()["rotations"] >= 1
+        # replay --all reconstructs the retained requests across the
+        # pair (repeated rotations drop the oldest chunks by design);
+        # the .1 file's events come first, so seqs read contiguously
+        snap = replay.load_snapshot(str(sink))
+        seqs = [e["seq"] for e in snap["events"]]
+        assert seqs == sorted(seqs) and len(seqs) >= 2
+        rids = replay.request_ids(snap)
+        assert rids
+        replayable = 0
+        for rid in rids:
+            plan = replay.reconstruct(replay.events_for(snap, rid))
+            if plan.outcome.get("status") == "completed" \
+                    and plan.payload is not None:
+                replayable += 1
+        assert replayable >= 1
 
     def test_loaders_read_sink_and_snapshot(self, tmp_path, monkeypatch):
         sink = tmp_path / "sink.jsonl"
@@ -475,7 +530,8 @@ class TestSimEndpoint:
         out = call(server, "/internal/sim")
         assert set(out) == {"enabled", "sink", "chaos", "last_run"}
         assert out["enabled"] is False
-        assert set(out["sink"]) == {"path", "spilled"}
+        assert set(out["sink"]) == {"path", "spilled", "bytes",
+                                    "rotations"}
         assert out["chaos"] == {"armed": False, "plan": None}
         assert out["last_run"] is None
 

@@ -779,3 +779,90 @@ class TestLintReport:
         bad = bench_compare.compare(row(0, 4.0), row(1, 4.0))
         assert bad["ok"] is False
         assert bad["regressions"] == ["lint_finding_count"]
+
+
+class TestAlertReport:
+    @staticmethod
+    def _doc(fps=0, missed=False):
+        steady_fired = ["queue_wait_anomaly"] if fps else []
+        kill_fired = [] if missed else ["error_rate_anomaly"]
+        phases = [
+            {"name": "steady", "expected": [], "fired": steady_fired,
+             "false_positives": len(steady_fired), "detected": None},
+            {"name": "chaos_kill",
+             "expected": ["error_rate_anomaly", "worker_flap"],
+             "fired": kill_fired, "false_positives": 0,
+             "detected": bool(kill_fired)},
+            {"name": "chaos_stall", "expected": ["watchdog_stall"],
+             "fired": ["watchdog_stall"], "false_positives": 0,
+             "detected": True},
+        ]
+        detected = sum(1 for p in phases if p["detected"])
+        faults = 2
+        return {
+            "device": "cpu",
+            "validation": {
+                "phases": phases,
+                "alert_false_positives": len(steady_fired),
+                "false_positive_rules": steady_fired,
+                "faults": faults,
+                "detected": detected,
+                "alert_recall": detected / faults,
+            },
+            "history": [
+                {"rule": "watchdog_stall", "from": "pending",
+                 "to": "firing", "t": 1.0, "value": 1.0,
+                 "detail": "window increase 1 vs 1"},
+                {"rule": "watchdog_stall", "from": "firing", "to": "ok",
+                 "t": 2.0, "value": 0.0, "detail": "aged out"},
+            ],
+        }
+
+    def test_rule_scores_arithmetic(self):
+        import alert_report
+
+        scores = alert_report.rule_scores(self._doc()["validation"]
+                                          ["phases"])
+        # fired in its expected window, never in steady
+        assert scores["error_rate_anomaly"] == {
+            "true_positives": 1, "false_positives": 0,
+            "fault_windows": 1, "precision": 1.0, "recall": 1.0}
+        # expected but silent: sibling covered the window, still recall 0
+        # for the rule itself
+        assert scores["worker_flap"]["recall"] == 0.0
+        assert scores["worker_flap"]["precision"] is None
+        fp = alert_report.rule_scores(self._doc(fps=1)["validation"]
+                                      ["phases"])
+        assert fp["queue_wait_anomaly"]["false_positives"] == 1
+        assert fp["queue_wait_anomaly"]["precision"] == 0.0
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        import alert_report
+
+        clean = tmp_path / "BENCH_alerts.json"
+        clean.write_text(json.dumps(self._doc()))
+        assert alert_report.main([str(clean)]) == 0
+        out = capsys.readouterr().out
+        assert "CLEAN" in out and "DETECTED" in out
+        assert "firing history" in out
+
+        assert alert_report.main([str(clean), "--json"]) == 0
+        digest = json.loads(capsys.readouterr().out)
+        assert digest["alert_recall"] == 1.0
+
+        fp = tmp_path / "fp.json"
+        fp.write_text(json.dumps(self._doc(fps=1)))
+        assert alert_report.main([str(fp)]) == 1
+        assert "FALSE POSITIVE" in capsys.readouterr().out
+
+        miss = tmp_path / "miss.json"
+        miss.write_text(json.dumps(self._doc(missed=True)))
+        assert alert_report.main([str(miss)]) == 1
+        assert "MISSED" in capsys.readouterr().out
+
+        (tmp_path / "garbage.json").write_text("{not json")
+        assert alert_report.main([str(tmp_path / "garbage.json")]) == 2
+        assert alert_report.main([str(tmp_path / "missing.json")]) == 2
+        # an artifact from a bench that died before phase validation
+        (tmp_path / "dead.json").write_text(json.dumps({"device": "cpu"}))
+        assert alert_report.main([str(tmp_path / "dead.json")]) == 2

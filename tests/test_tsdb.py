@@ -1,0 +1,534 @@
+"""Metric history (obs/tsdb.py) + alert engine (obs/alerts.py).
+
+The store and query primitives are tested with explicit timestamps; the
+alert state machine with a synthetic :class:`SeriesStore` and a fake
+clock, so every pending -> firing -> resolved transition is
+deterministic. The gate-off path is hash-pinned through the goldens
+mechanism (the serving path must stay byte-identical with SDTPU_TSDB /
+SDTPU_ALERTS unset).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.obs import alerts as obs_alerts
+from stable_diffusion_webui_distributed_tpu.obs import flightrec
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs import (
+    prometheus as obs_prom,
+)
+from stable_diffusion_webui_distributed_tpu.obs import tsdb as obs_tsdb
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from test_goldens import _check
+from test_pipeline import init_params
+
+
+@pytest.fixture()
+def tsdb_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_TSDB", "1")
+    obs_tsdb.reset()
+    yield obs_tsdb.STORE
+    obs_tsdb.reset()
+
+
+@pytest.fixture()
+def alerts_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_TSDB", "1")
+    monkeypatch.setenv("SDTPU_ALERTS", "1")
+    obs_tsdb.reset()
+    obs_alerts.reset()
+    yield obs_alerts.ENGINE
+    obs_alerts.reset()
+    obs_tsdb.reset()
+
+
+# -- derived-series math -----------------------------------------------------
+
+class TestQuantileFromCounts:
+    def test_interpolates_inside_the_bucket(self):
+        # 10 samples uniformly in the (1.0, 2.0] bucket: rank
+        # interpolation spreads them across the bucket instead of
+        # reporting the 2.0 upper bound for every quantile
+        bounds = (1.0, 2.0, 4.0)
+        counts = [0, 10, 0, 0]  # incl. +Inf overflow slot
+        q50 = obs_tsdb.quantile_from_counts(bounds, counts, 10, 0.5)
+        q95 = obs_tsdb.quantile_from_counts(bounds, counts, 10, 0.95)
+        assert 1.0 < q50 < q95 <= 2.0
+        assert q50 == pytest.approx(1.5)
+
+    def test_overflow_bucket_clamps_to_top_bound(self):
+        bounds = (1.0, 2.0)
+        counts = [0, 0, 5]  # everything in +Inf
+        assert obs_tsdb.quantile_from_counts(bounds, counts, 5, 0.95) == 2.0
+
+    def test_empty_histogram_is_zero(self):
+        assert obs_tsdb.quantile_from_counts((1.0,), [0, 0], 0, 0.95) == 0.0
+
+
+# -- the store ---------------------------------------------------------------
+
+class TestSeriesStore:
+    def _store(self, points=32):
+        return obs_tsdb.SeriesStore(points=points)
+
+    def test_ring_is_bounded_and_ordered(self):
+        s = self._store(points=8)
+        for i in range(20):
+            s.record("x", float(i), t=float(i))
+        w = s.window("x", 0)  # <=0 window: the whole ring
+        assert len(w) == 8
+        assert [v for _t, v in w] == [float(i) for i in range(12, 20)]
+        assert s.latest("x") == (19.0, 19.0)
+
+    def test_non_numeric_samples_are_dropped(self):
+        s = self._store()
+        s.record("x", "not-a-number", t=1.0)
+        s.record("x", None, t=2.0)
+        assert s.names() == []
+        assert s.stats()["samples_total"] == 0
+
+    def test_window_filters_by_time(self):
+        s = self._store()
+        for t in (1.0, 5.0, 9.0):
+            s.record("x", t, t=t)
+        assert [t for t, _v in s.window("x", 5.0, now=10.0)] == [5.0, 9.0]
+
+    def test_rate_and_increase(self):
+        s = self._store()
+        s.record("c", 10.0, t=0.0)
+        s.record("c", 30.0, t=10.0)
+        assert s.rate("c", 60.0, now=10.0) == pytest.approx(2.0)
+        assert s.increase("c", 60.0, now=10.0) == pytest.approx(20.0)
+        # under 2 samples in the window -> None, not 0
+        assert s.rate("c", 5.0, now=10.0) is None
+        assert s.increase("missing", 60.0) is None
+
+    def test_avg_and_quantile_over_time(self):
+        s = self._store()
+        for i, v in enumerate([1.0, 2.0, 3.0, 10.0]):
+            s.record("x", v, t=float(i))
+        assert s.avg_over_time("x", 100.0, now=4.0) == pytest.approx(4.0)
+        assert s.quantile_over_time("x", 0.5, 100.0, now=4.0) \
+            == pytest.approx(2.5)
+        assert s.quantile_over_time("x", 1.0, 100.0, now=4.0) == 10.0
+        assert s.quantile_over_time("x", 0.5, 100.0, now=1e9) is None
+
+    def test_series_namespace_is_capped(self):
+        s = self._store()
+        for i in range(obs_tsdb._MAX_SERIES + 5):
+            s.record(f"adversarial.{i}", 1.0, t=1.0)
+        st = s.stats()
+        assert st["series"] == obs_tsdb._MAX_SERIES
+        assert st["dropped_series"] == 5
+
+    def test_snapshot_schema_and_trim(self):
+        s = self._store()
+        for i in range(6):
+            s.record("x", float(i), t=float(i))
+        snap = s.snapshot(max_points=3)
+        assert set(snap) == {"x"}
+        assert set(snap["x"]) == {"count", "latest", "samples"}
+        assert snap["x"]["count"] == 3
+        assert snap["x"]["samples"] == [[3.0, 3.0], [4.0, 4.0], [5.0, 5.0]]
+        assert snap["x"]["latest"] == [5.0, 5.0]
+
+
+class TestSamplingAndGate:
+    def test_tick_is_a_noop_with_the_gate_off(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        obs_tsdb.reset()
+        assert obs_tsdb.enabled() is False
+        assert obs_tsdb.tick() == 0
+        assert obs_tsdb.STORE.names() == []
+        assert obs_tsdb.start_daemon() is False
+        assert obs_tsdb.flight_window() is None
+
+    def test_sample_once_lands_counter_series(self, tsdb_on):
+        obs_prom.observe_hist("queue_wait", 0.2)
+        obs_prom.observe_hist("e2e", 1.0)
+        landed = obs_tsdb.tick()
+        assert landed > 0
+        names = set(obs_tsdb.STORE.names())
+        assert {"queue_wait_p95_s", "e2e_p95_s", "worker_failures_total",
+                "watchdog_stalls_total"} <= names
+
+    def test_daemon_starts_and_stops(self, tsdb_on, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB_INTERVAL_S", "0.01")
+        assert obs_tsdb.start_daemon() is True
+        assert obs_tsdb.start_daemon() is True  # idempotent
+        assert obs_tsdb.summary()["daemon"] is True
+        obs_tsdb.stop_daemon()
+        assert obs_tsdb.summary()["daemon"] is False
+
+    def test_points_knob_resizes_on_reset(self, tsdb_on, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB_POINTS", "16")
+        obs_tsdb.reset()
+        try:
+            assert obs_tsdb.STORE.points == 16
+        finally:
+            monkeypatch.delenv("SDTPU_TSDB_POINTS")
+            obs_tsdb.reset()
+
+    def test_summary_schema(self, tsdb_on):
+        obs_tsdb.tick()
+        doc = obs_tsdb.summary()
+        assert set(doc) == {"enabled", "interval_s", "points", "daemon",
+                            "series_count", "samples_total",
+                            "dropped_series", "series"}
+        assert doc["enabled"] is True
+        assert doc["series_count"] == len(doc["series"])
+
+    def test_flight_window_is_bounded_and_filtered(self, tsdb_on):
+        for i in range(100):
+            obs_tsdb.STORE.record("worker_failures_total", float(i),
+                                  t=float(i))
+            obs_tsdb.STORE.record("slo_burn.t.interactive", 1.0, t=float(i))
+            obs_tsdb.STORE.record("requests_total", float(i), t=float(i))
+        win = obs_tsdb.flight_window()
+        assert set(win) == {"interval_s", "series"}
+        assert set(win["series"]) == {"worker_failures_total",
+                                      "slo_burn.t.interactive"}
+        for doc in win["series"].values():
+            assert doc["count"] <= obs_tsdb._FLIGHT_POINTS
+
+
+# -- the alert engine --------------------------------------------------------
+
+def _engine_with_store():
+    """A synthetic store + fake-clock engine: tests advance ``clock[0]``
+    and record samples with explicit timestamps."""
+    store = obs_tsdb.SeriesStore(points=128)
+    clock = [0.0]
+    engine = obs_alerts.AlertEngine(store=store,
+                                    clock=lambda: clock[0])
+    return store, clock, engine
+
+
+class TestAlertEngine:
+    def test_increase_rule_fires_and_resolves(self, alerts_on):
+        store, clock, eng = _engine_with_store()
+        store.record("watchdog_stalls_total", 0.0, t=0.0)
+        clock[0] = 1.0
+        store.record("watchdog_stalls_total", 0.0, t=1.0)
+        assert eng.evaluate() == []  # flat counter: no transition
+        clock[0] = 2.0
+        store.record("watchdog_stalls_total", 1.0, t=2.0)
+        (t,) = eng.evaluate()
+        assert (t["rule"], t["from"], t["to"]) == \
+            ("watchdog_stall", "ok", "firing")
+        assert eng.firing() == ["watchdog_stall"]
+        # the stall ages out of the fast window -> resolved
+        clock[0] = 4000.0
+        store.record("watchdog_stalls_total", 1.0, t=3999.0)
+        store.record("watchdog_stalls_total", 1.0, t=4000.0)
+        (t,) = eng.evaluate()
+        assert (t["rule"], t["from"], t["to"]) == \
+            ("watchdog_stall", "firing", "ok")
+        assert eng.firing() == []
+
+    def test_burn_rule_needs_both_windows(self, alerts_on, monkeypatch):
+        monkeypatch.setenv("SDTPU_ALERT_TIMESCALE", "0.01")  # 3s / 36s
+        store, clock, eng = _engine_with_store()
+        # long window hot, short window cooled off: min(short, long)
+        # stays under threshold -> no alert (the anti-flap property)
+        for t in range(0, 30):
+            store.record("slo_burn.t.rt", 20.0, t=float(t))
+        for t in range(30, 36):
+            store.record("slo_burn.t.rt", 1.0, t=float(t))
+        clock[0] = 36.0
+        first = {t["rule"] for t in eng.evaluate() if t["to"] == "firing"}
+        assert "slo_burn_fast" not in first  # fast window cooled off
+        # both fast windows over 14.4 -> slo_burn_fast fires
+        for t in range(36, 40):
+            store.record("slo_burn.t.rt", 30.0, t=float(t))
+        clock[0] = 40.0
+        fired = {t["rule"] for t in eng.evaluate() if t["to"] == "firing"}
+        assert "slo_burn_fast" in fired
+        assert eng.scale_up_firing() == sorted(
+            n for n in eng.firing()
+            if obs_alerts.registered_rules()[n].scale_up)
+
+    def test_anomaly_rule_warms_up_then_latches(self, alerts_on):
+        store, clock, eng = _engine_with_store()
+        rule = obs_alerts.registered_rules()["queue_wait_anomaly"]
+        # flat baseline through warmup: never fires
+        for i in range(rule.warmup + 2):
+            clock[0] = float(i)
+            store.record("queue_wait_p95_s", 0.05, t=float(i))
+            assert eng.evaluate() == []
+        # a runaway regime change (the EWMA chases, so only an
+        # escalating series stays z-anomalous) must sustain for_count
+        # evaluations: pending on the first hit, firing on the last
+        states = []
+        for i, v in enumerate([5.0, 50.0, 500.0][:rule.for_count]):
+            clock[0] = 100.0 + i
+            store.record("queue_wait_p95_s", v, t=100.0 + i)
+            eng.evaluate()
+            states.append(eng.state()["rules"]["queue_wait_anomaly"]
+                          ["state"])
+        assert states[:-1] == ["pending"] * (rule.for_count - 1)
+        assert states[-1] == "firing"
+
+    def test_anomaly_min_value_floor_blocks_quiet_series(self, alerts_on):
+        store, clock, eng = _engine_with_store()
+        # z-score explodes (0.001 -> 0.1) but stays under the 0.25s
+        # absolute floor: a quiet series cannot alarm on noise
+        for i in range(12):
+            clock[0] = float(i)
+            store.record("queue_wait_p95_s", 0.001, t=float(i))
+            eng.evaluate()
+        clock[0] = 50.0
+        store.record("queue_wait_p95_s", 0.1, t=50.0)
+        assert eng.evaluate() == []
+
+    def test_pending_self_clears_on_a_single_spike(self, alerts_on):
+        store, clock, eng = _engine_with_store()
+        for i in range(12):
+            clock[0] = float(i)
+            store.record("queue_wait_p95_s", 0.05, t=float(i))
+            eng.evaluate()
+        clock[0] = 50.0
+        store.record("queue_wait_p95_s", 5.0, t=50.0)
+        eng.evaluate()
+        assert eng.state()["rules"]["queue_wait_anomaly"]["state"] \
+            == "pending"
+        # back to baseline before for_count sustains -> ok, no firing
+        for i in range(3):
+            clock[0] = 51.0 + i
+            store.record("queue_wait_p95_s", 0.05, t=51.0 + i)
+            eng.evaluate()
+        st = eng.state()["rules"]["queue_wait_anomaly"]
+        assert st["state"] == "ok"
+        assert all(e["to"] != "firing" for e in eng.history())
+
+    def test_history_entry_shape_and_bound(self, alerts_on):
+        store, clock, eng = _engine_with_store()
+        store.record("watchdog_stalls_total", 0.0, t=0.0)
+        store.record("watchdog_stalls_total", 1.0, t=1.0)
+        clock[0] = 1.0
+        eng.evaluate()
+        (e,) = eng.history()
+        assert set(e) == {"rule", "from", "to", "t", "value", "detail"}
+        assert eng._history.maxlen == obs_alerts._HISTORY_CAP
+
+    def test_gated_module_functions(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_ALERTS", raising=False)
+        assert obs_alerts.evaluate() == []
+        assert obs_alerts.firing() == []
+        assert obs_alerts.scale_up_firing() == []
+        assert obs_alerts.state_snapshot() is None
+
+    def test_summary_schema(self, alerts_on):
+        doc = obs_alerts.summary()
+        assert set(doc) == {"enabled", "timescale", "registered",
+                            "rules", "firing", "history"}
+        assert doc["enabled"] is True
+        assert set(doc["registered"]) == set(obs_alerts.registered_rules())
+        for meta in doc["registered"].values():
+            assert set(meta) == {"kind", "series", "description",
+                                 "scale_up"}
+
+    def test_reregistering_a_rule_name_raises(self):
+        with pytest.raises(ValueError, match="already registered"):
+            # construction is fine anywhere; double registration is not
+            obs_alerts.register_rule(obs_alerts.AlertRule(
+                name="watchdog_stall", kind="increase", series="x",
+                description="collides"))  # sdtpu-lint: alert
+
+
+class TestAlertSideEffects:
+    def test_firing_journals_and_exports_metrics(self, alerts_on,
+                                                 monkeypatch):
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        obs_journal.JOURNAL.clear()
+        fired0 = obs_prom.ALERT_COUNTER.value(rule="watchdog_stall",
+                                              state="firing")
+        resolved0 = obs_prom.ALERT_COUNTER.value(rule="watchdog_stall",
+                                                 state="resolved")
+        store, clock, eng = _engine_with_store()
+        store.record("watchdog_stalls_total", 0.0, t=0.0)
+        store.record("watchdog_stalls_total", 1.0, t=1.0)
+        clock[0] = 1.0
+        eng.evaluate()
+        clock[0] = 4000.0
+        store.record("watchdog_stalls_total", 1.0, t=3999.0)
+        store.record("watchdog_stalls_total", 1.0, t=4000.0)
+        eng.evaluate()
+        names = [e["event"] for e in
+                 obs_journal.JOURNAL.snapshot()["events"]]
+        assert names == ["alert_firing", "alert_resolved"]
+        # closed vocabulary: both names are registered journal events
+        assert {"alert_firing", "alert_resolved"} <= obs_journal.EVENTS
+        assert obs_prom.alert_states().get("watchdog_stall") == 0.0
+        assert obs_prom.ALERT_COUNTER.value(
+            rule="watchdog_stall", state="firing") == fired0 + 1.0
+        assert obs_prom.ALERT_COUNTER.value(
+            rule="watchdog_stall", state="resolved") == resolved0 + 1.0
+        obs_journal.JOURNAL.clear()
+
+    def test_firing_lands_a_flightrec_entry(self, alerts_on):
+        flightrec.RECORDER.clear()
+        store, clock, eng = _engine_with_store()
+        store.record("watchdog_stalls_total", 0.0, t=0.0)
+        store.record("watchdog_stalls_total", 1.0, t=1.0)
+        clock[0] = 1.0
+        eng.evaluate()
+        entries = [e for e in flightrec.RECORDER.dump()["entries"]
+                   if e["reason"] == "alert_firing"]
+        assert len(entries) == 1
+        assert entries[0]["request_id"] == "alert-watchdog_stall"
+        # enrichment: the entry carries the alert state + TSDB window
+        assert entries[0]["alerts"] is not None
+        assert entries[0]["tsdb"] is not None
+        flightrec.RECORDER.clear()
+
+    def test_flightrec_enrichment_is_none_with_gates_off(self,
+                                                         monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        monkeypatch.delenv("SDTPU_ALERTS", raising=False)
+        flightrec.RECORDER.clear()
+        entry = flightrec.RECORDER.record("rid-x", "failure", "boom",
+                                          events=[])
+        assert entry["alerts"] is None
+        assert entry["tsdb"] is None
+        flightrec.RECORDER.clear()
+
+
+class TestAutoscaleAlertSignal:
+    def test_firing_alert_triggers_scale_up_with_audit(self):
+        from stable_diffusion_webui_distributed_tpu.fleet import slices
+
+        reg = slices.SliceRegistry()
+        reg.register(slices.SliceInfo(name="s0", group="g", replicas=1,
+                                      min_replicas=1, max_replicas=4))
+        eng = slices.AutoscaleEngine(
+            reg, quantile_source=lambda: 0.0,  # p95 alone says "down"
+            up_p95_s=5.0, down_p95_s=0.5, cooldown_s=0.0,
+            alert_source=lambda: ["queue_wait_anomaly"])
+        try:
+            (d,) = eng.decide()
+            assert d.direction == "up"
+            assert "alert queue_wait_anomaly firing" in d.reason
+            assert reg.summary()["s0"]["replicas"] == 2
+            audit = eng.audit()
+            assert audit["firing_alerts"] == ["queue_wait_anomaly"]
+            assert audit["decisions"][-1]["reason"] == d.reason
+        finally:
+            slices.set_autoscale(None)
+
+    def test_default_alert_source_is_gated(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.fleet import slices
+
+        monkeypatch.delenv("SDTPU_ALERTS", raising=False)
+        assert slices._default_alert_source() == []
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+class TestHttpSurfaces:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            ConfigModel,
+        )
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker \
+            import StubBackend, WorkerNode
+        from stable_diffusion_webui_distributed_tpu.scheduler.world \
+            import World
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            ApiServer,
+        )
+
+        w = World(ConfigModel())
+        w.add_worker(WorkerNode("m", StubBackend(), master=True,
+                                avg_ipm=10.0))
+        srv = ApiServer(w, state=GenerationState(),
+                        host="127.0.0.1", port=0).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, server, route):
+        url = f"http://127.0.0.1:{server.port}{route}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read())
+
+    def test_tsdb_endpoint_schema(self, server, tsdb_on):
+        obs_tsdb.tick()
+        doc = self._get(server, "/internal/tsdb")
+        assert set(doc) == {"enabled", "interval_s", "points", "daemon",
+                            "series_count", "samples_total",
+                            "dropped_series", "series"}
+        assert doc["enabled"] is True
+        for series in doc["series"].values():
+            assert set(series) == {"count", "latest", "samples"}
+
+    def test_alerts_endpoint_schema(self, server, alerts_on):
+        doc = self._get(server, "/internal/alerts")
+        assert set(doc) == {"enabled", "timescale", "registered",
+                            "rules", "firing", "history"}
+        assert set(doc["rules"]) == set(doc["registered"])
+
+    def test_endpoints_report_disabled_when_gated_off(self, server,
+                                                      monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        monkeypatch.delenv("SDTPU_ALERTS", raising=False)
+        assert self._get(server, "/internal/tsdb")["enabled"] is False
+        assert self._get(server, "/internal/alerts")["enabled"] is False
+
+
+# -- device-memory telemetry -------------------------------------------------
+
+class TestDeviceMemory:
+    def test_cpu_reports_none_never_fabricates(self, tsdb_on):
+        # CPU memory_stats() is empty/absent: the sampler must report
+        # None and record no hbm_* series (pinned on the CPU test rig)
+        stats = obs_tsdb.device_memory_stats()
+        if stats is None:
+            assert obs_tsdb.dispatch_memory_sample() is None
+            assert not any(n.startswith("hbm_")
+                           for n in obs_tsdb.STORE.names())
+        else:  # accelerator rig: the stats must be real ints
+            assert all(isinstance(v, int) for v in stats.values())
+
+    def test_dispatch_memory_sample_gated_off(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        obs_tsdb.reset()
+        obs_tsdb.dispatch_memory_sample()
+        assert obs_tsdb.STORE.names() == []
+
+
+# -- the gate-off serving path is byte-identical -----------------------------
+
+class TestDefaultPathPinned:
+    def test_tsdb_off_serving_path_hash_pinned(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        monkeypatch.delenv("SDTPU_ALERTS", raising=False)
+        obs_tsdb.reset()
+        obs_alerts.reset()
+        engine = Engine(TINY, init_params(TINY), chunk_size=4,
+                        state=GenerationState())
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(GenerationPayload(
+            prompt="a golden scenario cow", width=32, height=32,
+            steps=4, seed=4321, sampler_name="Euler a"))
+        _check("serving/tsdb-off-default", r)
+        # and nothing leaked into the store or engine along the way
+        assert obs_tsdb.STORE.names() == []
+        assert obs_alerts.ENGINE.history() == []

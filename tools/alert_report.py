@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Summarize BENCH_alerts.json (bench.py --alerts) as a detector report.
+
+The bench replays labeled phases — steady traffic (every firing is a
+false positive) and injected chaos fault windows (each must raise a
+matching alert) — and this report renders the per-phase verdicts, the
+firing history, and per-rule precision/recall over the phase labels.
+
+    python tools/alert_report.py                    # ./BENCH_alerts.json
+    python tools/alert_report.py path/to/BENCH_alerts.json
+    python tools/alert_report.py --json             # machine-readable
+
+Exit codes: 0 clean (zero false positives, full recall); 1 any false
+positive or a missed fault window; 2 artifact missing/unparseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import benchjson
+
+_fmt = benchjson.fmt
+
+
+def rule_scores(phases):
+    """Per-rule precision/recall over the labeled phases. A firing in a
+    no-expectation (steady) phase is a false positive; a firing in a
+    fault phase is a true positive when the rule was expected there and
+    ignored otherwise (chaos windows legitimately trip sibling
+    detectors); a fault phase expecting a rule that stayed silent is a
+    miss unless a sibling expected rule covered the window."""
+    rules = sorted({r for ph in phases
+                    for r in list(ph.get("expected") or [])
+                    + list(ph.get("fired") or [])})
+    out = {}
+    for rule in rules:
+        tp = fp = relevant = 0
+        for ph in phases:
+            expected = set(ph.get("expected") or [])
+            fired = set(ph.get("fired") or [])
+            if not expected:
+                fp += 1 if rule in fired else 0
+            elif rule in expected:
+                relevant += 1
+                tp += 1 if rule in fired else 0
+        out[rule] = {
+            "true_positives": tp,
+            "false_positives": fp,
+            "fault_windows": relevant,
+            "precision": (tp / (tp + fp)) if (tp + fp) else None,
+            "recall": (tp / relevant) if relevant else None,
+        }
+    return out
+
+
+def build_summary(doc):
+    """Digest the BENCH_alerts.json document into the report rows."""
+    validation = doc.get("validation", {}) or {}
+    phases = validation.get("phases", []) or []
+    history = doc.get("history", []) or []
+    firings = [e for e in history if e.get("to") == "firing"]
+    return {
+        "device": doc.get("device"),
+        "phases": phases,
+        "rules": rule_scores(phases),
+        "firings": firings,
+        "alert_false_positives": validation.get("alert_false_positives"),
+        "false_positive_rules": validation.get("false_positive_rules", []),
+        "faults": validation.get("faults"),
+        "detected": validation.get("detected"),
+        "alert_recall": validation.get("alert_recall"),
+    }
+
+
+def render(summary):
+    lines = [f"alert validation report — {len(summary['phases'])} phases "
+             f"on {summary['device']}",
+             "",
+             f"{'phase':<14} {'expected':<36} {'fired':<36} verdict"]
+    for ph in summary["phases"]:
+        expected = ",".join(ph.get("expected") or []) or "-"
+        fired = ",".join(ph.get("fired") or []) or "-"
+        if not ph.get("expected"):
+            verdict = ("CLEAN" if not ph.get("false_positives")
+                       else f"{ph['false_positives']} FALSE POSITIVE(S)")
+        else:
+            verdict = "DETECTED" if ph.get("detected") else "MISSED"
+        lines.append(f"{ph.get('name', ''):<14} {expected:<36} "
+                     f"{fired:<36} {verdict}")
+    lines.append("")
+    lines.append(f"{'rule':<24} {'tp':>3} {'fp':>3} {'windows':>8} "
+                 f"{'precision':>10} {'recall':>7}")
+    for rule, s in sorted(summary["rules"].items()):
+        lines.append(f"{rule:<24} {s['true_positives']:>3} "
+                     f"{s['false_positives']:>3} {s['fault_windows']:>8} "
+                     f"{_fmt(s['precision']):>10} {_fmt(s['recall']):>7}")
+    lines.append("")
+    lines.append(f"false positives: {summary['alert_false_positives']}   "
+                 f"fault windows detected: {summary['detected']}"
+                 f"/{summary['faults']}   "
+                 f"recall: {_fmt(summary['alert_recall'])}")
+    if summary["firings"]:
+        lines.append(f"firing history ({len(summary['firings'])}):")
+        for e in summary["firings"][:16]:
+            lines.append(f"  {e.get('rule', '')}: {e.get('detail', '')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default="BENCH_alerts.json",
+                    help="bench.py --alerts artifact "
+                         "(default ./BENCH_alerts.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digested summary as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        doc = benchjson.load_bench(args.path, "alert_report",
+                                   hint="python bench.py --alerts")
+    except benchjson.BenchJsonError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    summary = build_summary(doc)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    fps = summary["alert_false_positives"]
+    recall = summary["alert_recall"]
+    if fps is None or not summary["phases"]:
+        print("alert_report: artifact has no phase validation — the "
+              "bench died mid-run", file=sys.stderr)
+        return 2
+    if fps > 0 or (recall is not None and recall < 1.0):
+        print(f"alert_report: FAIL — {fps} false positive(s), recall "
+              f"{_fmt(recall)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,12 @@ THRESHOLDS = {
     # correctness bug at any count
     "scenario_p95_s": ("up", "rel", 0.50),
     "double_merged_images": ("up", "abs", 0.0),
+    # alert rows (bench.py run_alerts): the labeled phase protocol is
+    # deterministic, so a single false-positive firing on steady traffic
+    # or any recall lost on the injected fault windows is a detector
+    # regression at any size
+    "alert_false_positives": ("up", "abs", 0.0),
+    "alert_recall": ("down", "abs", 0.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back

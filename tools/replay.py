@@ -32,6 +32,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import urllib.request
 from typing import Any, Dict, List, Optional
@@ -67,7 +68,11 @@ def load_snapshot(source: str) -> Dict[str, Any]:
     """A journal snapshot from a saved JSON file, a JSONL sink file
     (``SDTPU_JOURNAL_SINK`` spill — one event per line, possibly out of
     seq order), or a live ``/internal/journal`` URL. Always returns the
-    snapshot-dict shape with events sorted by seq."""
+    snapshot-dict shape with events sorted by seq.
+
+    A size-capped sink (``SDTPU_JOURNAL_SINK_MAX_MB``) rotates once to
+    ``<sink>.1``; when the rotated file sits beside a JSONL source it is
+    loaded first, so the pair reads as one contiguous event stream."""
     if source.startswith(("http://", "https://")):
         with urllib.request.urlopen(source, timeout=10) as resp:
             return json.loads(resp.read().decode("utf-8"))
@@ -77,8 +82,14 @@ def load_snapshot(source: str) -> Dict[str, Any]:
         doc = json.loads(text)
     except ValueError:
         doc = None
-    if isinstance(doc, dict):
+    # a one-line JSONL sink also parses as a dict; only a snapshot
+    # document carries the events list
+    if isinstance(doc, dict) and "events" in doc:
         return doc
+    rotated = source + ".1"
+    if os.path.exists(rotated):
+        with open(rotated, "r", encoding="utf-8") as fh:
+            text = fh.read() + "\n" + text
     events = [json.loads(line) for line in text.splitlines()
               if line.strip()]
     events.sort(key=lambda e: e.get("seq", 0))
