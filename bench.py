@@ -1992,6 +1992,214 @@ def run_alerts(tiny):
     return out
 
 
+def run_federation(tiny):
+    """--federation: fleet-federation + paging validation. Two stub
+    workers are fronted by in-process API servers; the federation prober
+    scrapes both over real HTTP on explicit ticks (steady phase: zero
+    stale verdicts, zero fleet-scope firings = zero false positives),
+    then one worker is chaos-killed and its API server shut down
+    mid-run — the staleness gauge must cross the freshness deadline,
+    trip the fleet-scope alerts (worker_metrics_stale +
+    fleet_error_rate), and land the transitions on a local webhook
+    capture server. Writes BENCH_federation.json + a ``federation``
+    ledger row; tools/bench_compare.py zero-movement-gates
+    notify_delivery_rate and federation_staleness_fp. CPU-safe."""
+    import http.server
+
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        alerts as obs_alerts, federation as obs_federation,
+        journal as obs_journal, notify as obs_notify,
+        prometheus as obs_prom, tsdb as obs_tsdb,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel, env_int,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+        GenerationState,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend, StubBehavior, WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+    from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        chaos as sim_chaos,
+    )
+
+    seed = env_int("SDTPU_SIM_SEED", 0)
+
+    # local webhook capture server: every delivered page lands here
+    received = []
+
+    class _Hook(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                received.append(json.loads(self.rfile.read(n)))
+            except ValueError:
+                received.append({"malformed": True})
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *args):  # keep bench stderr clean
+            pass
+
+    hook = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    threading.Thread(target=hook.serve_forever, daemon=True).start()
+    hook_url = f"http://127.0.0.1:{hook.server_address[1]}/hook"
+
+    try:
+        with _EnvPatch(SDTPU_SIM="1", SDTPU_JOURNAL="1",
+                       SDTPU_TSDB="1", SDTPU_ALERTS="1",
+                       SDTPU_FEDERATION="1",
+                       SDTPU_TSDB_INTERVAL_S="0.05",
+                       SDTPU_ALERT_TIMESCALE="0.01",
+                       SDTPU_OBS_HTTP_TIMEOUT_S="2.0",
+                       SDTPU_NOTIFY_URL=hook_url):
+            obs_prom.clear_histograms()
+            obs_tsdb.reset()
+            obs_alerts.reset()
+            obs_federation.reset()
+            obs_notify.reset()
+            obs_journal.JOURNAL.clear()
+
+            w = World(ConfigModel())  # registers itself as prober source
+            w.add_worker(WorkerNode(
+                "alpha",
+                StubBackend(StubBehavior(seconds_per_image=0.001)),
+                avg_ipm=2400.0))
+            w.add_worker(WorkerNode(
+                "victim",
+                StubBackend(StubBehavior(seconds_per_image=0.001)),
+                avg_ipm=2400.0))
+            servers = {}
+            for node in w.workers:
+                srv = ApiServer(w, state=GenerationState(),
+                                host="127.0.0.1", port=0).start()
+                node.backend.address = "127.0.0.1"
+                node.backend.port = srv.port
+                servers[node.label] = srv
+
+            def cycle(n, sleep_s=0.05):
+                # explicit cadence, like run_alerts: the federation poll
+                # and the TSDB sample share one deterministic clock
+                for _ in range(n):
+                    obs_federation.tick()
+                    obs_tsdb.tick()
+                    time.sleep(sleep_s)
+
+            # phase 1 — steady: both workers polled over real HTTP; any
+            # stale verdict or fleet-scope firing is a false positive.
+            mark = len(obs_alerts.ENGINE.history())
+            cycle(6)
+            steady_summary = obs_federation.summary()
+            history = obs_alerts.ENGINE.history()
+            fired_steady = _alert_firings(history, mark)
+            steady_stale = sorted(
+                label for label, st in steady_summary["workers"].items()
+                if st["stale"])
+
+            # phase 2 — kill: the chaos fault lands in the victim's
+            # generate path (journaled, requeued onto alpha) and its API
+            # server goes down, so federation polls fail and the
+            # staleness gauge crosses the freshness deadline.
+            mark = len(history)
+            plan = sim_chaos.ChaosPlan(
+                [sim_chaos.Fault(kind="kill", worker="victim",
+                                 at_request=1)],
+                seed=seed)
+            sim_chaos.arm(plan)
+            try:
+                p = GenerationPayload(prompt="federation kill", steps=8,
+                                      width=512, height=512, batch_size=4,
+                                      seed=99, request_id="fed-kill-000")
+                result = w.execute(p)
+            finally:
+                sim_chaos.disarm()
+            servers["victim"].stop()
+            time.sleep(max(0.3, obs_federation.stale_after_s()))
+            cycle(6)
+            history = obs_alerts.ENGINE.history()
+            fired_kill = _alert_firings(history, mark)
+            kill_summary = obs_federation.summary()
+
+            flushed = obs_notify.flush(10.0)
+            notify_counts = obs_notify.NOTIFIER.counts()
+            fed_journal = [
+                e for e in obs_journal.JOURNAL.snapshot()["events"]
+                if e.get("event") in ("notify_sent", "notify_failed",
+                                      "federation_poll_failed")]
+            servers["alpha"].stop()
+            obs_journal.JOURNAL.clear()
+            obs_notify.reset()
+            obs_federation.reset()
+            obs_tsdb.reset()
+            obs_alerts.reset()
+    finally:
+        hook.shutdown()
+        hook.server_close()
+
+    sent = notify_counts.get("sent", 0)
+    failed = notify_counts.get("failed", 0)
+    delivery_rate = sent / (sent + failed) if (sent + failed) else None
+    staleness_recall = 1.0 if "worker_metrics_stale" in fired_kill else 0.0
+    staleness_fp = len(steady_stale) + sum(
+        1 for r in fired_steady
+        if r in ("worker_metrics_stale", "fleet_error_rate"))
+    if not flushed:
+        raise RuntimeError("notify queue did not drain within 10s")
+    if staleness_recall < 1.0:
+        raise RuntimeError(
+            f"killed worker raised no worker_metrics_stale alert "
+            f"(kill-phase firings: {fired_kill})")
+    if sent == 0 or not received:
+        raise RuntimeError(
+            f"no webhook reached the capture server "
+            f"(counts: {notify_counts})")
+
+    out = {
+        "seed": seed,
+        "steady": {"fired": fired_steady, "stale_workers": steady_stale,
+                   "summary": steady_summary},
+        "kill": {"fired": fired_kill, "summary": kill_summary,
+                 "chaos_plan": plan.status(),
+                 "recovered_images": len(result.images)},
+        "webhooks_received": received,
+        "notify_counts": notify_counts,
+        "federation_journal_events": fed_journal,
+        "notify_delivery_rate": delivery_rate,
+        "federation_staleness_recall": staleness_recall,
+        "federation_staleness_fp": staleness_fp,
+        "tiny": bool(tiny),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_federation.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"bench: federation validation written to {path} "
+          f"(inspect with tools/fed_report.py)", file=sys.stderr)
+
+    recorded_at = time.time()
+    row = _ledger_row("federation", {
+        "notify_delivery_rate": delivery_rate,
+        "federation_staleness_fp": staleness_fp,
+        "federation_staleness_recall": staleness_recall,
+        "webhooks_delivered": sent,
+    }, "stub", tiny, recorded_at)
+    lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LEDGER.jsonl")
+    with open(lpath, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"bench: federation ledger row appended to {lpath}",
+          file=sys.stderr)
+    return out
+
+
 def _ledger_row(kind, metrics, device, tiny, recorded_at):
     """One append-only BENCH_LEDGER.jsonl row. ``schema`` versions the row
     shape; ``metrics`` holds only platform-independent structural numbers
@@ -2129,6 +2337,14 @@ def main() -> None:
                          "kill/stall scenarios (every fault window must "
                          "raise a matching alert); writes "
                          "BENCH_alerts.json + a ledger row (CPU-safe)")
+    ap.add_argument("--federation", action="store_true",
+                    help="fleet-federation + paging validation: two "
+                         "API-fronted stub workers polled over real "
+                         "HTTP, one chaos-killed mid-run — staleness "
+                         "alert recall, steady false positives and "
+                         "webhook delivery to a local capture server; "
+                         "writes BENCH_federation.json + a ledger row "
+                         "(CPU-safe)")
     ap.add_argument("--ledger", action="store_true",
                     help="run the serving, fleet and watchdog microbenches "
                          "with the perf ledger on and append structural "
@@ -2179,6 +2395,8 @@ def main() -> None:
             print(json.dumps(run_scenarios(tiny)))
         elif args.alerts:
             print(json.dumps(run_alerts(tiny)))
+        elif args.federation:
+            print(json.dumps(run_federation(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
         elif args.ragged:

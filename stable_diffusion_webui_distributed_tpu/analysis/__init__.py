@@ -22,8 +22,8 @@ from . import cache as cache_mod
 from . import callgraph as callgraph_mod
 from . import summaries as summaries_mod
 from . import (alertrules, cacherules, donation, envrules, escape,
-               fleetrules, journalrules, locks, metricrules, purity,
-               recompile, timerules)
+               fleetrules, journalrules, locks, metricrules, netrules,
+               purity, recompile, timerules)
 from .core import RULES, Finding, ModuleInfo, walk_package
 
 __all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis",
@@ -65,6 +65,7 @@ def analyze_modules(modules: List[ModuleInfo],
     findings.extend(metricrules.check(modules))
     findings.extend(journalrules.check(modules))
     findings.extend(alertrules.check(modules))
+    findings.extend(netrules.check(modules))
     findings.extend(locks.check(modules, prog=prog))
     findings.extend(donation.check(modules, prog=prog))
     findings.extend(escape.check(modules, prog=prog))

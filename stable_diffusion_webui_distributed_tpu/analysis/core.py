@@ -41,6 +41,8 @@ RULES = {
     "OB002": "ad-hoc Prometheus metric name outside the central registry",
     "OB003": "journal event literal outside the registered event set",
     "OB004": "alert-rule registration outside the obs/alerts.py registry",
+    "OB005": "outbound network call in obs/ outside "
+             "federation/notify/stitch",
     "LK001": "guarded attribute accessed without holding its lock",
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",

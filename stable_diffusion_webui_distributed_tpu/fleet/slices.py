@@ -294,12 +294,24 @@ def get_autoscale() -> Optional[AutoscaleEngine]:
 
 def _default_quantile_source() -> float:
     """Worst per-class p95 of the fleet queue-wait histograms — the
-    autoscaler keys on the most-starved class, not the average."""
+    autoscaler keys on the most-starved class, not the average. With
+    SDTPU_FEDERATION on, the federated worst-of-fleet p95 folds in, so
+    the scale signal is fleet-wide rather than node-local."""
     from stable_diffusion_webui_distributed_tpu.obs import (
         prometheus as obs_prom,
     )
 
-    return obs_prom.fleet_queue_wait_p95()
+    local = obs_prom.fleet_queue_wait_p95()
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            federation as obs_fed,
+        )
+
+        if obs_fed.enabled():
+            return max(local, obs_fed.fleet_queue_wait_p95())
+    except Exception:  # noqa: BLE001 — the scale signal stays node-local
+        pass
+    return local
 
 
 def _default_alert_source() -> List[str]:

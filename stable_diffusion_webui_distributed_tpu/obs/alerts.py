@@ -170,6 +170,20 @@ register_rule(AlertRule(
     series="watchdog_stalls_total",
     description="Hang-watchdog stall detections inside the fast window.",
     windows_s=(300.0, 3600.0), threshold=1.0, for_count=1))
+register_rule(AlertRule(
+    name="worker_metrics_stale", kind="increase",
+    series="fleet/worker_stale_count",
+    description="Fleet-scope: a federated worker's metrics went stale "
+                "(no successful poll inside the freshness deadline) — "
+                "the worker is dead or partitioned. Dormant without "
+                "SDTPU_FEDERATION (series never recorded).",
+    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1))
+register_rule(AlertRule(
+    name="fleet_error_rate", kind="anomaly", series="fleet/error_rate",
+    description="Fleet-scope: federated mean worker error rate jumping "
+                "off its EWMA baseline (an unreachable worker counts as "
+                "1.0). Dormant without SDTPU_FEDERATION.",
+    for_count=1, z=6.0, warmup=4, min_value=0.1))
 
 
 class AlertEngine:
@@ -362,6 +376,14 @@ class AlertEngine:
             obs_prom.alert_count(rule.name,
                                  "firing" if firing else "resolved")
             obs_prom.set_alert_state(rule.name, 1.0 if firing else 0.0)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                notify as obs_notify,
+            )
+
+            obs_notify.notify_transition(rule.name, event, value, detail)
         except Exception:  # noqa: BLE001
             pass
         if firing:

@@ -81,6 +81,10 @@ EVENTS = frozenset({
     # alerting plane (obs/alerts.py state machine)
     "alert_firing",
     "alert_resolved",
+    # delivery / federation plane (obs/notify.py, obs/federation.py)
+    "notify_sent",
+    "notify_failed",
+    "federation_poll_failed",
 })
 
 DEFAULT_CAPACITY = 4096

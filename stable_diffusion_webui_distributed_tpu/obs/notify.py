@@ -1,0 +1,278 @@
+"""Alert notification delivery (SDTPU_NOTIFY_URL): webhook paging.
+
+The alert engine (obs/alerts.py) journals ``alert_firing`` /
+``alert_resolved`` transitions and exports them as metrics, but nothing
+leaves the process — an operator learns about a 3am burn-rate page by
+polling ``/internal/alerts``. This module is the delivery channel: every
+firing/resolved transition is enqueued onto a bounded in-memory queue
+and drained by a daemon thread that POSTs one JSON document per
+transition to the configured webhook URL.
+
+Delivery discipline:
+
+- **off-thread, never under a lock** — the queue hand-off is the only
+  locked region; the HTTP POST, its retries, and the backoff sleeps all
+  run on the drain thread with no lock held (LK004).
+- **retry + exponential backoff** — ``_MAX_ATTEMPTS`` tries per
+  transition, sleeping ``_BACKOFF_BASE_S * 2**attempt`` between them;
+  a transition that exhausts its attempts is counted and journaled as
+  failed, never re-queued (the queue must drain even with the webhook
+  down).
+- **dedup** — an identical (rule, event) transition enqueued within
+  ``SDTPU_NOTIFY_DEDUP_S`` seconds of the previous one is dropped
+  (outcome ``deduped``), so a flapping rule cannot page-storm.
+- **bounded** — past ``_MAX_QUEUE`` undelivered transitions the newest
+  is dropped (outcome ``dropped``); paging lag must not grow memory.
+
+Every outcome bumps ``sdtpu_notify_total{outcome}`` and delivery
+results journal through the closed vocabulary (``notify_sent`` /
+``notify_failed``) when the journal is on. The POST timeout comes from
+the obs-plane-wide ``SDTPU_OBS_HTTP_TIMEOUT_S`` knob (obs/stitch.py).
+
+Gated off by default: an empty ``SDTPU_NOTIFY_URL`` (the default) means
+:func:`notify_transition` returns before touching the queue and no
+thread ever starts — the serving path is byte-identical to the
+unnotified build (hash-pinned in tests/test_federation.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+from ..runtime.config import env_float, env_str
+from . import stitch
+
+#: Undelivered-transition queue depth; the newest transition past it is
+#: dropped (paging lag must not grow memory without bound).
+_MAX_QUEUE = 256
+
+#: Delivery attempts per transition before it counts as failed.
+_MAX_ATTEMPTS = 3
+
+#: Backoff base: sleep ``_BACKOFF_BASE_S * 2**attempt`` between tries.
+_BACKOFF_BASE_S = 0.05
+
+DEFAULT_DEDUP_S = 60.0
+
+
+def enabled() -> bool:
+    """Notify gate — a non-empty webhook URL arms delivery."""
+    return bool(url())
+
+
+def url() -> str:
+    """Webhook endpoint (SDTPU_NOTIFY_URL); '' = delivery off."""
+    return env_str("SDTPU_NOTIFY_URL", "")
+
+
+def dedup_s() -> float:
+    """Dedup window: identical (rule, event) transitions inside it are
+    dropped instead of delivered twice (SDTPU_NOTIFY_DEDUP_S)."""
+    return max(0.0, env_float("SDTPU_NOTIFY_DEDUP_S", DEFAULT_DEDUP_S))
+
+
+class Notifier:
+    """Bounded queue + daemon drain thread for webhook delivery."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._queue: Deque[Dict[str, Any]] = deque()   # guarded-by: _lock
+        # (rule, event) -> enqueue time of the last accepted transition
+        self._last_sent: Dict[Any, float] = {}         # guarded-by: _lock
+        self._counts: Dict[str, int] = {}              # guarded-by: _lock
+        self._pending = 0                              # guarded-by: _lock
+        self._wake = threading.Event()
+        self._thread_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        # NOT named _stop: Thread.join() calls a private self._stop()
+        self._halt = threading.Event()
+
+    # -- enqueue (alert-engine side; cheap, lock only for the hand-off) ----
+
+    def notify_transition(self, rule: str, event: str, value: Any,
+                          detail: str) -> bool:
+        """Queue one firing/resolved transition for delivery; returns
+        True when it was accepted (not deduped/dropped/gated off)."""
+        if not enabled():
+            return False
+        now = self._clock()
+        item = {"rule": str(rule), "event": str(event), "value": value,
+                "detail": str(detail)}
+        key = (item["rule"], item["event"])
+        rejected = None
+        with self._lock:
+            last = self._last_sent.get(key)
+            if last is not None and now - last < dedup_s():
+                rejected = "deduped"
+            elif len(self._queue) >= _MAX_QUEUE:
+                rejected = "dropped"
+            else:
+                self._last_sent[key] = now
+                self._queue.append(item)
+                self._pending += 1
+            if rejected is not None:
+                self._counts[rejected] = self._counts.get(rejected, 0) + 1
+        if rejected is not None:
+            _count_outcome(rejected)
+            return False
+        self._wake.set()
+        self._ensure_thread()
+        return True
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._halt.clear()
+            self._thread = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name="sdtpu-notify-drain")
+            self._thread.start()
+
+    # -- drain thread (all blocking work lives here, no locks held) --------
+
+    def _drain_loop(self) -> None:
+        while not self._halt.is_set():
+            item = None
+            with self._lock:
+                if self._queue:
+                    item = self._queue.popleft()
+            if item is None:
+                self._wake.clear()
+                self._wake.wait(0.2)
+                continue
+            delivered, attempts = self._deliver(item)
+            outcome = "sent" if delivered else "failed"
+            with self._lock:
+                self._pending -= 1
+                self._counts[outcome] = self._counts.get(outcome, 0) + 1
+            _count_outcome(outcome)
+            _journal_outcome(item, delivered, attempts)
+
+    def _deliver(self, item: Dict[str, Any]) -> "tuple[bool, int]":
+        """POST one transition with retry + exponential backoff; returns
+        (delivered, attempts). Runs on the drain thread only — never
+        call with any lock held (LK004)."""
+        target = url()
+        if not target:
+            return False, 0
+        body = dict(item)
+        body["ts"] = time.time()  # sdtpu-lint: wallclock — pager-facing timestamp
+        data = json.dumps(body, sort_keys=True, default=str).encode("utf-8")
+        timeout = stitch.http_timeout_s()
+        for attempt in range(_MAX_ATTEMPTS):
+            if attempt:
+                time.sleep(_BACKOFF_BASE_S * (2 ** (attempt - 1)))
+            try:
+                req = urllib.request.Request(
+                    target, data=data,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    if 200 <= resp.status < 300:
+                        return True, attempt + 1
+            except Exception:  # noqa: BLE001 — delivery is best-effort
+                pass
+        return False, _MAX_ATTEMPTS
+
+    # -- synchronization + views -------------------------------------------
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until every queued transition has a delivery outcome
+        (tests/bench determinism); False on timeout."""
+        deadline = self._clock() + max(0.0, timeout_s)
+        while True:
+            with self._lock:
+                pending = self._pending
+            if pending <= 0:
+                return True
+            if self._clock() >= deadline:
+                return False
+            self._wake.set()
+            time.sleep(0.005)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._wake.set()
+        with self._thread_lock:
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            queued = len(self._queue)
+            pending = self._pending
+            counts = dict(self._counts)
+        with self._thread_lock:
+            alive = self._thread is not None and self._thread.is_alive()
+        return {"enabled": enabled(), "dedup_s": dedup_s(),
+                "queued": queued, "pending": pending,
+                "outcomes": counts, "draining": alive}
+
+
+def _count_outcome(outcome: str) -> None:
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        obs_prom.notify_count(outcome)
+    except Exception:  # noqa: BLE001 — telemetry stays passive
+        pass
+
+
+def _journal_outcome(item: Dict[str, Any], delivered: bool,
+                     attempts: int) -> None:
+    """Journal one delivery outcome (URL deliberately omitted: webhook
+    URLs routinely embed tokens and the journal is replayable)."""
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+        )
+
+        if obs_journal.enabled():
+            obs_journal.emit(
+                "notify_sent" if delivered else "notify_failed",
+                f"notify-{item.get('rule', '')}",
+                rule=item.get("rule"), alert_event=item.get("event"),
+                attempts=attempts)
+    except Exception:  # noqa: BLE001 — telemetry stays passive
+        pass
+
+
+#: Process-wide notifier (the alert engine feeds it). Tests construct
+#: their own or call :func:`reset` after flipping the env knobs.
+NOTIFIER = Notifier()
+
+
+def notify_transition(rule: str, event: str, value: Any,
+                      detail: str) -> bool:
+    """Module-level convenience for :meth:`Notifier.notify_transition`;
+    no-op (False) with SDTPU_NOTIFY_URL unset."""
+    return NOTIFIER.notify_transition(rule, event, value, detail)
+
+
+def flush(timeout_s: float = 5.0) -> bool:
+    return NOTIFIER.flush(timeout_s)
+
+
+def reset() -> None:
+    """Stop the drain thread and rebuild the notifier (tests/bench)."""
+    global NOTIFIER
+    NOTIFIER.stop()
+    NOTIFIER = Notifier()
+
+
+def summary() -> Dict[str, Any]:
+    return NOTIFIER.summary()

@@ -241,6 +241,7 @@ def clear_histograms() -> None:
     CACHE_COUNTER.clear()
     SIM_FAULT_COUNTER.clear()
     ALERT_COUNTER.clear()
+    NOTIFY_COUNTER.clear()
     with _ALERT_LOCK:
         _ALERT_STATE.clear()
     set_sim_slo_burn(None)
@@ -416,6 +417,18 @@ def set_alert_state(rule: str, value: float) -> None:
 def alert_states() -> Dict[str, float]:
     with _ALERT_LOCK:
         return dict(_ALERT_STATE)
+
+
+#: Webhook delivery outcomes (sent / failed / deduped / dropped) from
+#: obs/notify.py. Zero families with SDTPU_NOTIFY_URL unset.
+NOTIFY_COUNTER = LabeledCounter(
+    "sdtpu_notify_total",
+    "Alert notification delivery outcomes (SDTPU_NOTIFY_URL) by outcome.",
+    ("outcome",))
+
+
+def notify_count(outcome: str, n: float = 1.0) -> None:
+    NOTIFY_COUNTER.inc(n, outcome=outcome)
 
 
 # -- scenario engine (sim/: chaos injection + SLO scoring) -------------------
@@ -748,6 +761,7 @@ def render() -> str:
     lines.extend(CACHE_COUNTER.render())
     lines.extend(SIM_FAULT_COUNTER.render())
     lines.extend(ALERT_COUNTER.render())
+    lines.extend(NOTIFY_COUNTER.render())
     _labeled_family(
         lines, "sdtpu_alert_state", "gauge",
         "Current alert state by rule (1 = firing, 0 = resolved/ok); "

@@ -24,11 +24,23 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..runtime.config import env_float
 from . import spans
 
 #: Per-remote fetch timeout (seconds); a dead worker must not hang the
 #: stitched export.
 FETCH_TIMEOUT_S = 5.0
+
+
+def http_timeout_s(default: float = FETCH_TIMEOUT_S) -> float:
+    """The obs-plane-wide outbound HTTP timeout (SDTPU_OBS_HTTP_TIMEOUT_S).
+
+    Every outbound call the observability plane makes — trace stitching,
+    federation polls, webhook delivery, the heartbeat prober — resolves
+    its timeout here, so one knob bounds how long a hung remote can stall
+    any of them. Floored at 0.05s so a typo cannot disable the bound."""
+    t = env_float("SDTPU_OBS_HTTP_TIMEOUT_S", default)
+    return max(0.05, float(t if t is not None else default))
 
 
 def _workers_of(source: Any) -> List[Any]:
@@ -40,10 +52,12 @@ def _workers_of(source: Any) -> List[Any]:
 
 
 def fetch_remote_trace(backend: Any,
-                       timeout: float = FETCH_TIMEOUT_S,
+                       timeout: Optional[float] = None,
                        ) -> Tuple[Dict[str, Any], float, float]:
     """GET a remote's /internal/trace.json through its session; returns
     (document, t0_us, t1_us) with the local trace-clock fetch bracket."""
+    if timeout is None:
+        timeout = http_timeout_s()
     scheme = "https" if getattr(backend, "tls", False) else "http"
     url = (f"{scheme}://{backend.address}:{backend.port}"
            f"/internal/trace.json")

@@ -35,12 +35,14 @@ tests/test_tsdb.py).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from ..runtime.config import env_flag, env_float, env_int
+from ..runtime.config import env_flag, env_float, env_int, env_str
 
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_POINTS = 512
@@ -68,6 +70,24 @@ def enabled() -> bool:
 def interval_s() -> float:
     """Daemon sampling cadence (seconds)."""
     return max(0.01, env_float("SDTPU_TSDB_INTERVAL_S", DEFAULT_INTERVAL_S))
+
+
+# -- durability (SDTPU_TSDB_DIR) ---------------------------------------------
+
+SNAPSHOT_BASENAME = "tsdb_snapshot.json"
+
+#: The daemon snapshots the store every this-many sampling ticks (plus a
+#: final one at shutdown), bounding data loss to a handful of intervals.
+_SAVE_EVERY_TICKS = 10
+
+
+def snapshot_dir() -> str:
+    """Snapshot directory (SDTPU_TSDB_DIR); '' = durability off."""
+    return env_str("SDTPU_TSDB_DIR", "")
+
+
+def snapshot_path(base: Optional[str] = None) -> str:
+    return os.path.join(base or snapshot_dir(), SNAPSHOT_BASENAME)
 
 
 # -- derived-series math -----------------------------------------------------
@@ -337,6 +357,64 @@ class SeriesStore:
             }
         return out
 
+    def dump(self) -> Dict[str, Any]:
+        """Durable snapshot document (every ring, full depth). Timestamps
+        are ``time.monotonic()`` — CLOCK_MONOTONIC, boot-relative on
+        Linux, so they stay comparable across process restarts within one
+        boot; :meth:`load_merge` drops anything from a future clock."""
+        with self._lock:
+            return {
+                "schema": 1,
+                "points": self.points,
+                "saved_t_mono": time.monotonic(),
+                "series": {k: [[t, v] for t, v in ring]
+                           for k, ring in self._series.items()},
+            }
+
+    def load_merge(self, doc: Any) -> int:
+        """Merge a :meth:`dump` document into the live rings; returns how
+        many samples landed. Tolerant of garbage: a non-dict document,
+        malformed series, or non-numeric samples contribute nothing, and
+        samples stamped after *now* (a snapshot from a previous boot,
+        where the monotonic clock restarted) are dropped rather than
+        poisoning windowed queries. Restored samples do not bump
+        ``samples_total`` — that counter means "sampled this process"."""
+        if not isinstance(doc, dict):
+            return 0
+        series = doc.get("series")
+        if not isinstance(series, dict):
+            return 0
+        now = time.monotonic()
+        landed = 0
+        for name, samples in series.items():
+            if not isinstance(samples, (list, tuple)):
+                continue
+            clean: List[Tuple[float, float]] = []
+            for s in samples:
+                try:
+                    t, v = float(s[0]), float(s[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if t > now:
+                    continue
+                clean.append((t, v))
+            if not clean:
+                continue
+            key = str(name)
+            with self._lock:
+                ring = self._series.get(key)
+                if ring is None:
+                    if len(self._series) >= _MAX_SERIES:
+                        self._dropped_series += 1
+                        continue
+                    ring = deque(maxlen=self.points)
+                    self._series[key] = ring
+                merged = sorted(set(list(ring) + clean))
+                ring.clear()
+                ring.extend(merged[-self.points:])
+            landed += len(clean)
+        return landed
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"series": len(self._series),
@@ -353,6 +431,50 @@ class SeriesStore:
 #: Process-wide store. Ring depth is resolved at construction; tests and
 #: bench call :func:`reset` after flipping the env knobs.
 STORE = SeriesStore()
+
+
+def save_snapshot(store: Optional[SeriesStore] = None,
+                  path: Optional[str] = None) -> bool:
+    """Write the store's :meth:`~SeriesStore.dump` to disk crash-safely
+    (tmp + ``os.replace``, the journal-sink rotation pattern — a crash
+    mid-write leaves the previous snapshot intact, never a truncated
+    one). No-op (False) when SDTPU_TSDB_DIR is unset and no explicit
+    path is given; write failures are swallowed (telemetry stays
+    passive)."""
+    if path is None:
+        base = snapshot_dir()
+        if not base:
+            return False
+        path = snapshot_path(base)
+    store = store if store is not None else STORE
+    tmp = f"{path}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(store.dump(), f, sort_keys=True)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+def load_snapshot(store: Optional[SeriesStore] = None,
+                  path: Optional[str] = None) -> int:
+    """Merge an on-disk snapshot into the store; returns how many samples
+    landed (0 for a missing, truncated, or corrupt file — restart must
+    never fail on bad history)."""
+    if path is None:
+        base = snapshot_dir()
+        if not base:
+            return 0
+        path = snapshot_path(base)
+    store = store if store is not None else STORE
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    return store.load_merge(doc)
 
 
 # -- sampling daemon ---------------------------------------------------------
@@ -373,8 +495,12 @@ class _Sampler(threading.Thread):
         self._halt = threading.Event()
 
     def run(self) -> None:
+        ticks = 0
         while not self._halt.is_set():
             tick(store=self.store)
+            ticks += 1
+            if ticks % _SAVE_EVERY_TICKS == 0 and snapshot_dir():
+                save_snapshot(self.store)
             self._halt.wait(self.period_s)
 
     def stop(self) -> None:
@@ -409,6 +535,8 @@ def start_daemon() -> bool:
     with _DAEMON_LOCK:
         if _DAEMON is not None and _DAEMON.is_alive():
             return True
+        if snapshot_dir():
+            load_snapshot(STORE)
         _DAEMON = _Sampler(STORE, interval_s())
         _DAEMON.start()
     return True
@@ -422,14 +550,20 @@ def stop_daemon() -> None:
     if daemon is not None:
         daemon.stop()
         daemon.join(timeout=2.0)
+        if snapshot_dir():
+            save_snapshot(daemon.store)
 
 
 def reset() -> None:
     """Stop the daemon and rebuild the store from the current env knobs
-    (tests/bench flip SDTPU_TSDB_POINTS between phases)."""
+    (tests/bench flip SDTPU_TSDB_POINTS between phases). With
+    SDTPU_TSDB_DIR set, the rebuilt store merges the on-disk snapshot —
+    reset *is* the restart, and history survives it."""
     global STORE
     stop_daemon()
     STORE = SeriesStore()
+    if enabled() and snapshot_dir():
+        load_snapshot(STORE)
 
 
 def dispatch_memory_sample() -> Optional[Dict[str, int]]:

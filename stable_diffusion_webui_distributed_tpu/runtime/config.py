@@ -254,6 +254,40 @@ def _logger():
 #   rule's wall-clock windows so scenario runs compress the 5m/1h/6h
 #   SLO windows into seconds (``0.01`` -> 3s/36s/216s) without touching
 #   thresholds — ``bench.py --alerts`` validates with it.
+# - ``SDTPU_FEDERATION`` (flag, default off): fleet-federated metrics
+#   (obs/federation.py) — the master-side prober scrapes every HTTP
+#   worker's ``/internal/metrics`` + ``/internal/tsdb`` on the TSDB
+#   sampler's cadence, records ``worker:<label>/...`` series plus
+#   ``fleet/...`` aggregates (worst-of-fleet queue-wait p95, mean error
+#   rate, stale-worker count), serves ``GET /internal/fleet``, and arms
+#   the ``worker_metrics_stale`` / ``fleet_error_rate`` alert rules and
+#   the autoscaler's fleet-wide scale signal. Off (the default) no
+#   source registers, ``tick()`` is a no-op, and the serving path is
+#   byte-identical (hash-pinned in tests/test_federation.py).
+# - ``SDTPU_TSDB_DIR`` (path, default unset): TSDB durability — the
+#   sampling daemon snapshots every ring to
+#   ``<dir>/tsdb_snapshot.json`` every 10 ticks and at shutdown
+#   (tmp + ``os.replace``, crash-safe), and a (re)start merges the
+#   on-disk history back in (future-stamped samples from a prior boot
+#   are dropped), so ``quantile_over_time`` windows survive restarts.
+#   Corrupt or truncated snapshots load as nothing, never an error.
+# - ``SDTPU_NOTIFY_URL`` (url, default unset): alert notification
+#   delivery (obs/notify.py) — every alert firing/resolved transition
+#   is queued (bounded) and POSTed as JSON to this webhook by a drain
+#   thread with retry + exponential backoff; outcomes count into
+#   ``sdtpu_notify_total{outcome}`` and journal as ``notify_sent`` /
+#   ``notify_failed``. Unset (the default) the queue is never touched
+#   and no thread starts.
+# - ``SDTPU_NOTIFY_DEDUP_S`` (float seconds, default 60): identical
+#   (rule, event) transitions inside this window are dropped (outcome
+#   ``deduped``) so a flapping rule cannot page-storm.
+# - ``SDTPU_OBS_HTTP_TIMEOUT_S`` (float seconds, floor 0.05): the one
+#   obs-plane outbound HTTP timeout — trace stitching, federation
+#   polls, webhook delivery, and the HTTP backend's control-plane
+#   probes all resolve through ``obs/stitch.py:http_timeout_s`` so a
+#   hung worker costs one bounded timeout, never a stalled sweep.
+#   Unset, each call site keeps its historical default (stitch 5.0,
+#   backend probes 3.0).
 
 
 def read_env(name: str, default: str = "") -> str:

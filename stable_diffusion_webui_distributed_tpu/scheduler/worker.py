@@ -704,13 +704,20 @@ class HTTPBackend:
 
     def __init__(self, address: str, port: int, tls: bool = False,
                  user: Optional[str] = None, password: Optional[str] = None,
-                 verify_tls: bool = True, timeout: float = 3.0):
+                 verify_tls: bool = True, timeout: Optional[float] = None):
         self.address = address
         self.port = port
         self.tls = tls
         self.user = user
         self.password = password
         self.verify_tls = verify_tls
+        if timeout is None:
+            # control-plane probe timeout (reachable/interrupt/heartbeat
+            # sweeps): the obs-plane-wide SDTPU_OBS_HTTP_TIMEOUT_S knob
+            # bounds it, defaulting to the historical 3.0s
+            from ..obs import stitch as obs_stitch
+
+            timeout = obs_stitch.http_timeout_s(3.0)
         self.timeout = timeout
         import requests
 

@@ -131,6 +131,15 @@ class World:
         # operator ping; off by default (no thread spawned)
         self._heartbeat_stop: Optional[threading.Event] = None
         self.start_heartbeat()
+        # with SDTPU_FEDERATION on, this World is the metrics prober's
+        # worker source (obs/federation.py); gate off = no registration
+        try:
+            from ..obs import federation as obs_federation
+
+            if obs_federation.enabled():
+                obs_federation.set_source(self)
+        except Exception:  # noqa: BLE001 — telemetry stays passive
+            pass
 
     # -- registry -----------------------------------------------------------
 

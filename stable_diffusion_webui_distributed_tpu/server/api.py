@@ -621,6 +621,15 @@ class ApiServer:
 
         return alerts.summary()
 
+    def handle_fleet(self) -> Dict[str, Any]:
+        """Fleet-federated metrics view (obs/federation.py): per-worker
+        poll/staleness status and the latest fleet aggregates.
+        ``enabled`` is False until SDTPU_FEDERATION=1 (the summary
+        itself is always served)."""
+        from stable_diffusion_webui_distributed_tpu.obs import federation
+
+        return federation.summary()
+
     def handle_executables(self) -> Dict[str, Any]:
         """Live compiled-executable census against the serving budget of
         <=2 step-cache x <=3 precision variants per shape bucket; the
@@ -879,6 +888,7 @@ class ApiServer:
             ("GET", "/internal/sim"): self.handle_sim,
             ("GET", "/internal/tsdb"): self.handle_tsdb,
             ("GET", "/internal/alerts"): self.handle_alerts,
+            ("GET", "/internal/fleet"): self.handle_fleet,
             ("GET", "/internal/executables"): self.handle_executables,
             ("GET", "/internal/autoscale"): self.handle_autoscale,
             ("GET", "/internal/profile"): self.handle_profile_get,

@@ -41,3 +41,9 @@ obs_journal.emit("fault_cleared", "chaos-0", kind="kill")
 # deal — flagged standalone, accepted beside the real registry.
 obs_journal.emit("alert_firing", "alert-slo", rule="slo_burn_fast")
 obs_journal.emit("alert_resolved", "alert-slo", rule="slo_burn_fast")
+
+# Delivery/federation-plane vocabulary pin (obs/notify.py +
+# obs/federation.py): flagged standalone, accepted beside the registry.
+obs_journal.emit("notify_sent", "notify-fleet_error_rate", attempts=1)
+obs_journal.emit("notify_failed", "notify-fleet_error_rate", attempts=3)
+obs_journal.emit("federation_poll_failed", "federation-w0", worker="w0")

@@ -1,0 +1,33 @@
+"""OB005 fixture: outbound network calls in obs/ outside the trio.
+
+Loaded by tests/test_lint.py under a spoofed obs/ rel path: outbound
+HTTP from any obs/ module other than federation/notify/stitch bypasses
+the SDTPU_OBS_HTTP_TIMEOUT_S bound and must be flagged.
+"""
+
+import urllib.request
+from urllib.request import urlopen
+
+import requests
+
+# BAD (line 14): module-level urlopen through the package spelling
+urllib.request.urlopen("http://example.invalid/internal/metrics")
+
+
+def fetch(session):
+    # BAD (line 19): aliased urlopen inside a function scope
+    urlopen("http://example.invalid/internal/tsdb", timeout=1.0)
+    # BAD (line 21): requests verb call
+    requests.get("http://example.invalid/hook", timeout=1.0)
+    # BAD (line 23): session verb call
+    session.post("http://example.invalid/hook", json={}, timeout=1.0)
+
+
+def sanctioned_escape():
+    # OK: deliberate site, marker-exempt
+    urlopen("http://example.invalid/ok")  # sdtpu-lint: netcall
+
+
+def not_network(store):
+    # OK: a .get on a non-HTTP owner is not an outbound call
+    return store.get("queue_wait_p95_s")

@@ -1,0 +1,591 @@
+"""Federation prober, TSDB durability, and notify delivery tests.
+
+Covers the three observability pieces of the federation PR:
+
+- obs/federation.py — prober staleness math (RTT-midpoint anchoring),
+  per-node fault isolation, fleet aggregates, the /internal/fleet
+  endpoint, and the hung-worker timeout regression;
+- obs/tsdb.py durability — dump/load round-trips, corrupt-snapshot
+  tolerance, cross-boot future-timestamp drops, and the
+  restart-equivalence contract (a quantile window spanning a restart
+  equals an uninterrupted run);
+- obs/notify.py — webhook delivery, retry with backoff, dedup, and
+  the gate-off no-op;
+
+plus the hash-pinned gate-off golden proving the serving path is
+byte-identical with every new knob unset.
+"""
+
+import json
+import socket
+import threading
+import time
+import types
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.obs import alerts as obs_alerts
+from stable_diffusion_webui_distributed_tpu.obs import (
+    federation as obs_fed,
+)
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs import notify as obs_notify
+from stable_diffusion_webui_distributed_tpu.obs import stitch as obs_stitch
+from stable_diffusion_webui_distributed_tpu.obs import tsdb as obs_tsdb
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+
+from test_goldens import _check
+from test_pipeline import init_params
+
+
+@pytest.fixture()
+def fed_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_FEDERATION", "1")
+    yield
+    obs_fed.reset()
+
+
+class FakeClock:
+    """Settable monotonic clock for deterministic staleness math."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def scripted_clock(values, last):
+    """Clock returning ``values`` in order, then ``last`` forever."""
+    it = iter(values)
+
+    def clock():
+        try:
+            return next(it)
+        except StopIteration:
+            return last
+
+    return clock
+
+
+class FakeBackend:
+    """In-process fed_fetch seam: returns canned documents or raises."""
+
+    def __init__(self, metrics_text="", tsdb_doc=None, exc=None):
+        self.metrics_text = metrics_text
+        self.tsdb_doc = tsdb_doc if tsdb_doc is not None else {"series": {}}
+        self.exc = exc
+
+    def fed_fetch(self):
+        if self.exc is not None:
+            raise self.exc
+        return self.metrics_text, self.tsdb_doc
+
+
+class FakeWorker:
+    def __init__(self, label, backend):
+        self.label = label
+        self.backend = backend
+
+
+_METRICS_A = "\n".join([
+    "# HELP sdtpu_worker_requests_total total requests",
+    "# TYPE sdtpu_worker_requests_total counter",
+    'sdtpu_worker_requests_total{worker="a"} 3',
+    'sdtpu_worker_requests_total{worker="x"} 1',
+    'sdtpu_worker_failures_total{worker="a"} 1',
+    "not a metric line at all",
+])
+
+_TSDB_A = {"series": {
+    "queue_wait_p95_s": {"count": 1, "latest": [5.0, 0.5],
+                         "samples": [[5.0, 0.5]]},
+    "e2e_p95_s": {"count": 1, "latest": [5.0, 1.25],
+                  "samples": [[5.0, 1.25]]},
+}}
+
+
+# -- prometheus text digest ---------------------------------------------------
+
+class TestParsePromText:
+    def test_sums_families_across_label_sets(self):
+        out = obs_fed.parse_prom_text(_METRICS_A)
+        assert out["sdtpu_worker_requests_total"] == 4.0
+        assert out["sdtpu_worker_failures_total"] == 1.0
+
+    def test_tolerates_comments_blanks_and_garbage(self):
+        text = "# HELP x\n\nbroken\nalso broken nan-ish value?\nf 2\nf 3\n"
+        assert obs_fed.parse_prom_text(text) == {"f": 5.0}
+        assert obs_fed.parse_prom_text("") == {}
+        assert obs_fed.parse_prom_text(None) == {}
+
+
+# -- staleness deadline -------------------------------------------------------
+
+class TestStaleAfter:
+    def test_scales_with_the_tsdb_interval(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB_INTERVAL_S", "2.0")
+        assert obs_fed.stale_after_s() == pytest.approx(6.0)
+
+    def test_floored_for_fast_test_cadences(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB_INTERVAL_S", "0.01")
+        assert obs_fed.stale_after_s() == pytest.approx(
+            obs_fed.STALE_FLOOR_S)
+
+
+# -- the prober ---------------------------------------------------------------
+
+class TestProberTick:
+    def test_gate_off_tick_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_FEDERATION", raising=False)
+        store = obs_tsdb.SeriesStore(points=64)
+        prober = obs_fed.FederationProber(
+            source=[FakeWorker("a", FakeBackend(_METRICS_A))],
+            store=store, clock=FakeClock(10.0))
+        assert prober.tick() == 0
+        assert store.names() == []
+
+    def test_tick_records_worker_and_fleet_series(self, fed_on,
+                                                  monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        monkeypatch.setattr(obs_prom, "fleet_queue_wait_p95", lambda: 0.0)
+        store = obs_tsdb.SeriesStore(points=64)
+        workers = [
+            FakeWorker("a", FakeBackend(_METRICS_A, _TSDB_A)),
+            FakeWorker("b", FakeBackend(
+                'sdtpu_worker_requests_total{worker="b"} 10\n')),
+        ]
+        prober = obs_fed.FederationProber(source=workers, store=store,
+                                          clock=FakeClock(10.0))
+        landed = prober.tick(now=10.0)
+        assert landed > 0
+        assert store.latest("worker:a/requests_total")[1] == 4.0
+        assert store.latest("worker:a/failures_total")[1] == 1.0
+        assert store.latest("worker:a/error_rate")[1] == pytest.approx(0.25)
+        assert store.latest("worker:a/queue_wait_p95_s")[1] == 0.5
+        assert store.latest("worker:a/e2e_p95_s")[1] == 1.25
+        assert store.latest("worker:b/error_rate")[1] == 0.0
+        # no remote tsdb doc series for b: the p95 defaults, never absent
+        assert store.latest("worker:b/queue_wait_p95_s")[1] == 0.0
+        assert store.latest("fleet/error_rate")[1] == pytest.approx(0.125)
+        assert store.latest("fleet/queue_wait_p95_s")[1] == 0.5
+        assert store.latest("fleet/worker_stale_count")[1] == 0.0
+        assert store.latest("fleet/poll_failures_total")[1] == 0.0
+
+    def test_staleness_anchors_to_the_rtt_midpoint(self, fed_on):
+        # fetch bracketed at t0=100, t1=102: the document is attributed
+        # to 101 (stitch's clock-correction pattern), so at now=102 the
+        # worker is 1.0s stale — data age, not transfer time
+        store = obs_tsdb.SeriesStore(points=64)
+        prober = obs_fed.FederationProber(
+            source=[FakeWorker("a", FakeBackend(_METRICS_A))],
+            store=store, clock=scripted_clock([100.0, 102.0], 102.0))
+        prober.tick(now=102.0)
+        assert store.latest("worker:a/staleness_s")[1] == pytest.approx(1.0)
+        assert store.latest("worker:a/poll_rtt_s")[1] == pytest.approx(2.0)
+
+    def test_per_node_fault_isolation(self, fed_on, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        monkeypatch.setattr(obs_prom, "fleet_queue_wait_p95", lambda: 0.0)
+        monkeypatch.setenv("SDTPU_JOURNAL", "1")
+        store = obs_tsdb.SeriesStore(points=64)
+        workers = [
+            FakeWorker("good", FakeBackend(_METRICS_A, _TSDB_A)),
+            FakeWorker("fedbad", FakeBackend(
+                exc=ConnectionError("worker down"))),
+        ]
+        prober = obs_fed.FederationProber(source=workers, store=store,
+                                          clock=FakeClock(10.0))
+        prober.tick(now=10.0)
+        # the healthy worker's sweep is untouched by the dead one
+        assert store.latest("worker:good/error_rate")[1] == \
+            pytest.approx(0.25)
+        # the dead worker contributes staleness + a 1.0 error share only
+        assert store.latest("worker:fedbad/staleness_s") is not None
+        assert store.latest("worker:fedbad/error_rate") is None
+        assert store.latest("fleet/error_rate")[1] == pytest.approx(0.625)
+        assert store.latest("fleet/poll_failures_total")[1] == 1.0
+        doc = prober.summary()
+        assert doc["workers"]["fedbad"]["failures"] == 1
+        assert "ConnectionError" in doc["workers"]["fedbad"]["last_error"]
+        assert doc["workers"]["good"]["last_error"] is None
+        events = obs_journal.JOURNAL.events_for("federation-fedbad")
+        assert any(e["event"] == "federation_poll_failed" for e in events)
+
+    def test_dead_worker_goes_stale_and_counts(self, fed_on):
+        clock = FakeClock(0.0)
+        backend = FakeBackend(_METRICS_A)
+        store = obs_tsdb.SeriesStore(points=64)
+        prober = obs_fed.FederationProber(
+            source=[FakeWorker("w", backend)], store=store, clock=clock)
+        prober.tick(now=0.0)
+        assert store.latest("fleet/worker_stale_count")[1] == 0.0
+        # the worker dies; the next sweep is far past the deadline
+        backend.exc = ConnectionError("gone")
+        clock.t = 100.0
+        prober.tick(now=100.0)
+        assert store.latest("worker:w/staleness_s")[1] == \
+            pytest.approx(100.0)
+        assert store.latest("fleet/worker_stale_count")[1] == 1.0
+        doc = prober.summary()
+        assert doc["workers"]["w"]["stale"] is True
+
+    def test_hung_worker_cannot_stall_the_tick(self, fed_on, monkeypatch):
+        # regression: a worker that accepts the TCP connection but never
+        # responds must cost one obs-plane timeout, not a hung sweep
+        monkeypatch.setenv("SDTPU_OBS_HTTP_TIMEOUT_S", "0.2")
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            port = srv.getsockname()[1]
+            backend = types.SimpleNamespace(
+                address="127.0.0.1", port=port, tls=False)
+            prober = obs_fed.FederationProber(
+                source=[FakeWorker("hung", backend)],
+                store=obs_tsdb.SeriesStore(points=64))
+            t0 = time.monotonic()
+            prober.tick()
+            elapsed = time.monotonic() - t0
+            assert elapsed < 2.0
+            doc = prober.summary()
+            assert doc["workers"]["hung"]["failures"] == 1
+            assert doc["workers"]["hung"]["last_error"] is not None
+        finally:
+            srv.close()
+
+
+# -- module plumbing: scale signal, alert rules, endpoint ---------------------
+
+class TestModuleSurfaces:
+    def test_fleet_scale_signal_is_gated(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_FEDERATION", raising=False)
+        assert obs_fed.fleet_queue_wait_p95() == 0.0
+
+    def test_fleet_scale_signal_reads_the_latest_aggregate(
+            self, fed_on, monkeypatch):
+        obs_tsdb.STORE.record("fleet/queue_wait_p95_s", 7.5)
+        try:
+            assert obs_fed.fleet_queue_wait_p95() == 7.5
+        finally:
+            obs_tsdb.reset()
+
+    def test_autoscaler_source_lifts_to_the_fleet_signal(
+            self, fed_on, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.fleet import slices
+
+        obs_tsdb.STORE.record("fleet/queue_wait_p95_s", 9.0)
+        try:
+            assert slices._default_quantile_source() >= 9.0
+        finally:
+            obs_tsdb.reset()
+
+    def test_fleet_alert_rules_are_registered(self):
+        rules = obs_alerts.registered_rules()
+        assert "worker_metrics_stale" in rules
+        assert "fleet_error_rate" in rules
+
+    def test_fleet_endpoint_schema(self):
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            ConfigModel,
+        )
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker \
+            import StubBackend, WorkerNode
+        from stable_diffusion_webui_distributed_tpu.scheduler.world \
+            import World
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            ApiServer,
+        )
+
+        w = World(ConfigModel())
+        w.add_worker(WorkerNode("m", StubBackend(), master=True,
+                                avg_ipm=10.0))
+        srv = ApiServer(w, state=GenerationState(),
+                        host="127.0.0.1", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/internal/fleet"
+            with urllib.request.urlopen(url, timeout=30) as r:
+                doc = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert set(doc) == {"enabled", "stale_after_s", "ticks",
+                            "polls_total", "poll_failures_total",
+                            "daemon", "workers", "fleet"}
+        assert doc["enabled"] is False
+        assert set(doc["fleet"]) == {"queue_wait_p95_s", "error_rate",
+                                     "worker_stale_count"}
+
+
+# -- obs-plane HTTP timeout knob ----------------------------------------------
+
+class TestHttpTimeoutKnob:
+    def test_defaults_follow_the_caller(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_OBS_HTTP_TIMEOUT_S", raising=False)
+        assert obs_stitch.http_timeout_s() == obs_stitch.FETCH_TIMEOUT_S
+        assert obs_stitch.http_timeout_s(3.0) == 3.0
+
+    def test_env_override_and_floor(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_OBS_HTTP_TIMEOUT_S", "0.5")
+        assert obs_stitch.http_timeout_s() == 0.5
+        monkeypatch.setenv("SDTPU_OBS_HTTP_TIMEOUT_S", "0.001")
+        assert obs_stitch.http_timeout_s() == 0.05
+
+    def test_http_backend_resolves_the_knob(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker \
+            import HTTPBackend
+
+        monkeypatch.setenv("SDTPU_OBS_HTTP_TIMEOUT_S", "0.7")
+        b = HTTPBackend("127.0.0.1", 1)
+        try:
+            assert b.timeout == 0.7
+        finally:
+            b.close()
+        monkeypatch.delenv("SDTPU_OBS_HTTP_TIMEOUT_S", raising=False)
+        b = HTTPBackend("127.0.0.1", 1)
+        try:
+            assert b.timeout == 3.0
+        finally:
+            b.close()
+
+
+# -- notify delivery ----------------------------------------------------------
+
+@pytest.fixture()
+def hook(monkeypatch):
+    """Local webhook capture server; scripted per-request statuses."""
+    received, statuses = [], deque()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            status = statuses.popleft() if statuses else 200
+            # record before responding: the client may assert the moment
+            # it sees the 2xx, so the append must happen-before it
+            if 200 <= status < 300:
+                received.append(body)
+            self.send_response(status)
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *args):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setenv(
+        "SDTPU_NOTIFY_URL",
+        f"http://127.0.0.1:{srv.server_address[1]}/hook")
+    monkeypatch.setenv("SDTPU_NOTIFY_DEDUP_S", "60")
+    yield {"received": received, "statuses": statuses}
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestNotify:
+    def test_gate_off_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_NOTIFY_URL", raising=False)
+        n = obs_notify.Notifier()
+        assert n.notify_transition("r", "firing", 1.0, "d") is False
+        assert n.counts() == {}
+        assert n.summary()["enabled"] is False
+
+    def test_delivers_one_document_per_transition(self, hook):
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("burn", "firing", 2.5, "hot") is True
+            assert n.flush(5.0) is True
+            assert n.counts() == {"sent": 1}
+            (body,) = hook["received"]
+            assert body["rule"] == "burn"
+            assert body["event"] == "firing"
+            assert body["value"] == 2.5
+            assert body["detail"] == "hot"
+            assert "ts" in body
+        finally:
+            n.stop()
+
+    def test_dedup_window_drops_repeats_not_transitions(self, hook):
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("r", "firing", 1.0, "d") is True
+            assert n.notify_transition("r", "firing", 1.0, "d") is False
+            # a different transition of the same rule is not a repeat
+            assert n.notify_transition("r", "resolved", 0.0, "d") is True
+            assert n.flush(5.0) is True
+            assert n.counts() == {"sent": 2, "deduped": 1}
+            assert len(hook["received"]) == 2
+        finally:
+            n.stop()
+
+    def test_retries_through_a_transient_500(self, hook):
+        hook["statuses"].append(500)
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("r", "firing", 1.0, "d") is True
+            assert n.flush(5.0) is True
+            assert n.counts() == {"sent": 1}
+            assert len(hook["received"]) == 1
+        finally:
+            n.stop()
+
+    def test_exhausted_retries_count_as_failed(self, hook):
+        hook["statuses"].extend([500] * obs_notify._MAX_ATTEMPTS)
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("r", "firing", 1.0, "d") is True
+            assert n.flush(5.0) is True
+            assert n.counts() == {"failed": 1}
+            assert hook["received"] == []
+        finally:
+            n.stop()
+
+
+# -- TSDB durability ----------------------------------------------------------
+
+class TestDurability:
+    def _filled(self, n=10, base=None):
+        now = time.monotonic() if base is None else base
+        store = obs_tsdb.SeriesStore(points=64)
+        for i in range(n):
+            store.record("queue_wait_p95_s", float(i % 7),
+                         t=now - 60.0 + i)
+        return store, now
+
+    def test_dump_load_round_trip(self):
+        a, _now = self._filled()
+        doc = a.dump()
+        assert doc["schema"] == 1
+        b = obs_tsdb.SeriesStore(points=64)
+        assert b.load_merge(doc) == 10
+        assert b.window("queue_wait_p95_s", 0) == \
+            a.window("queue_wait_p95_s", 0)
+        # restored samples do not count as "sampled this process"
+        assert b.stats()["samples_total"] == 0
+
+    def test_load_merge_tolerates_garbage(self):
+        b = obs_tsdb.SeriesStore(points=64)
+        assert b.load_merge(None) == 0
+        assert b.load_merge([1, 2]) == 0
+        assert b.load_merge({"series": "nope"}) == 0
+        assert b.load_merge({"series": {"s": [[1.0], ["x", "y"],
+                                              "junk"]}}) == 0
+        assert b.names() == []
+
+    def test_future_timestamps_from_a_prior_boot_are_dropped(self):
+        b = obs_tsdb.SeriesStore(points=64)
+        future = time.monotonic() + 1e6
+        assert b.load_merge({"series": {"s": [[future, 1.0]]}}) == 0
+        assert b.names() == []
+
+    def test_corrupt_snapshot_file_loads_as_nothing(self, tmp_path):
+        path = tmp_path / "tsdb_snapshot.json"
+        path.write_text('{"schema": 1, "series": {"s": [[1.0, 2.0')
+        b = obs_tsdb.SeriesStore(points=64)
+        assert obs_tsdb.load_snapshot(store=b, path=str(path)) == 0
+        assert obs_tsdb.load_snapshot(
+            store=b, path=str(tmp_path / "missing.json")) == 0
+        assert b.names() == []
+
+    def test_save_snapshot_is_gated_on_the_dir_knob(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_TSDB_DIR", raising=False)
+        a, _now = self._filled()
+        assert obs_tsdb.save_snapshot(store=a) is False
+
+    def test_save_load_via_the_dir_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB_DIR", str(tmp_path))
+        a, _now = self._filled()
+        assert obs_tsdb.save_snapshot(store=a) is True
+        assert (tmp_path / obs_tsdb.SNAPSHOT_BASENAME).exists()
+        b = obs_tsdb.SeriesStore(points=64)
+        assert obs_tsdb.load_snapshot(store=b) == 10
+        assert b.window("queue_wait_p95_s", 0) == \
+            a.window("queue_wait_p95_s", 0)
+
+    def test_quantile_window_spans_the_restart(self, tmp_path):
+        # the acceptance contract: save at sample 10, "restart" into a
+        # fresh store, record the rest — a quantile_over_time window
+        # spanning the restart equals the uninterrupted run's
+        now = time.monotonic()
+        ts = [now - 60.0 + i for i in range(20)]
+        vals = [float((i * 13) % 29) for i in range(20)]
+        uninterrupted = obs_tsdb.SeriesStore(points=64)
+        for t, v in zip(ts, vals):
+            uninterrupted.record("queue_wait_p95_s", v, t=t)
+        a = obs_tsdb.SeriesStore(points=64)
+        for t, v in zip(ts[:10], vals[:10]):
+            a.record("queue_wait_p95_s", v, t=t)
+        path = str(tmp_path / "snap.json")
+        assert obs_tsdb.save_snapshot(store=a, path=path) is True
+        b = obs_tsdb.SeriesStore(points=64)
+        assert obs_tsdb.load_snapshot(store=b, path=path) == 10
+        for t, v in zip(ts[10:], vals[10:]):
+            b.record("queue_wait_p95_s", v, t=t)
+        for q in (0.5, 0.95, 0.99):
+            assert b.quantile_over_time(
+                "queue_wait_p95_s", q, 120.0, now=now) == \
+                uninterrupted.quantile_over_time(
+                    "queue_wait_p95_s", q, 120.0, now=now)
+
+    def test_reset_is_the_restart(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SDTPU_TSDB", "1")
+        monkeypatch.setenv("SDTPU_TSDB_DIR", str(tmp_path))
+        obs_tsdb.reset()
+        obs_tsdb.STORE.record("queue_wait_p95_s", 4.0)
+        assert obs_tsdb.save_snapshot() is True
+        obs_tsdb.reset()  # the restart: a rebuilt store merges the disk
+        assert "queue_wait_p95_s" in obs_tsdb.STORE.names()
+        assert obs_tsdb.STORE.latest("queue_wait_p95_s")[1] == 4.0
+        monkeypatch.delenv("SDTPU_TSDB", raising=False)
+        monkeypatch.delenv("SDTPU_TSDB_DIR", raising=False)
+        obs_tsdb.reset()
+        assert obs_tsdb.STORE.names() == []
+
+
+# -- the gate-off serving path is byte-identical -----------------------------
+
+class TestDefaultPathPinned:
+    def test_federation_off_serving_path_hash_pinned(self, monkeypatch):
+        for var in ("SDTPU_TSDB", "SDTPU_ALERTS", "SDTPU_FEDERATION",
+                    "SDTPU_NOTIFY_URL", "SDTPU_TSDB_DIR"):
+            monkeypatch.delenv(var, raising=False)
+        obs_tsdb.reset()
+        obs_alerts.reset()
+        obs_fed.reset()
+        obs_notify.reset()
+        engine = Engine(TINY, init_params(TINY), chunk_size=4,
+                        state=GenerationState())
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(GenerationPayload(
+            prompt="a golden scenario cow", width=32, height=32,
+            steps=4, seed=4321, sampler_name="Euler a"))
+        _check("serving/federation-off-default", r)
+        # and nothing leaked into any of the new planes along the way
+        assert obs_tsdb.STORE.names() == []
+        assert obs_alerts.ENGINE.history() == []
+        assert obs_fed.summary()["workers"] == {}
+        assert obs_notify.summary()["outcomes"] == {}

@@ -70,8 +70,8 @@ class TestRepoGate:
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "TP004", "RC001", "RC002",
                      "RC003", "EV001", "OB001", "OB002", "OB003", "OB004",
-                     "LK001", "LK002", "LK003", "LK004", "DN001", "FL001",
-                     "AL001", "AL002", "CA001"):
+                     "OB005", "LK001", "LK002", "LK003", "LK004", "DN001",
+                     "FL001", "AL001", "AL002", "CA001"):
             assert rule in RULES and RULES[rule]
 
 
@@ -205,6 +205,9 @@ class TestFixtures:
             ("OB003", 38),  # chaos pin: unregistered without the registry
             ("OB003", 42),  # alert pin: unregistered without the registry
             ("OB003", 43),  # alert pin: unregistered without the registry
+            ("OB003", 47),  # notify pin: unregistered without the registry
+            ("OB003", 48),  # notify pin: unregistered without the registry
+            ("OB003", 49),  # federation pin: same
         }
         # dynamic event names, the marker-exempt literal, and plain
         # non-emit strings stay clean
@@ -250,6 +253,36 @@ class TestFixtures:
         mod = load_module(os.path.join(FIXTURES, "alert_bad.py"), rel)
         found = _rule_lines(analyze_modules([mod]))
         assert not {f for f in found if f[0] == "OB004"}
+
+    def test_net_family(self):
+        # OB005: outbound HTTP inside obs/ is confined to
+        # federation/notify/stitch. The fixture analyzes under a spoofed
+        # obs/ rel path outside the sanctioned trio, so every shape fires.
+        rel = "stable_diffusion_webui_distributed_tpu/obs/notify_bad.py"
+        mod = load_module(os.path.join(FIXTURES, "notify_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert {f for f in found if f[0] == "OB005"} == {
+            ("OB005", 14),  # module-level urllib.request.urlopen
+            ("OB005", 19),  # aliased urlopen inside a function
+            ("OB005", 21),  # requests verb call
+            ("OB005", 23),  # session verb call
+        }
+        # the '# sdtpu-lint: netcall' marker and the non-HTTP .get on a
+        # store stay clean
+
+    def test_net_rule_exempts_sanctioned_modules(self):
+        # the same calls inside obs/notify.py are the delivery channel's
+        # own outbound path: zero OB005 findings
+        rel = "stable_diffusion_webui_distributed_tpu/obs/notify.py"
+        mod = load_module(os.path.join(FIXTURES, "notify_bad.py"), rel)
+        found = _rule_lines(analyze_modules([mod]))
+        assert not {f for f in found if f[0] == "OB005"}
+
+    def test_net_rule_is_path_scoped(self):
+        # the same file under its real tests/lint_fixtures/ path is
+        # outside the obs/ scope: zero OB005 findings
+        found = _rule_lines(_fixture_findings("notify_bad.py"))
+        assert not {f for f in found if f[0] == "OB005"}
 
     def test_cache_family(self):
         # CA001: payload hashing and hand-built cache keys outside

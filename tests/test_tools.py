@@ -866,3 +866,115 @@ class TestAlertReport:
         # an artifact from a bench that died before phase validation
         (tmp_path / "dead.json").write_text(json.dumps({"device": "cpu"}))
         assert alert_report.main([str(tmp_path / "dead.json")]) == 2
+
+
+class TestFedReport:
+    @staticmethod
+    def _fleet_doc(stale=False):
+        return {
+            "enabled": True, "stale_after_s": 0.5, "ticks": 4,
+            "polls_total": 8, "poll_failures_total": 2 if stale else 0,
+            "daemon": False,
+            "workers": {
+                "alpha": {"polls": 4, "failures": 0, "staleness_s": 0.05,
+                          "stale": False, "rtt_s": 0.01,
+                          "last_error": None, "error_rate": 0.0,
+                          "queue_wait_p95_s": 0.2},
+                "victim": {"polls": 4, "failures": 2 if stale else 0,
+                           "staleness_s": 1.4 if stale else 0.06,
+                           "stale": stale, "rtt_s": 0.01,
+                           "last_error": ("ConnectionError: refused"
+                                          if stale else None),
+                           "error_rate": 1.0 if stale else 0.0,
+                           "queue_wait_p95_s": None},
+            },
+            "fleet": {"queue_wait_p95_s": 0.2,
+                      "error_rate": 0.5 if stale else 0.0,
+                      "worker_stale_count": 1.0 if stale else 0.0},
+        }
+
+    @staticmethod
+    def _snapshot_doc(stale=False):
+        tail = 5.0 if stale else 0.1
+        return {
+            "schema": 1, "points": 512, "saved_t_mono": 100.0,
+            "series": {
+                "worker:alpha/staleness_s": [[t, 0.1] for t in range(8)],
+                "worker:alpha/error_rate": [[t, 0.0] for t in range(8)],
+                "worker:alpha/queue_wait_p95_s":
+                    [[t, 0.2] for t in range(8)],
+                "worker:victim/staleness_s":
+                    [[t, 0.1] for t in range(6)] + [[6, tail], [7, tail]],
+                "worker:victim/error_rate": [[t, 0.0] for t in range(8)],
+                "fleet/queue_wait_p95_s": [[7, 0.2]],
+                "fleet/error_rate": [[7, 0.0]],
+                "fleet/worker_stale_count":
+                    [[7, 1.0 if stale else 0.0]],
+            },
+        }
+
+    def test_sparkline_shapes(self):
+        import fed_report
+
+        assert fed_report.sparkline([]) == "-"
+        flat = fed_report.sparkline([1.0, 1.0, 1.0])
+        assert flat == fed_report.SPARK[1] * 3
+        ramp = fed_report.sparkline([0.0, 1.0])
+        assert ramp[0] == fed_report.SPARK[0]
+        assert ramp[-1] == fed_report.SPARK[-1]
+        # trailing-window trim
+        assert len(fed_report.sparkline(range(100))) == 16
+
+    def test_build_summary_fleet_doc(self):
+        import fed_report
+
+        summary = fed_report.build_summary(self._fleet_doc(stale=True))
+        assert summary["kind"] == "fleet"
+        assert summary["stale_workers"] == ["victim"]
+        assert summary["stale_after_s"] == 0.5
+        by_name = {r["worker"]: r for r in summary["workers"]}
+        assert not by_name["alpha"]["stale"]
+        assert by_name["victim"]["error_rate"] == 1.0
+
+    def test_build_summary_snapshot_doc(self):
+        import fed_report
+
+        summary = fed_report.build_summary(self._snapshot_doc(stale=True),
+                                           stale_after_s=3.0)
+        assert summary["kind"] == "snapshot"
+        assert summary["stale_workers"] == ["victim"]
+        by_name = {r["worker"]: r for r in summary["workers"]}
+        # sparkline drawn from the staleness history
+        assert len(by_name["victim"]["sparklines"]["staleness_s"]) == 8
+        assert summary["fleet"]["worker_stale_count"] == 1.0
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        import fed_report
+
+        clean = tmp_path / "fleet.json"
+        clean.write_text(json.dumps(self._fleet_doc()))
+        assert fed_report.main([str(clean)]) == 0
+        out = capsys.readouterr().out
+        assert "alpha" in out and "victim" in out
+
+        assert fed_report.main([str(clean), "--json"]) == 0
+        digest = json.loads(capsys.readouterr().out)
+        assert digest["stale_workers"] == []
+
+        stale = tmp_path / "stale.json"
+        stale.write_text(json.dumps(self._fleet_doc(stale=True)))
+        assert fed_report.main([str(stale)]) == 1
+        err = capsys.readouterr().err
+        assert "stale worker" in err and "victim" in err
+
+        snap = tmp_path / "tsdb_snapshot.json"
+        snap.write_text(json.dumps(self._snapshot_doc(stale=True)))
+        assert fed_report.main([str(snap), "--stale-after", "3.0"]) == 1
+        assert fed_report.main([str(snap), "--stale-after", "10.0"]) == 0
+
+        (tmp_path / "garbage.json").write_text("{not json")
+        assert fed_report.main([str(tmp_path / "garbage.json")]) == 2
+        assert fed_report.main([str(tmp_path / "missing.json")]) == 2
+        # a document that is neither summary nor snapshot
+        (tmp_path / "other.json").write_text(json.dumps({"device": "cpu"}))
+        assert fed_report.main([str(tmp_path / "other.json")]) == 2

@@ -78,6 +78,11 @@ THRESHOLDS = {
     # regression at any size
     "alert_false_positives": ("up", "abs", 0.0),
     "alert_recall": ("down", "abs", 0.0),
+    # federation rows (bench.py run_federation): the kill-one-worker
+    # protocol is deterministic — a dropped webhook or a steady-state
+    # stale verdict is a paging/federation regression at any size
+    "notify_delivery_rate": ("down", "abs", 0.0),
+    "federation_staleness_fp": ("up", "abs", 0.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back

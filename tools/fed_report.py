@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Render a fleet-federation document as a per-worker staleness report.
+
+Consumes either kind of federation artifact (obs/federation.py):
+
+- a ``GET /internal/fleet`` summary (saved to a file), or
+- a durable TSDB snapshot (``SDTPU_TSDB_DIR/tsdb_snapshot.json``),
+  whose ``worker:<label>/...`` series carry the full poll history —
+  this is the shape that gets ascii sparklines.
+
+    python tools/fed_report.py fleet.json
+    python tools/fed_report.py /var/lib/sdtpu/tsdb_snapshot.json
+    python tools/fed_report.py fleet.json --json     # machine-readable
+
+Exit codes: 0 every worker fresh; 1 any stale worker; 2 artifact
+missing/unparseable or carrying no federation data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import benchjson
+
+_fmt = benchjson.fmt
+
+#: Sparkline ramp (space = lowest bucket); classic 8-level block glyphs.
+SPARK = " ▁▂▃▄▅▆▇█"
+
+_SPARK_WIDTH = 16
+
+#: Per-worker metrics a snapshot's series history is digested into.
+_METRICS = ("staleness_s", "error_rate", "queue_wait_p95_s")
+
+
+def sparkline(values, width=_SPARK_WIDTH):
+    """Ascii sparkline of the trailing ``width`` values ('-' when there
+    is nothing to draw). Flat series render as all-low, not all-high."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return SPARK[1] * len(vals)
+    out = []
+    for v in vals:
+        idx = int((v - lo) / span * (len(SPARK) - 1))
+        out.append(SPARK[max(0, min(len(SPARK) - 1, idx))])
+    return "".join(out)
+
+
+def _rows_from_fleet(doc):
+    """Per-worker rows from a /internal/fleet summary document."""
+    rows = []
+    for label, w in sorted((doc.get("workers") or {}).items()):
+        rows.append({
+            "worker": label,
+            "stale": bool(w.get("stale")),
+            "staleness_s": w.get("staleness_s"),
+            "error_rate": w.get("error_rate"),
+            "queue_wait_p95_s": w.get("queue_wait_p95_s"),
+            "polls": w.get("polls"),
+            "failures": w.get("failures"),
+            "last_error": w.get("last_error"),
+            "sparklines": {},  # a point-in-time summary has no history
+        })
+    return rows
+
+
+def _rows_from_snapshot(doc, stale_after_s):
+    """Per-worker rows from a durable TSDB snapshot's worker:<label>/
+    series; staleness verdicts re-derive from the latest gauge sample
+    against ``stale_after_s``."""
+    series = doc.get("series") or {}
+    workers = {}
+    for name, samples in series.items():
+        if not name.startswith("worker:") or "/" not in name:
+            continue
+        label, metric = name[len("worker:"):].split("/", 1)
+        workers.setdefault(label, {})[metric] = [
+            s[1] for s in samples
+            if isinstance(s, (list, tuple)) and len(s) == 2]
+    rows = []
+    for label, metrics in sorted(workers.items()):
+        row = {"worker": label, "polls": None, "failures": None,
+               "last_error": None, "sparklines": {}}
+        for metric in _METRICS:
+            history = metrics.get(metric) or []
+            row[metric] = history[-1] if history else None
+            if history:
+                row["sparklines"][metric] = sparkline(history)
+        staleness = row.get("staleness_s")
+        row["stale"] = (staleness is not None
+                        and staleness >= stale_after_s)
+        rows.append(row)
+    return rows
+
+
+def build_summary(doc, stale_after_s=3.0):
+    """Digest either artifact kind into the report rows; the ``kind``
+    field records which shape was detected (None = neither)."""
+    if isinstance(doc.get("workers"), dict):
+        kind = "fleet"
+        rows = _rows_from_fleet(doc)
+        fleet = dict(doc.get("fleet") or {})
+        stale_after = doc.get("stale_after_s", stale_after_s)
+    elif isinstance(doc.get("series"), dict):
+        kind = "snapshot"
+        rows = _rows_from_snapshot(doc, stale_after_s)
+        fleet = {}
+        for metric in ("queue_wait_p95_s", "error_rate",
+                       "worker_stale_count"):
+            samples = doc["series"].get(f"fleet/{metric}") or []
+            fleet[metric] = samples[-1][1] if samples else None
+        stale_after = stale_after_s
+    else:
+        return {"kind": None, "workers": [], "fleet": {},
+                "stale_workers": [], "stale_after_s": stale_after_s}
+    return {
+        "kind": kind,
+        "stale_after_s": stale_after,
+        "workers": rows,
+        "fleet": fleet,
+        "stale_workers": [r["worker"] for r in rows if r["stale"]],
+    }
+
+
+def render(summary):
+    rows = summary["workers"]
+    lines = [f"federation report ({summary['kind']}) — {len(rows)} "
+             f"worker(s), stale after {_fmt(summary['stale_after_s'])}s",
+             "",
+             f"{'worker':<12} {'fresh':<6} {'stale_s':>8} {'err':>6} "
+             f"{'p95_s':>7}  history (stale_s)"]
+    for r in rows:
+        spark = r["sparklines"].get("staleness_s", "-")
+        lines.append(
+            f"{r['worker']:<12} {'STALE' if r['stale'] else 'ok':<6} "
+            f"{_fmt(r['staleness_s']):>8} {_fmt(r['error_rate']):>6} "
+            f"{_fmt(r['queue_wait_p95_s']):>7}  {spark}")
+        if r.get("last_error"):
+            lines.append(f"{'':<12} last error: {r['last_error']}")
+    fleet = summary["fleet"]
+    if fleet:
+        lines.append("")
+        lines.append(
+            f"fleet: queue-wait p95 {_fmt(fleet.get('queue_wait_p95_s'))}s"
+            f"   error rate {_fmt(fleet.get('error_rate'))}"
+            f"   stale workers {_fmt(fleet.get('worker_stale_count'))}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default="fleet.json",
+                    help="saved GET /internal/fleet document or a "
+                         "tsdb_snapshot.json (default ./fleet.json)")
+    ap.add_argument("--stale-after", type=float, default=3.0,
+                    help="snapshot-mode freshness deadline in seconds "
+                         "(fleet summaries carry their own)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digested summary as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        doc = benchjson.load_bench(
+            args.path, "fed_report",
+            hint="curl <master>/internal/fleet > fleet.json")
+    except benchjson.BenchJsonError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    summary = build_summary(doc, stale_after_s=args.stale_after)
+    if summary["kind"] is None:
+        print("fed_report: document has neither a 'workers' summary nor "
+              "a 'series' snapshot — not a federation artifact",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    if summary["stale_workers"]:
+        print(f"fed_report: FAIL — stale worker(s): "
+              f"{', '.join(summary['stale_workers'])}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
