@@ -1261,6 +1261,151 @@ def run_cache(tiny):
     return out
 
 
+def run_lora(tiny):
+    """--lora: adapter-churn microbench (BENCH_lora.json + a "lora"
+    ledger row). Two arms cycle the same four synthetic adapters through
+    the serving dispatcher: the merged baseline (host merge + epoch bump
+    per switch) and the traced arm (SDTPU_LORA_TRACED=1 — factors ride
+    as jit arguments on the rank/slot ladder). The numbers are
+    structural, so CPU runs are meaningful: the traced churn phase must
+    mint ZERO new chunk executables and perform ZERO host merges while
+    the merged arm pays >= 1 merge per switch; the executables census
+    must stay silent; and the embed cache must survive every switch
+    (unet-only adapters leave conditioning untouched)."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu import cache
+    from stable_diffusion_webui_distributed_tpu.models import configs as C
+    from stable_diffusion_webui_distributed_tpu.obs import perf as obs_perf
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+        ShapeBucketer,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+        ServingDispatcher,
+    )
+    from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
+
+    dev = jax.devices()[0]
+    if tiny or dev.platform == "cpu":
+        family, size, steps = C.TINY, 64, 8
+    else:
+        family, size, steps = C.SD15, 512, 16
+    names = ("la", "lb", "lc", "ld")
+
+    def chunk_compiles():
+        return METRICS.summary()["compiles"].get("chunk", 0)
+
+    def arm(traced):
+        with _EnvPatch(SDTPU_LORA_TRACED="1" if traced else None,
+                       SDTPU_CACHE="1", SDTPU_CHUNK="4"):
+            engine = _make_engine(family, lora_names=names)
+            bucketer = ShapeBucketer(shapes=[(size, size)], batches=[1])
+            dispatcher = ServingDispatcher(engine, bucketer=bucketer,
+                                           window=0.0)
+            cache.clear_all()
+            METRICS.clear()
+            errs, lat = [], []
+
+            def go(p):
+                t0 = time.time()
+                try:
+                    dispatcher.submit(p.model_copy(deep=True))
+                except Exception as e:  # noqa: BLE001 — reported in JSON
+                    errs.append(repr(e))
+                    return
+                lat.append(time.time() - t0)
+
+            def payload(seed, adapter=None):
+                tag = f" <lora:{adapter}:0.8>" if adapter else ""
+                return GenerationPayload(
+                    prompt=f"bench lora llama{tag}",
+                    negative_prompt="blurry", steps=steps, width=size,
+                    height=size, seed=seed, sampler_name="Euler a")
+
+            # phase 1 — adapterless baseline: mints the plain bucket
+            base = payload(100)
+            go(base)
+            compiles_base = chunk_compiles()
+            # phase 2 — first adapter: the traced arm mints the ladder
+            # cell's executables exactly once; the merged arm reuses the
+            # plain ones (merge mutates params, not the compile key)
+            go(payload(101, names[0]))
+            compiles_warm = chunk_compiles()
+            merges_warm = engine._lora_merge_total
+            # phase 3 — churn: two full cycles over all four adapters.
+            # THE claim under test: switches are compile-free and (on
+            # the traced arm) merge-free.
+            switches = 0
+            for cyc in range(2):
+                for i, n in enumerate(names[1:] + names[:1]):
+                    go(payload(110 + 10 * cyc + i, n))
+                    switches += 1
+            compiles_churn = chunk_compiles() - compiles_warm
+            merges_churn = engine._lora_merge_total - merges_warm
+            # phase 4 — cache survival: the pre-churn baseline request,
+            # byte-exact, must still hit result dedupe (no epoch bump
+            # invalidated it), and every churn request after the first
+            # re-used its embed entry (adapters here are unet-only)
+            res_before = cache.summary()["result"]["hits"]
+            go(base)
+            result_survived = cache.summary()["result"]["hits"] > res_before
+            emb = cache.summary()["embed"]
+            e_hits = emb["positive"]["hits"] + emb["negative"]["hits"]
+            e_total = e_hits + emb["positive"]["misses"] + \
+                emb["negative"]["misses"]
+            census = obs_perf.census_from_keys(engine.executable_keys())
+            cache.clear_all()
+        return {
+            "chunk_compiles_baseline": compiles_base,
+            "chunk_compiles_first_adapter": compiles_warm - compiles_base,
+            "chunk_compiles_churn": compiles_churn,
+            "merges_churn": merges_churn,
+            "merges_total": engine._lora_merge_total,
+            "switches": switches,
+            "embed_hit_rate": round((e_hits / e_total) if e_total
+                                    else 0.0, 3),
+            "result_cache_survived_churn": bool(result_survived),
+            "census_alarm": int(bool(census["alarm"])),
+            "e2e_p50_s": round(_percentile(lat, 0.50), 4),
+            "errors": errs,
+        }
+
+    merged = arm(traced=False)
+    traced = arm(traced=True)
+    out = {
+        "metric": ("tiny_" if tiny or dev.platform == "cpu" else "")
+        + "lora_traced_chunk_compiles",
+        "value": traced["chunk_compiles_churn"],
+        "unit": "count",
+        "vs_baseline": merged["merges_churn"],
+        "merged": merged,
+        "traced": traced,
+        "device": dev.device_kind,
+    }
+    if merged["errors"] or traced["errors"]:
+        _dump_flightrec("lora")
+    base = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(base, "BENCH_lora.json"), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    row = _ledger_row("lora", {
+        "lora_traced_chunk_compiles": traced["chunk_compiles_churn"],
+        "lora_traced_merges": traced["merges_churn"],
+        "lora_merged_merges_per_switch": round(
+            merged["merges_churn"] / merged["switches"], 3)
+        if merged["switches"] else 0.0,
+        "lora_embed_hit_rate": traced["embed_hit_rate"],
+        "census_alarm": traced["census_alarm"],
+    }, dev.device_kind, tiny, time.time())
+    with open(os.path.join(base, "BENCH_LEDGER.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return out
+
+
 def _fleet_workload(tiny, dev):
     """The mixed-tenant open-loop arrival plan: (delay_s, tenant, class,
     payload-kwargs) per request. Interactive traffic is Poisson (seeded —
@@ -2313,6 +2458,13 @@ def main() -> None:
                          "per-layer hit rates, FLOPs/image delta for a "
                          "prefix-resumed denoise, e2e p50/p95; writes "
                          "BENCH_cache.json + a ledger row (CPU-safe)")
+    ap.add_argument("--lora", action="store_true",
+                    help="adapter-churn microbench: four adapters "
+                         "cycling through the dispatcher, merged vs "
+                         "SDTPU_LORA_TRACED arms — chunk-compile and "
+                         "host-merge counts per switch, embed-cache "
+                         "survival, census silence; writes "
+                         "BENCH_lora.json + a ledger row (CPU-safe)")
     ap.add_argument("--ragged", action="store_true",
                     help="ragged-dispatch microbench: mixed-height "
                          "workload under a fine ladder, a coarse classic "
@@ -2399,6 +2551,8 @@ def main() -> None:
             print(json.dumps(run_federation(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
+        elif args.lora:
+            print(json.dumps(run_lora(tiny)))
         elif args.ragged:
             print(json.dumps(run_ragged(tiny)))
         elif args.deepcache:

@@ -79,10 +79,19 @@ def lookup_or_encode(engine: Any, text: str, clip_skip: int, chunks: int,
     per group-max it ever appeared under. The keyspaces coincide safely:
     encoding a prompt at its true count is byte-identical to the classic
     encode whose max happens to equal it."""
+    lora = ""
+    try:
+        # Traced text-encoder deltas change the conditioning bytes without
+        # moving _cond_epoch; their content address keeps entries distinct
+        # (and lets adapterless entries survive the switch untouched).
+        lora = str(engine.traced_te_content())
+    except AttributeError:
+        pass  # fakes / bare engines without the traced-LoRA surface
     key = cache_keys.embed_key(
         text, clip_skip, chunks,
         cache_keys.model_fingerprint(engine),
-        cache_keys.text_tower_fingerprint(engine))
+        cache_keys.text_tower_fingerprint(engine),
+        lora=lora)
     s = store()
     hit = s.get(key)
     half = _NEG if negative else _POS

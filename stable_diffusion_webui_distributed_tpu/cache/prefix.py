@@ -86,11 +86,16 @@ class PrefixPlan:
 
 def plan(engine: Any, payload: Any, *, batch: int, width: int, height: int,
          steps: int, end: int, cadence: int, sc_active: bool,
-         precision: str, cfg_stop: int) -> Optional[PrefixPlan]:
+         precision: str, cfg_stop: int,
+         lora: str = "") -> Optional[PrefixPlan]:
     """Build the range's prefix plan, resolving a resume point if a
     usable captured prefix exists. Returns None when the range is not
     prefix-shareable (multi-group requests: the latent batch is not the
-    whole request, so a group index would have to enter the key)."""
+    whole request, so a group index would have to enter the key).
+
+    ``lora`` is the traced-adapter content address the denoise range runs
+    under ("" on the merged/adapterless path — there ``_model_epoch``
+    inside the model fingerprint already pins adapter identity)."""
     try:
         total = int(payload.batch_size) * int(payload.n_iter)
     except Exception:
@@ -100,7 +105,8 @@ def plan(engine: Any, payload: Any, *, batch: int, width: int, height: int,
     key = cache_keys.prefix_key(
         payload, model_fp=cache_keys.model_fingerprint(engine),
         batch=batch, width=width, height=height, steps=steps,
-        cadence=cadence, sc_active=sc_active, precision=precision)
+        cadence=cadence, sc_active=sc_active, precision=precision,
+        lora=lora)
     p = PrefixPlan(key, int(cadence), bool(sc_active), int(cfg_stop),
                    int(end))
     ent = store().get(key)

@@ -17,6 +17,9 @@ import jax
 import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.models.configs import CLIPTextConfig
+from stable_diffusion_webui_distributed_tpu.models.lora import (
+    apply_site as _lora_site,
+)
 
 
 def _act(name: str):
@@ -49,11 +52,13 @@ class CLIPAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, mask: jax.Array,
+                 lora=None) -> jax.Array:
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
         # Fused QKV: one (hidden, 3*hidden) matmul keeps the MXU busy.
         qkv = nn.Dense(3 * c.hidden_size, dtype=self.dtype, name="qkv")(x)
+        qkv = _lora_site(qkv, x, lora, "qkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -64,7 +69,8 @@ class CLIPAttention(nn.Module):
             q, k, v, bias=mask.astype(q.dtype), scale=1.0 / head_dim**0.5
         )
         out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
-        return nn.Dense(c.hidden_size, dtype=self.dtype, name="out_proj")(out)
+        y = nn.Dense(c.hidden_size, dtype=self.dtype, name="out_proj")(out)
+        return _lora_site(y, out, lora, "out_proj")
 
 
 class CLIPLayer(nn.Module):
@@ -72,15 +78,19 @@ class CLIPLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, mask: jax.Array,
+                 lora=None) -> jax.Array:
         c = self.cfg
         # Pre-LN transformer; layer norms in f32 for stable statistics.
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + CLIPAttention(c, dtype=self.dtype, name="attn")(h, mask)
+        x = x + CLIPAttention(c, dtype=self.dtype, name="attn")(
+            h, mask, lora=None if lora is None else lora.get("attn"))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(c.intermediate_size, dtype=self.dtype, name="fc1")(h)
-        h = _act(c.hidden_act)(h)
-        h = nn.Dense(c.hidden_size, dtype=self.dtype, name="fc2")(h)
+        f = nn.Dense(c.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        f = _lora_site(f, h, lora, "fc1")
+        f = _act(c.hidden_act)(f)
+        h = nn.Dense(c.hidden_size, dtype=self.dtype, name="fc2")(f)
+        h = _lora_site(h, f, lora, "fc2")
         return x + h
 
 
@@ -109,6 +119,7 @@ class CLIPTextModel(nn.Module):
         eos_index: Optional[jax.Array] = None,  # (B,) position of EOS token
         inject_values: Optional[jax.Array] = None,  # (B, T, H) learned vecs
         inject_mask: Optional[jax.Array] = None,    # (B, T, 1) 1 = replace
+        lora=None,  # traced adapter tree (models/lora.py), None = no-op
     ):
         c = self.cfg
         skip = c.default_skip if skip is None else skip
@@ -133,7 +144,9 @@ class CLIPTextModel(nn.Module):
 
         hidden = None
         for i in range(c.num_layers):
-            x = CLIPLayer(c, dtype=self.dtype, name=f"layer_{i}")(x, causal)
+            x = CLIPLayer(c, dtype=self.dtype, name=f"layer_{i}")(
+                x, causal,
+                lora=None if lora is None else lora.get(f"layer_{i}"))
             if i == c.num_layers - 1 - skip:
                 hidden = x
         assert hidden is not None, f"skip={skip} exceeds depth {c.num_layers}"

@@ -18,6 +18,7 @@ for SDXL's two encoders).
 
 from __future__ import annotations
 
+import hashlib
 import re
 from typing import Dict, List, Optional, Tuple
 
@@ -272,6 +273,402 @@ def merge_lora(
         get_logger().debug("lora: %d module(s) applied, %d skipped",
                            applied, skipped)
     return out, applied, skipped
+
+
+# --------------------------------------------------------------------------
+# traced adapters (SDTPU_LORA_TRACED): factors as jit ARGUMENTS
+# --------------------------------------------------------------------------
+#
+# The merge path above bakes the adapter into the param tree — correct,
+# but an adapter switch costs a host-side merge and (via the model epoch)
+# retires every cache entry keyed on the engine fingerprint. The traced
+# path instead hands the up/down factors to the jitted chunk executable
+# as ordinary inputs (SwiftDiffusion, arxiv 2407.02031): shapes are held
+# static by padding every site to a rank-bucket ladder and a slot-count
+# ladder, so ONE executable serves any adapter combination inside a
+# (rank_bucket, slot_count) cell and switching adapters recompiles
+# nothing. Delta math at each Dense site, in flax (I, O) orientation:
+#
+#     y = x @ W + sum_s ((x @ down_s^T) @ up_s^T)        (scale in up_s)
+#
+# with ``down`` padded to [slots, rank_bucket, I] and ``up`` to
+# [slots, O, rank_bucket]; zero padding is exact (extra ranks/slots
+# contribute 0). Fused sites (attn qkv / kv) stack each adapter's
+# sub-modules along the rank axis with the up rows placed block-wise, so
+# a single site tensor carries q+k+v at effective rank <= 3r.
+
+DEFAULT_RANK_LADDER: Tuple[int, ...] = (8, 16, 32, 64)
+DEFAULT_SLOT_LADDER: Tuple[int, ...] = (1, 2, 4)
+
+_SITE_RE = re.compile(r"^(down_\d+_attn_\d+|mid_attn|up_\d+_attn_\d+)$")
+_BLOCK_RE = re.compile(r"^block_\d+$")
+_LAYER_RE = re.compile(r"^layer_\d+$")
+
+#: Dense leaves inside one transformer block that can carry a delta.
+_BLOCK_LEAVES = (("attn1", "qkv"), ("attn1", "out_proj"), ("attn2", "q"),
+                 ("attn2", "kv"), ("attn2", "out_proj"), ("geglu", "proj"),
+                 ("ff_out",))
+_TE_LEAVES = (("attn", "qkv"), ("attn", "out_proj"), ("fc1",), ("fc2",))
+
+
+def traced_enabled() -> bool:
+    """Live read of the traced-LoRA master knob (SDTPU_LORA_TRACED) —
+    default OFF; the off path keeps the merge semantics byte-for-byte."""
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_flag,
+    )
+
+    return env_flag("SDTPU_LORA_TRACED", False)
+
+
+def _ladder_strict(raw: str) -> Tuple[int, ...]:
+    vals = tuple(sorted({int(p.strip()) for p in raw.split(",") if
+                         p.strip()}))
+    if not vals or any(v <= 0 for v in vals):
+        raise ValueError("ladder needs positive ints")
+    return vals
+
+
+def rank_ladder() -> Tuple[int, ...]:
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_parsed,
+    )
+
+    return env_parsed("SDTPU_LORA_RANKS", _ladder_strict,
+                      DEFAULT_RANK_LADDER, "comma list of ranks")
+
+
+def slot_ladder() -> Tuple[int, ...]:
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        env_parsed,
+    )
+
+    return env_parsed("SDTPU_LORA_SLOTS", _ladder_strict,
+                      DEFAULT_SLOT_LADDER, "comma list of slot counts")
+
+
+def _bucket(value: int, ladder: Tuple[int, ...]) -> Optional[int]:
+    for rung in ladder:
+        if value <= rung:
+            return rung
+    return None
+
+
+def bucket_rank(rank: int) -> Optional[int]:
+    """Quantize an effective site rank onto the static ladder (None when
+    it exceeds the top rung — the set then falls back to the merge
+    path). The ladder is what keeps a request-derived rank from minting
+    executables (sdtpu-lint RC001 discipline)."""
+    return _bucket(int(rank), rank_ladder())
+
+
+def bucket_slots(n: int) -> Optional[int]:
+    """Quantize an adapter count onto the slot ladder."""
+    return _bucket(int(n), slot_ladder())
+
+
+def site_inventory(params: Dict) -> Dict[str, Dict[Tuple[str, ...],
+                                                   Tuple[int, int]]]:
+    """Every Dense site a kohya adapter can target, per component:
+    {component: {path_tuple: (in_dim, out_dim)}} from the engine's actual
+    param tree. The FULL inventory (not just touched sites) is what keeps
+    the traced pytree STRUCTURE constant across adapter sets, so one
+    executable serves them all."""
+    out: Dict[str, Dict[Tuple[str, ...], Tuple[int, int]]] = {}
+
+    def kernel_of(tree, path):
+        node = tree
+        for part in path:
+            node = node.get(part) if isinstance(node, dict) else None
+            if node is None:
+                return None
+        k = node.get("kernel") if isinstance(node, dict) else None
+        return None if k is None or getattr(k, "ndim", 0) != 2 else k
+
+    unet = params.get("unet") or {}
+    sites: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+    for name, sub in unet.items():
+        if not _SITE_RE.match(name) or not isinstance(sub, dict):
+            continue
+        for proj in ("proj_in", "proj_out"):
+            k = kernel_of(sub, (proj,))
+            if k is not None:
+                sites[(name, proj)] = (int(k.shape[0]), int(k.shape[1]))
+        for block in sub:
+            if not _BLOCK_RE.match(block):
+                continue
+            for leaf in _BLOCK_LEAVES:
+                k = kernel_of(sub, (block,) + leaf)
+                if k is not None:
+                    sites[(name, block) + leaf] = (int(k.shape[0]),
+                                                   int(k.shape[1]))
+    out["unet"] = sites
+    for comp in ("text_encoder", "text_encoder_2"):
+        tree = params.get(comp)
+        csites: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+        if isinstance(tree, dict):
+            for name, sub in tree.items():
+                if not _LAYER_RE.match(name) or not isinstance(sub, dict):
+                    continue
+                for leaf in _TE_LEAVES:
+                    k = kernel_of(sub, leaf)
+                    if k is not None:
+                        csites[(name,) + leaf] = (int(k.shape[0]),
+                                                  int(k.shape[1]))
+        out[comp] = csites
+    return out
+
+
+class TracedSet:
+    """One resolved adapter set in traced form: zero-padded factor trees
+    plus the content address that replaces the model-epoch bump in cache
+    keys. ``tree`` holds, per component, a nested dict mirroring the
+    module paths with ``{"down": [S, rb, I], "up": [S, O, rb]}`` float32
+    leaves (scale folded into ``up``)."""
+
+    __slots__ = ("sig", "rank_bucket", "slots", "tree", "content",
+                 "te_content", "specs", "applied", "skipped", "srcs")
+
+    def __init__(self, sig: str, rank_bucket: int, slots: int, tree: Dict,
+                 content: str, te_content: str, specs: Tuple,
+                 applied: int, skipped: int, srcs: Tuple) -> None:
+        self.sig = sig
+        self.rank_bucket = rank_bucket
+        self.slots = slots
+        self.tree = tree
+        self.content = content
+        self.te_content = te_content
+        self.specs = specs
+        self.applied = applied
+        self.skipped = skipped
+        self.srcs = srcs  # adapter state dicts (id-staleness guard)
+
+
+def _zero_tree(inventory: Dict, rb: int, sc: int) -> Dict:
+    """Full-inventory zero factor tree at (rank_bucket, slot_count)."""
+    tree: Dict = {}
+    for comp, sites in inventory.items():
+        ctree: Dict = {}
+        for path, (i_dim, o_dim) in sites.items():
+            node = ctree
+            for part in path[:-1]:
+                node = node.setdefault(part, {})
+            node[path[-1]] = {
+                "down": np.zeros((sc, rb, i_dim), np.float32),
+                "up": np.zeros((sc, o_dim, rb), np.float32),
+            }
+        tree[comp] = ctree
+    return tree
+
+
+def _site_leaf(tree: Dict, comp: str, path: Tuple[str, ...]):
+    node = tree.get(comp)
+    for part in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def _resolve_module(module: str, family: ModelFamily):
+    """kohya module key -> (component, path_tuple, fused_slot) or None."""
+    if module.startswith("lora_unet_"):
+        r = _resolve_unet_key(module, family.unet)
+        return ("unet", tuple(r[0]), r[1]) if r else None
+    for prefix, comp in (("lora_te1_", "text_encoder"),
+                         ("lora_te2_", "text_encoder_2"),
+                         ("lora_te_", "text_encoder")):
+        if module.startswith(prefix):
+            r = _resolve_te_key(module, prefix.rstrip("_"))
+            return (comp, tuple(r[0]), r[1]) if r else None
+    return None
+
+
+def _factor_pair(g: Dict[str, Array]):
+    """(up [O_sub, r], down [r, I], alpha) or None (unsupported form)."""
+    up, down = g.get("up"), g.get("down")
+    if up is None or down is None:
+        return None
+    if up.ndim == 4:
+        up = up[:, :, 0, 0]
+    if down.ndim == 4:
+        if down.shape[2:] != (1, 1):
+            return None  # 3x3 conv (LoCon) unsupported, same as merge
+        down = down[:, :, 0, 0]
+    rank = int(down.shape[0])
+    alpha = float(g["alpha"]) if "alpha" in g else float(rank)
+    return np.asarray(up, np.float32), np.asarray(down, np.float32), alpha
+
+
+def build_traced_set(specs, provider, family: ModelFamily,
+                     params: Dict) -> Optional[TracedSet]:
+    """Resolve ``specs`` ([(name, unet_w, te_w), ...], the
+    extract_lora_tags form) into a :class:`TracedSet`, or None when the
+    set cannot be bucketed (unknown adapter, rank/slot ladder exceeded)
+    — the caller then falls back to the merge path."""
+    inventory = site_inventory(params)
+    sc = bucket_slots(max(1, len(specs)))
+    if sc is None:
+        return None
+
+    # pass 1 — resolve every contribution and find the effective rank
+    # per site (fused sites stack sub-modules along the rank axis)
+    contribs = []   # (slot_idx, comp, path, fused, up, down, scale)
+    site_rank: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+    hasher = hashlib.sha256()
+    te_hasher = hashlib.sha256()
+    te_touched = False
+    srcs = []
+    applied = skipped = 0
+    for slot, (name, w, te_w) in enumerate(specs):
+        sd = provider(name) if provider else None
+        if sd is None:
+            return None  # unresolvable adapter: merge path owns the warn
+        srcs.append(sd)
+        hasher.update(f"{name}|{w}|{te_w}".encode())
+        groups = group_lora(sd)
+        for module in sorted(groups):
+            g = groups[module]
+            pair = _factor_pair(g)
+            resolved = _resolve_module(module, family)
+            if pair is None or resolved is None:
+                skipped += 1
+                continue
+            up, down, alpha = pair
+            comp, path, fused = resolved
+            leaf_dims = site_inventory_lookup(inventory, comp, path)
+            if leaf_dims is None:
+                skipped += 1
+                continue
+            weight = te_w if comp.startswith("text_encoder") else w
+            scale = weight * alpha / down.shape[0]
+            # effective rank is PER SLOT: each adapter owns its own rank
+            # axis, and fused sub-modules (q+k+v) stack within it
+            key = (slot, comp, path)
+            site_rank[key] = site_rank.get(key, 0) + int(down.shape[0])
+            contribs.append((slot, comp, path, fused, up, down, scale))
+            hasher.update(module.encode())
+            hasher.update(up.tobytes())
+            hasher.update(down.tobytes())
+            hasher.update(np.float32(scale).tobytes())
+            if comp.startswith("text_encoder"):
+                te_touched = True
+                te_hasher.update(module.encode())
+                te_hasher.update(up.tobytes())
+                te_hasher.update(down.tobytes())
+                te_hasher.update(np.float32(scale).tobytes())
+            applied += 1
+    if not contribs:
+        return None
+    rb = bucket_rank(max(site_rank.values()))
+    if rb is None:
+        return None
+
+    # pass 2 — allocate the full-inventory zero tree and place factors
+    tree = _zero_tree(inventory, rb, sc)
+    cursor: Dict[Tuple[int, str, Tuple[str, ...]], int] = {}
+    for slot, comp, path, fused, up, down, scale in contribs:
+        leaf = _site_leaf(tree, comp, path)
+        i_dim, o_dim = leaf["down"].shape[2], leaf["up"].shape[1]
+        r = int(down.shape[0])
+        if down.shape[1] != i_dim:
+            continue  # dim mismatch (wrong-family adapter): stays zero
+        ck = (slot, comp, path)
+        at = cursor.get(ck, 0)
+        if at + r > rb:
+            continue
+        cursor[ck] = at + r
+        leaf["down"][slot, at:at + r, :] = down
+        if fused is None:
+            if up.shape[0] != o_dim:
+                continue
+            leaf["up"][slot, :, at:at + r] = up * scale
+        else:
+            idx, of = fused
+            cols = o_dim // of
+            if up.shape[0] != cols:
+                continue
+            leaf["up"][slot, idx * cols:(idx + 1) * cols, at:at + r] = \
+                up * scale
+
+    sig = f"lora:r{rb}s{sc}"
+    return TracedSet(sig, rb, sc, tree, hasher.hexdigest(),
+                     te_hasher.hexdigest() if te_touched else "",
+                     tuple(specs), applied, skipped, tuple(srcs))
+
+
+def site_inventory_lookup(inventory: Dict, comp: str,
+                          path: Tuple[str, ...]):
+    sites = inventory.get(comp)
+    return sites.get(path) if sites else None
+
+
+def zero_set(params: Dict, family: ModelFamily, rb: int,
+             sc: int) -> TracedSet:
+    """All-zero traced set at an explicit (rank_bucket, slot_count) —
+    the warmup sweep's stand-in adapter (exact no-op contribution, same
+    executable as any real set in the cell)."""
+    rb = bucket_rank(rb) or rank_ladder()[-1]
+    sc = bucket_slots(sc) or slot_ladder()[-1]
+    tree = _zero_tree(site_inventory(params), rb, sc)
+    return TracedSet(f"lora:r{rb}s{sc}", rb, sc, tree, "zero", "",
+                     (), 0, 0, ())
+
+
+def delta_out(x, site):
+    """Traced delta at one Dense site: ``sum_s (x @ down_s^T) @ up_s^T``.
+
+    ``site`` leaves are [S, rb, I] / [S, O, rb] (shared across the batch,
+    the text-encoder form) or [B, S, rb, I] / [B, S, O, rb] (per-row sets,
+    the batched UNet form). Returns the [B, T, O] contribution in
+    ``x.dtype``; zero padding contributes exactly 0."""
+    import jax.numpy as jnp
+
+    down, up = site["down"], site["up"]
+    if down.ndim == 4:  # per-row heterogeneous sets
+        h = jnp.einsum("bti,bsri->bstr", x, down.astype(x.dtype))
+        return jnp.einsum("bstr,bsor->bto", h, up.astype(x.dtype))
+    h = jnp.einsum("bti,sri->bstr", x, down.astype(x.dtype))
+    return jnp.einsum("bstr,sor->bto", h, up.astype(x.dtype))
+
+
+def apply_site(y, x, lora, key: str):
+    """``y + delta_out(x, lora[key])`` in ``y.dtype`` — the one-line hook
+    the model code calls after each Dense site. Identity when ``lora`` is
+    None (the default trace: the gated-off graph stays byte-identical) or
+    the site is absent from the inventory."""
+    site = None if lora is None else lora.get(key)
+    if site is None:
+        return y
+    return y + delta_out(x, site).astype(y.dtype)
+
+
+def stack_row_sets(sets: List[TracedSet], batch: int):
+    """Stack per-row adapter sets into the batched [B, S, ...] delta tree
+    for a coalesced group. All sets must share one (rank_bucket, slots)
+    cell — the dispatcher's group key guarantees it. Short lists pad by
+    repeating the last row (the pad-and-drop rows of the batch ladder)."""
+    import jax.numpy as jnp
+    from jax import tree_util
+
+    assert sets, "stack_row_sets needs at least one row"
+    cell = {(s.rank_bucket, s.slots) for s in sets}
+    assert len(cell) == 1, f"heterogeneous cells in one group: {cell}"
+    rows = list(sets) + [sets[-1]] * (batch - len(sets))
+    return tree_util.tree_map(
+        lambda *leaves: jnp.stack([jnp.asarray(a) for a in leaves]),
+        *[r.tree for r in rows])
+
+
+def broadcast_set(ts: TracedSet, batch: int):
+    """One set for every row: the solo-dispatch batched tree."""
+    import jax.numpy as jnp
+    from jax import tree_util
+
+    return tree_util.tree_map(
+        lambda a: jnp.broadcast_to(jnp.asarray(a),
+                                   (batch,) + a.shape),
+        ts.tree)
 
 
 # --------------------------------------------------------------------------

@@ -26,6 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from stable_diffusion_webui_distributed_tpu.models.configs import UNetConfig
+from stable_diffusion_webui_distributed_tpu.models.lora import (
+    apply_site as _lora_site,
+)
 from stable_diffusion_webui_distributed_tpu.parallel.sharding import (
     channel_concat,
 )
@@ -107,19 +110,23 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None,
-                 true_len: Optional[jax.Array] = None) -> jax.Array:
+                 true_len: Optional[jax.Array] = None,
+                 lora=None) -> jax.Array:
         B, T, C = x.shape
         head_dim = C // self.num_heads
         qz = self.quant_linears
         if context is None:
             qkv = _linear(qz, 3 * C, use_bias=False, dtype=self.dtype,
                           name="qkv")(x)
+            qkv = _lora_site(qkv, x, lora, "qkv")
             q, k, v = jnp.split(qkv, 3, axis=-1)
             ctx_len = T
         else:
             q = _linear(qz, C, use_bias=False, dtype=self.dtype, name="q")(x)
+            q = _lora_site(q, x, lora, "q")
             kv = _linear(qz, 2 * C, use_bias=False, dtype=self.dtype,
                          name="kv")(context)
+            kv = _lora_site(kv, context, lora, "kv")
             k, v = jnp.split(kv, 2, axis=-1)
             ctx_len = context.shape[1]
 
@@ -166,8 +173,9 @@ class Attention(nn.Module):
             out = jax.nn.dot_product_attention(
                 q, k, v, scale=1.0 / head_dim**0.5)
         out = out.reshape(B, T, C)
-        return _linear(self.quant_linears, C, dtype=self.dtype,
-                       name="out_proj")(out)
+        y = _linear(self.quant_linears, C, dtype=self.dtype,
+                    name="out_proj")(out)
+        return _lora_site(y, out, lora, "out_proj")
 
 
 class GEGLU(nn.Module):
@@ -176,9 +184,10 @@ class GEGLU(nn.Module):
     quant_linears: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, lora=None) -> jax.Array:
         h = _linear(self.quant_linears, 2 * self.dim_out, dtype=self.dtype,
                     name="proj")(x)
+        h = _lora_site(h, x, lora, "proj")
         a, g = jnp.split(h, 2, axis=-1)
         return a * nn.gelu(g)
 
@@ -195,24 +204,30 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array,
                  true_len: Optional[jax.Array] = None,
-                 ctx_true: Optional[jax.Array] = None) -> jax.Array:
+                 ctx_true: Optional[jax.Array] = None,
+                 lora=None) -> jax.Array:
         C = x.shape[-1]
         qz = self.quant_linears
+
+        def sub(key):
+            return None if lora is None else lora.get(key)
+
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           impl=self.attention_impl, mesh=self.mesh,
                           quant_linears=qz, name="attn1")(
             nn.LayerNorm(dtype=jnp.float32, name="ln1")(x),
-            true_len=true_len,
+            true_len=true_len, lora=sub("attn1"),
         )
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           quant_linears=qz, name="attn2")(
             nn.LayerNorm(dtype=jnp.float32, name="ln2")(x), context,
-            true_len=ctx_true,
+            true_len=ctx_true, lora=sub("attn2"),
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln3")(x)
-        h = GEGLU(4 * C, dtype=self.dtype, quant_linears=qz,
-                  name="geglu")(h)
-        h = _linear(qz, C, dtype=self.dtype, name="ff_out")(h)
+        g = GEGLU(4 * C, dtype=self.dtype, quant_linears=qz,
+                  name="geglu")(h, lora=sub("geglu"))
+        h = _linear(qz, C, dtype=self.dtype, name="ff_out")(g)
+        h = _lora_site(h, g, lora, "ff_out")
         return x + h
 
 
@@ -230,16 +245,18 @@ class SpatialTransformer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array,
                  true_rows: Optional[jax.Array] = None,
-                 ctx_true: Optional[jax.Array] = None) -> jax.Array:
+                 ctx_true: Optional[jax.Array] = None,
+                 lora=None) -> jax.Array:
         B, H, W, C = x.shape
         residual = x
         # row-major flatten: a valid spatial prefix of true_rows rows is a
         # valid token prefix of true_rows * W tokens
         true_len = (None if true_rows is None
                     else jnp.minimum(true_rows, H).astype(jnp.int32) * W)
-        h = GroupNorm32(name="norm")(x).reshape(B, H * W, C)
+        hn = GroupNorm32(name="norm")(x).reshape(B, H * W, C)
         h = _linear(self.quant_linears, C, dtype=self.dtype,
-                    name="proj_in")(h)
+                    name="proj_in")(hn)
+        h = _lora_site(h, hn, lora, "proj_in")
         block = TransformerBlock
         if self.use_remat:
             block = nn.remat(TransformerBlock, static_argnums=())
@@ -247,10 +264,13 @@ class SpatialTransformer(nn.Module):
             h = block(self.num_heads, dtype=self.dtype,
                       attention_impl=self.attention_impl, mesh=self.mesh,
                       quant_linears=self.quant_linears,
-                      name=f"block_{i}")(h, context, true_len, ctx_true)
-        h = _linear(self.quant_linears, C, dtype=self.dtype,
-                    name="proj_out")(h)
-        return residual + h.reshape(B, H, W, C)
+                      name=f"block_{i}")(h, context, true_len, ctx_true,
+                                         None if lora is None
+                                         else lora.get(f"block_{i}"))
+        ho = _linear(self.quant_linears, C, dtype=self.dtype,
+                     name="proj_out")(h)
+        ho = _lora_site(ho, h, lora, "proj_out")
+        return residual + ho.reshape(B, H, W, C)
 
 
 class Downsample(nn.Module):
@@ -356,6 +376,7 @@ class UNet(nn.Module):
         cache_mode: Optional[str] = None,
         true_rows: Optional[jax.Array] = None,
         ctx_true: Optional[jax.Array] = None,
+        lora=None,
     ) -> jax.Array:
         c = self.cfg
         assert cache_mode in (None, "deep", "reuse"), cache_mode
@@ -427,7 +448,9 @@ class UNet(nn.Module):
                         name=f"down_{level}_attn_{i}")(
                         x, context,
                         None if rows_lvl is None else rows_lvl[level],
-                        ctx_true)
+                        ctx_true,
+                        None if lora is None
+                        else lora.get(f"down_{level}_attn_{i}"))
                 skips.append(x)
             if level < last_ds:
                 x = Downsample(ch, dtype=self.dtype,
@@ -448,7 +471,8 @@ class UNet(nn.Module):
                     quant_linears=self.quant_linears,
                     name="mid_attn")(
                     x, context,
-                    None if rows_lvl is None else rows_lvl[-1], ctx_true)
+                    None if rows_lvl is None else rows_lvl[-1], ctx_true,
+                    None if lora is None else lora.get("mid_attn"))
             x = ResBlock(mid_ch, dtype=self.dtype,
                          quant_convs=self.quant_convs,
                          name="mid_res_1")(x, temb)
@@ -493,7 +517,9 @@ class UNet(nn.Module):
                         name=f"up_{level}_attn_{i}")(
                         x, context,
                         None if rows_lvl is None else rows_lvl[level],
-                        ctx_true)
+                        ctx_true,
+                        None if lora is None
+                        else lora.get(f"up_{level}_attn_{i}"))
             if level > 0:
                 x = Upsample(ch, dtype=self.dtype,
                              quant_convs=self.quant_convs,

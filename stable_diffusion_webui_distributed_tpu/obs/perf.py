@@ -53,6 +53,11 @@ DEFAULT_SLO_TARGET = 0.95
 #: variants; PR 7: ≤3 precision rungs over the same param tree).
 STEP_CACHE_BUDGET = 2
 PRECISION_BUDGET = 3
+#: Distinct traced-LoRA (rank_bucket, slot_count) cells allowed per shape
+#: bucket (SDTPU_LORA_TRACED): adapter NAMES never mint executables — only
+#: ladder cells do — so this bounds the whole adapter-diverse workload.
+#: The adapterless variant ("" sig) rides outside this allowance.
+LORA_BUDGET = 4
 
 #: bf16 peak FLOPs/s per chip by device_kind substring (public specs);
 #: bench.py's MFU estimate shares this table via :func:`peak_flops_for`.
@@ -122,7 +127,8 @@ class PerfLedger:
         self.max_groups = max(1, int(max_groups or DEFAULT_GROUPS))
         self.slo_target = min(0.9999, max(0.0, float(slo_target)))
         self._lock = threading.Lock()
-        self._groups: "OrderedDict[Tuple[str, int, str], Dict[str, float]]" \
+        self._groups: \
+            "OrderedDict[Tuple[str, int, str, str], Dict[str, float]]" \
             = OrderedDict()  # guarded-by: _lock
         self._groups_evicted = 0  # guarded-by: _lock
         self._compiles: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
@@ -135,6 +141,7 @@ class PerfLedger:
     # -- recording (dispatcher / engine side) ------------------------------
 
     def record_dispatch(self, *, bucket: str, cadence: int, precision: str,
+                        lora: str = "",
                         device_s: float, flops: float, requests: int,
                         batch_raw: int, batch_run: int, true_pixels: int,
                         padded_pixels: int, masked_pixels: int = 0,
@@ -157,11 +164,16 @@ class PerfLedger:
         (``obs/tsdb.dispatch_memory_sample()``: bytes_in_use /
         peak_bytes_in_use / live_buffers keys as available) — ``None``
         on CPU or when memory_stats is unsupported, and the group row
-        then reports null watermarks rather than fabricating them."""
+        then reports null watermarks rather than fabricating them.
+
+        ``lora`` is the traced-adapter cell label (``"r8s1"``-style, "" on
+        adapterless and merged-path dispatches) — appended as the LAST
+        group-key axis so adapter-active traffic gets its own MFU rows
+        without disturbing key[0..2] consumers."""
         if not enabled():
             return
         try:
-            key = (str(bucket), int(cadence), str(precision))
+            key = (str(bucket), int(cadence), str(precision), str(lora))
             with self._lock:
                 if self._device_kind is None:
                     self._device_kind = _device_kind()
@@ -260,7 +272,7 @@ class PerfLedger:
     # -- derivation --------------------------------------------------------
 
     @staticmethod
-    def _dispatch_entry(key: Tuple[str, int, str],
+    def _dispatch_entry(key: Tuple[str, int, str, str],
                         g: Dict[str, float], device_s: float,
                         flops: float, device_kind: Optional[str],
                         compiles_total: int) -> Dict[str, Any]:
@@ -276,6 +288,7 @@ class PerfLedger:
         padded_px = g["padded_pixels"]
         return {
             "bucket": key[0], "cadence": key[1], "precision": key[2],
+            "lora": key[3],
             "device_s": round(float(device_s), 6),
             "flops": float(flops),
             "mfu": mfu,
@@ -284,7 +297,7 @@ class PerfLedger:
         }
 
     @staticmethod
-    def _group_row(key: Tuple[str, int, str], g: Dict[str, float],
+    def _group_row(key: Tuple[str, int, str, str], g: Dict[str, float],
                    device_kind: Optional[str]) -> Dict[str, Any]:
         # static for the same reason as _dispatch_entry (LK001 discipline)
         peak = peak_flops_for(device_kind or "", key[2])
@@ -301,6 +314,7 @@ class PerfLedger:
         padded_tok = int(g.get("padded_tokens", 0))
         return {
             "bucket": key[0], "cadence": key[1], "precision": key[2],
+            "lora": key[3],
             "dispatches": int(g["dispatches"]),
             "requests": int(g["requests"]),
             "device_s": g["device_s"],
@@ -395,14 +409,19 @@ LEDGER = PerfLedger()
 
 def census_from_keys(keys: Iterable[Tuple],
                      step_cache_budget: int = STEP_CACHE_BUDGET,
-                     precision_budget: int = PRECISION_BUDGET
+                     precision_budget: int = PRECISION_BUDGET,
+                     lora_budget: int = LORA_BUDGET
                      ) -> Dict[str, Any]:
     """Group compiled-stage cache keys by shape bucket and check the
-    chunk-executable budget. Chunk keys are
-    ``("chunk", sampler, steps, w, h, batch, ..., step_cache, precision)``
-    (pipeline/engine.py) — everything between the kind and the last two
-    axes identifies the bucket; the last two axes are the budgeted
-    variants."""
+    chunk-executable budget. Chunk keys are ``("chunk", sampler, steps,
+    w, h, batch, ..., lora_sig, step_cache, precision)``
+    (pipeline/engine.py) — everything between the kind and the last three
+    axes identifies the bucket; the trailing axes are the budgeted
+    variants. The lora_sig axis ("" adapterless, ``"lora:rXsY"`` per
+    traced ladder cell) is recognized by its string shape, so older key
+    layouts (no lora axis) census exactly as before. The lora allowance
+    is PER CELL, not per adapter — any number of adapter combos share a
+    cell's executables, which is the recompile-free serving contract."""
     buckets: "OrderedDict[Tuple, Dict[str, Any]]" = OrderedDict()
     other = 0
     total_chunks = 0
@@ -411,7 +430,12 @@ def census_from_keys(keys: Iterable[Tuple],
             other += 1
             continue
         total_chunks += 1
+        lora_v = ""
         ident = k[1:-2]
+        if isinstance(k[-3], str) and (k[-3] == ""
+                                       or k[-3].startswith("lora:")):
+            lora_v = k[-3]
+            ident = k[1:-3]
         b = buckets.get(ident)
         if b is None:
             b = {
@@ -419,24 +443,29 @@ def census_from_keys(keys: Iterable[Tuple],
                 "executables": 0,
                 "step_cache_variants": set(),
                 "precision_variants": set(),
+                "lora_variants": set(),
             }
             buckets[ident] = b
         b["executables"] += 1
         b["step_cache_variants"].add(k[-2])
         b["precision_variants"].add(str(k[-1]))
+        b["lora_variants"].add(lora_v)
     rows: List[Dict[str, Any]] = []
     over: List[str] = []
     for b in buckets.values():
         sc, prec = b["step_cache_variants"], b["precision_variants"]
+        n_lora = len([v for v in b["lora_variants"] if v])
         over_budget = (len(sc) > step_cache_budget
                        or len(prec) > precision_budget
+                       or n_lora > lora_budget
                        or b["executables"] > step_cache_budget
-                       * precision_budget)
+                       * precision_budget * (1 + n_lora))
         rows.append({
             "bucket": b["bucket"],
             "executables": b["executables"],
             "step_cache_variants": len(sc),
             "precisions": sorted(prec),
+            "lora_variants": n_lora,
             "over_budget": over_budget,
         })
         if over_budget:
@@ -447,6 +476,7 @@ def census_from_keys(keys: Iterable[Tuple],
         "other_executables": other,
         "budget": {"step_cache": step_cache_budget,
                    "precision": precision_budget,
+                   "lora": lora_budget,
                    "per_bucket": step_cache_budget * precision_budget},
         "over_budget": over,
         "alarm": bool(over),

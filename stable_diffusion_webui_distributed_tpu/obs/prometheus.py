@@ -202,6 +202,11 @@ HISTOGRAMS: Dict[str, Histogram] = {
         "sdtpu_decode_seconds",
         "VAE decode latency (dispatch + fetch halves observed "
         "separately)."),
+    "lora_apply": Histogram(
+        "sdtpu_lora_apply_seconds",
+        "LoRA adapter activation latency: traced factor-set builds "
+        "(SDTPU_LORA_TRACED, host-side padding/bucketing only — zero "
+        "merges, zero recompiles) observed per build."),
 }
 
 #: StageStats stage name -> histogram key (stages not listed only appear as
@@ -217,6 +222,14 @@ def observe_hist(name: str, value: float) -> None:
     h = HISTOGRAMS.get(name)
     if h is not None:
         h.observe(value)
+
+
+def observe_lora_apply(seconds: float) -> None:
+    """One traced factor-set build (``Engine._traced_set_for`` cache
+    miss): the full host cost of an adapter activation on the traced
+    path — the merged path's equivalent is a param-tree merge plus a
+    recompile, which this histogram exists to show the absence of."""
+    HISTOGRAMS["lora_apply"].observe(seconds)
 
 
 def observe_stage(stage: str, seconds: float) -> None:
@@ -235,6 +248,7 @@ def clear_histograms() -> None:
     for c in FLEET_COUNTERS.values():
         c.clear()
     PRECISION_COUNTER.clear()
+    LORA_SWITCH_COUNTER.clear()
     for c in WORKER_COUNTERS.values():
         c.clear()
     WATCHDOG_COUNTER.clear()
@@ -344,6 +358,21 @@ PRECISION_COUNTER = LabeledCounter(
     "sdtpu_dispatch_precision_total",
     "Requests dispatched to the device by resolved serving precision.",
     ("precision",))
+
+#: Adapter-set activations by serving mode: ``merged`` — host merge into
+#: the param tree (epoch bump, caches retired); ``traced`` — factor set
+#: installed as jit arguments (SDTPU_LORA_TRACED, no merge, no epoch
+#: bump). The engine feeds this through :func:`count_lora_switch`.
+LORA_SWITCH_COUNTER = LabeledCounter(
+    "sdtpu_lora_switch_total",
+    "LoRA adapter-set switches by serving mode (merged/traced).",
+    ("mode",))
+
+
+def count_lora_switch(mode: str, n: float = 1.0) -> None:
+    """One adapter-set switch: ``mode`` is ``merged`` (host merge path)
+    or ``traced`` (recompile-free traced path)."""
+    LORA_SWITCH_COUNTER.inc(n, mode=mode)
 
 # -- scheduler tier (scheduler/worker.py health + obs/watchdog.py) -----------
 
@@ -625,9 +654,12 @@ def _render_perf(lines: List[str]) -> None:
     s = obs_perf.LEDGER.summary()
 
     def body(g):
+        # lora: traced-adapter cell ("r8s1") or "" — adapter-active MFU
+        # rows stay separable from the adapterless baseline
         return (f'bucket="{_label(g["bucket"])}",'
                 f'cadence="{g["cadence"]}",'
-                f'precision="{_label(g["precision"])}"')
+                f'precision="{_label(g["precision"])}",'
+                f'lora="{_label(g.get("lora", ""))}"')
 
     groups = s["groups"]
     _labeled_family(
@@ -753,6 +785,7 @@ def render() -> str:
          for stage in sorted(timings)])
 
     lines.extend(PRECISION_COUNTER.render())
+    lines.extend(LORA_SWITCH_COUNTER.render())
     for c in FLEET_COUNTERS.values():
         lines.extend(c.render())
     for c in WORKER_COUNTERS.values():

@@ -63,13 +63,6 @@ from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
 
 
-# Truthy "params are dirty" sentinel for Engine._active_loras: latched when a
-# requested adapter set could not be fully resolved, so the next request (even
-# a tag-less one) re-merges from the pristine base instead of keeping a
-# partial merge. Never compares equal to a real spec tuple.
-_UNRESOLVED = ("<unresolved-lora-set>",)
-
-
 class Engine:
     """One loaded model family + its compiled stages on the local device(s)."""
 
@@ -117,8 +110,16 @@ class Engine:
             self.params = {k: (shard_params(v, mesh) if v is not None else None)
                            for k, v in self.params.items()}
 
-        # LoRA: merged host-side on request boundaries; the jitted stages
-        # take params as arguments, so adapter swaps never recompile.
+        # LoRA: merged host-side on request boundaries (the jitted stages
+        # take params as arguments, so adapter swaps never recompile), or
+        # — under SDTPU_LORA_TRACED — carried as traced jit arguments with
+        # the param tree left pristine (models/lora.py TracedSet).
+        # _active_loras latches () (pristine, initial) or the
+        # (spec-tuple, provider-generation) pair the last merge ran for —
+        # missing names included, so an identical repeat of a partially
+        # resolved set is a no-op until /refresh-loras bumps the
+        # registry's lora_generation and the retry actually sees new
+        # files.
         self.lora_provider = lora_provider
         self._base_params = self.params
         self._active_loras: Tuple = ()
@@ -215,6 +216,15 @@ class Engine:
         self._cond_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._cond_epoch = 0
         self._COND_CACHE_MAX = 64
+        # traced-adapter serving state (SDTPU_LORA_TRACED): the active
+        # TracedSet (None = adapterless), an LRU of built sets keyed
+        # (specs, provider generation), and host-merge accounting the
+        # adapter-churn bench reads (the traced arm must hold at 0)
+        self._traced_lora = None
+        self._traced_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._TRACED_CACHE_MAX = 8
+        self._lora_merge_total = 0
+        self._lora_merge_seconds = 0.0
         # weights-identity epoch for the cache tier (cache/keys.py
         # model_fingerprint): bumped whenever the served weights change
         # under one model_name — LoRA merges AND VAE swaps — so every
@@ -315,7 +325,7 @@ class Engine:
         return pair
 
     # sdtpu-lint: jitted(static=4)
-    def _encode_fn(self) -> Callable:
+    def _encode_fn(self, lora_sig: str = "") -> Callable:
         """(te_params, te2_params, ids, weights, clip_skip static) ->
         (context (1, chunks*77, D), pooled). Params are jit ARGUMENTS, never
         closure constants — so LoRA-patched trees swap in without
@@ -324,21 +334,31 @@ class Engine:
         ``ids``/``weights`` are (n_chunks, 77): long prompts ride as extra
         batch rows through the encoder, then concatenate along the sequence
         axis (webui unlimited-length convention). Emphasis weights scale the
-        embeddings with chunk-mean restoration (webui semantics)."""
+        embeddings with chunk-mean restoration (webui semantics).
+
+        ``lora_sig`` (SDTPU_LORA_TRACED, models/lora.py) selects the
+        variant whose trailing ``te_lora``/``te2_lora`` factor trees are
+        live: one executable per (rank_bucket, slot_count) cell serves
+        every adapter set in it. Empty sig keeps the key — and the traced
+        graph — identical to the adapterless build, and is what unet-only
+        adapter sets route to (their conditioning IS the adapterless
+        conditioning, so the embed cache survives the switch)."""
 
         def build():
             def encode(te_params, te2_params, ids, weights, skip,
-                       inj_mask, inj_l, inj_g):
+                       inj_mask, inj_l, inj_g, te_lora=None, te2_lora=None):
                 # skip=0 -> model default (None); webui clip_skip N maps to N-1.
                 skip_arg = skip if skip else None
                 ctx, pooled = self.text_encoder.apply(
                     {"params": te_params}, ids, skip=skip_arg,
                     inject_values=inj_l, inject_mask=inj_mask,
+                    lora=te_lora,
                 )
                 if self.text_encoder_2 is not None:
                     ctx2, pooled2 = self.text_encoder_2.apply(
                         {"params": te2_params}, ids, skip=skip_arg,
                         inject_values=inj_g, inject_mask=inj_mask,
+                        lora=te2_lora,
                     )
                     # channel_concat: both encoder outputs can be
                     # tp-sharded along features under a mesh, and a
@@ -362,12 +382,13 @@ class Engine:
 
             return jax.jit(encode, static_argnums=(4,))
 
-        return self._cached(("encode",), build)
+        key = ("encode",) if not lora_sig else ("encode", lora_sig)
+        return self._cached(key, build)
 
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
                          added_u, added_c, controls=(), total_steps=1,
                          inpaint_cond=None, unet=None, controlnet=None,
-                         ragged=None):
+                         ragged=None, lora=None):
         """Closure: x0-prediction denoiser with classifier-free guidance and
         optional ControlNet residual injection.
 
@@ -383,11 +404,18 @@ class Engine:
         int32 vectors for ragged dispatch — valid latent rows per batch
         row plus valid context tokens per CFG half. The CFG batch doubling
         duplicates ``true_rows`` and interleaves the two context lengths
-        exactly like the contexts themselves."""
+        exactly like the contexts themselves.
+
+        ``lora``: per-row [B, slots, ...] traced delta tree for the UNet
+        component (models/lora.py) — doubled along the batch axis here so
+        each image's adapter set rides both of its CFG rows; None (the
+        default trace) leaves the graph byte-identical."""
         unet = unet if unet is not None else self.unet
         controlnet = (controlnet if controlnet is not None
                       else self.controlnet_module)
         unet_params = {"params": unet_tree}
+        lora2 = (None if lora is None else jax.tree_util.tree_map(
+            lambda a: batch_concat([a, a]), lora))
         v_pred = self.schedule.prediction_type == "v_prediction"
 
         def denoise(x, sigma, step):
@@ -441,7 +469,8 @@ class Engine:
                     "ctx_true": batch_concat([ctx_true_u, ctx_true_c]),
                 }
             out = unet.apply(unet_params, unet_in, tb, ctx, added,
-                             control_residuals=residuals, **ragged_kw)
+                             control_residuals=residuals, lora=lora2,
+                             **ragged_kw)
             out_u, out_c = jnp.split(out.astype(jnp.float32), 2, axis=0)
             guided = out_u + cfg_scale * (out_c - out_u)
             if v_pred:
@@ -458,7 +487,8 @@ class Engine:
                   inpaint: bool = False,
                   ragged: bool = False,
                   step_cache: bool = False,
-                  precision: str = "") -> Callable:
+                  precision: str = "",
+                  lora_sig: str = "") -> Callable:
         """Compiled scan over ``length`` sampler steps starting at a traced
         index. Cache key excludes prompt/seed/cfg — those are data.
 
@@ -481,9 +511,19 @@ class Engine:
         the ladder; sdtpu-lint RC001 fixture ``ragged_bad.py``), and the
         sampler step re-zeroes latent rows past ``true_rows`` so
         ancestral noise injection cannot leak into the masked tail. The
-        ragged bit sits BEFORE the step_cache/precision axes so the
-        census parser (obs/perf.py census_from_keys: ident = k[1:-2])
-        keeps attributing budget per bucket identity.
+        ragged bit sits BEFORE the lora/step_cache/precision axes so the
+        census parser (obs/perf.py census_from_keys) keeps attributing
+        budget per bucket identity.
+
+        ``lora_sig`` (SDTPU_LORA_TRACED): "" or ``lora:r{rb}s{sc}``
+        (models/lora.py TracedSet.sig). Non-empty sigs add a trailing
+        per-row ``[B, slots, ...]`` delta tree as traced data — adapter
+        NAMES, WEIGHTS and exact RANKS never enter this key (sdtpu-lint
+        RC001 fixture ``lora_bad.py``), so one executable per
+        (rank_bucket, slot_count) cell serves every adapter combo and an
+        adapter switch costs zero compiles. Empty sig traces with the
+        unpassed-default ``lora=None``, which folds the delta branches
+        away entirely — the gate-off executable is byte-identical.
 
         Both variants return ``(carry..., fence)`` where ``fence`` is a
         tiny data-dependent output: the host paces progress/interrupt on
@@ -496,7 +536,7 @@ class Engine:
         unet, cn_module = self._modules_for(prec)
         key = ("chunk", sampler_name, steps, width, height, batch, length,
                masked, n_controls, inpaint, self.family.name, ragged,
-               step_cache, prec)
+               lora_sig, step_cache, prec)
         if step_cache:
             assert not ragged, "ragged chunks disable the step cache"
             return self._cached(key, lambda: self._build_stepcache_chunk(
@@ -507,11 +547,12 @@ class Engine:
 
                 def run_chunk(unet_params, carry, start, ctx_u, ctx_c, cfg,
                               image_keys, added_u, added_c, true_rows,
-                              ctx_true_u, ctx_true_c):
+                              ctx_true_u, ctx_true_c, lora=None):
                     denoise = self._make_denoise_fn(
                         unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
                         total_steps=steps, unet=unet, controlnet=cn_module,
-                        ragged=(true_rows, ctx_true_u, ctx_true_c))
+                        ragged=(true_rows, ctx_true_u, ctx_true_c),
+                        lora=lora)
                     base_step = kd.make_sampler_step(
                         spec, denoise, sigmas, image_keys)
                     lat_h = carry.x.shape[1]
@@ -542,12 +583,12 @@ class Engine:
 
             def run_chunk(unet_params, carry, start, ctx_u, ctx_c, cfg,
                           image_keys, added_u, added_c, mask_lat, init_lat,
-                          controls, inpaint_cond):
+                          controls, inpaint_cond, lora=None):
                 denoise = self._make_denoise_fn(
                     unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
                     controls=controls, total_steps=steps,
                     inpaint_cond=inpaint_cond if inpaint else None,
-                    unet=unet, controlnet=cn_module)
+                    unet=unet, controlnet=cn_module, lora=lora)
                 base_step = kd.make_sampler_step(
                     spec, denoise, sigmas, image_keys)
 
@@ -598,8 +639,14 @@ class Engine:
 
         def run_chunk(unet_params, carry, cache, valid, start, ctx_u,
                       ctx_c, cfg, image_keys, added_u, added_c, mask_lat,
-                      init_lat, inpaint_cond, cadence, cfg_stop):
+                      init_lat, inpaint_cond, cadence, cfg_stop,
+                      lora=None):
             params = {"params": unet_params}
+            # traced adapter deltas (models/lora.py): the [B, ...] per-row
+            # tree serves the CFG-truncated cond-only paths; the full
+            # paths run [uncond; cond] rows, so double it like the latent
+            lora2 = (None if lora is None else jax.tree_util.tree_map(
+                lambda a: batch_concat([a, a]), lora))
 
             def prep(x, sigma):
                 c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
@@ -653,12 +700,12 @@ class Engine:
                     def deep_full(_):
                         xi, tb, ctx, added = full_inputs(xin, t)
                         return unet.apply(params, xi, tb, ctx, added,
-                                          cache_mode="deep")
+                                          cache_mode="deep", lora=lora2)
 
                     def deep_trunc(_):
                         xi, tb, ctx, added = cond_inputs(xin, t)
                         d = unet.apply(params, xi, tb, ctx, added,
-                                       cache_mode="deep")
+                                       cache_mode="deep", lora=lora)
                         return batch_concat([d, d])
 
                     return jax.lax.cond(i >= cfg_stop, deep_trunc,
@@ -674,7 +721,8 @@ class Engine:
                         xi, tb, ctx, added = full_inputs(xe, te)
                         out = unet.apply(
                             params, xi, tb, ctx, added,
-                            cache=new_cache, cache_mode="reuse")
+                            cache=new_cache, cache_mode="reuse",
+                            lora=lora2)
                         out_u, out_c = jnp.split(
                             out.astype(jnp.float32), 2, axis=0)
                         return out_u + cfg * (out_c - out_u)
@@ -683,7 +731,8 @@ class Engine:
                         xi, tb, ctx, added = cond_inputs(xe, te)
                         out = unet.apply(
                             params, xi, tb, ctx, added,
-                            cache=new_cache[B:], cache_mode="reuse")
+                            cache=new_cache[B:], cache_mode="reuse",
+                            lora=lora)
                         return out.astype(jnp.float32)
 
                     guided = jax.lax.cond(step_i >= cfg_stop, eval_trunc,
@@ -954,20 +1003,43 @@ class Engine:
 
     # -- LoRA ---------------------------------------------------------------
 
+    def _lora_provider_gen(self) -> int:
+        """The provider's reload generation (ModelRegistry.lora_generation,
+        bumped by /refresh-loras); 0 for plain-callable providers. Folded
+        into the merge latch and the traced-set LRU so a registry rescan
+        retries unresolved names and rebuilds factor sets, while identical
+        repeats stay no-ops."""
+        owner = getattr(self.lora_provider, "__self__", None)
+        return int(getattr(owner, "lora_generation", 0) or 0)
+
     def set_loras(self, specs) -> None:
         """Activate a stack of (name, unet_weight, te_weight) adapters
-        (webui ``<lora:name:w[:te_w]>`` semantics; BASELINE config #4).
-        Re-merges from the pristine base on every change, so removing an
-        adapter is exact, not approximate. If any requested adapter cannot
-        be resolved, the set is NOT latched — the next request retries
-        (covers the add-file-then-/refresh-loras flow)."""
+        (webui ``<lora:name:w[:te_w]>`` semantics; BASELINE config #4) by
+        host merge. Re-merges from the pristine base on every change, so
+        removing an adapter is exact, not approximate. The RESOLVED
+        OUTCOME is latched — skipped names included, keyed by the
+        provider's reload generation — so an identical repeat of a
+        partially-resolved set is a no-op instead of a full re-merge;
+        /refresh-loras bumps the generation and the next request retries
+        (covers the add-file-then-refresh flow without the old
+        merge-per-request tax)."""
         from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
 
         key = tuple(specs)
-        if key == self._active_loras:
+        gen = self._lora_provider_gen()
+        if self._active_loras == () and not key:
+            return  # pristine engine, empty request: nothing to undo
+        if self._active_loras == (key, gen):
+            return
+        if not key and self._active_loras[0] == ():
+            # already pristine, older provider generation — a rescan
+            # can't change "no adapters"; refresh the latch, skip the
+            # no-op merge and the cache-retiring epoch bumps
+            self._active_loras = ((), gen)
             return
         params = self._base_params
-        all_resolved = True
+        merged = 0
+        t0 = time.perf_counter()
         for name, weight, te_weight in specs:
             sd = self.lora_provider(name) if self.lora_provider else None
             if sd is None:
@@ -976,30 +1048,124 @@ class Engine:
                 )
 
                 get_logger().warning("lora '%s' not found; skipping", name)
-                all_resolved = False
                 continue
             params, applied, skipped = lora_mod.merge_lora(
                 params, sd, weight, self.family, te_weight=te_weight)
+            merged += 1
         self.params = params
-        # An unresolved set latches the truthy _UNRESOLVED sentinel (never
-        # equal to a spec tuple): the next request — even one with no lora
-        # tags — always re-merges from _base_params, so a partial merge can
-        # never leak into later images.
-        self._active_loras = key if all_resolved else _UNRESOLVED
+        self._active_loras = (key, gen)
+        if merged:
+            from stable_diffusion_webui_distributed_tpu.obs import (
+                prometheus as obs_prom,
+            )
+
+            self._lora_merge_total += merged
+            self._lora_merge_seconds += time.perf_counter() - t0
+            obs_prom.count_lora_switch("merged")
         # TE weights changed: conds computed under the old merge are stale
         self._cond_epoch += 1
         self._cond_cache.clear()
         self._model_epoch += 1
 
+    def _traced_set_for(self, specs: Tuple):
+        """TracedSet for a spec tuple under SDTPU_LORA_TRACED, or None
+        when the set can't ride the bucketing ladder (the caller then
+        falls back to the merge path). LRU-cached per (specs, provider
+        generation); a hit revalidates each adapter's state-dict IDENTITY
+        against the provider, so the registry's mtime invalidation (an
+        edited file reloads to a NEW dict) can never serve stale
+        factors."""
+        from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        key = (tuple(specs), self._lora_provider_gen())
+        ts = self._traced_cache.get(key)
+        if ts is not None:
+            if self.lora_provider is not None and all(
+                    self.lora_provider(name) is src
+                    for (name, _w, _tw), src in zip(ts.specs, ts.srcs)):
+                self._traced_cache.move_to_end(key)
+                return ts
+            del self._traced_cache[key]
+        t0 = time.perf_counter()
+        ts = lora_mod.build_traced_set(
+            specs, self.lora_provider, self.family, self._base_params)
+        obs_prom.observe_lora_apply(time.perf_counter() - t0)
+        if ts is None:
+            return None
+        self._traced_cache[key] = ts
+        if len(self._traced_cache) > self._TRACED_CACHE_MAX:
+            self._traced_cache.popitem(last=False)
+        return ts
+
+    def traced_te_content(self) -> str:
+        """Content address of the ACTIVE traced set's text-encoder deltas,
+        "" when no traced set is live or none of its factors touch the TE.
+        cache/embed.py folds it into conditioning keys: a traced TE
+        adapter can't alias the adapterless entry, while unet-only sets
+        leave keys — and the embed cache — untouched across switches."""
+        ts = self._traced_lora
+        return ts.te_content if ts is not None and ts.te_content else ""
+
+    def traced_content_for_payload(self, payload) -> str:
+        """Content address of the traced set this payload WOULD serve
+        under, resolvable before _apply_prompt_loras runs — the
+        dispatcher folds it into result-dedupe keys at submit time. "" on
+        the merged path (those keys already fold _model_epoch)."""
+        from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+
+        if not lora_mod.traced_enabled():
+            return ""
+        _, tags = lora_mod.extract_lora_tags(payload.prompt)
+        if not tags or kd.resolve_sampler(payload.sampler_name).adaptive:
+            return ""
+        ts = self._traced_set_for(tuple(tags))
+        return ts.content if ts is not None else ""
+
     def _apply_prompt_loras(self, payload: GenerationPayload) -> None:
         """Activate adapters named in the prompt. The payload keeps its tags
         — infotext/result prompts must round-trip them (webui convention);
-        only tokenization strips them (see encode_prompts)."""
-        from stable_diffusion_webui_distributed_tpu.models.lora import (
-            extract_lora_tags,
-        )
+        only tokenization strips them (see encode_prompts).
 
-        _, tags = extract_lora_tags(payload.prompt)
+        Under SDTPU_LORA_TRACED the tags resolve to a TracedSet instead
+        of a host merge: factors ride as jit arguments, the param tree
+        stays pristine, and NO epoch bumps (cache keys fold the set's
+        content address instead). Sets the ladder can't bucket — and the
+        DPM-adaptive sampler, whose attempt executable carries no delta
+        arguments — fall back to the merged path unchanged."""
+        from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+
+        _, tags = lora_mod.extract_lora_tags(payload.prompt)
+        if lora_mod.traced_enabled() and not kd.resolve_sampler(
+                payload.sampler_name).adaptive:
+            ts = self._traced_set_for(tuple(tags)) if tags else None
+            if ts is None and not tags:
+                # warmup sweep: an all-zero stand-in set at an explicit
+                # ladder cell pre-builds that cell's executables without
+                # needing a real adapter on disk (serving/warmup.py)
+                cell = getattr(self, "_warmup_lora", None)
+                if cell is not None:
+                    ts = lora_mod.zero_set(
+                        self._base_params, self.family, *cell)
+            if ts is not None or not tags:
+                if self._active_loras:
+                    # an earlier merged set is live on self.params —
+                    # restore the pristine tree the traced deltas assume
+                    self.set_loras(())
+                changed = (ts.content if ts is not None else None) != \
+                    (self._traced_lora.content
+                     if self._traced_lora is not None else None)
+                self._traced_lora = ts
+                if changed and ts is not None:
+                    from stable_diffusion_webui_distributed_tpu.obs import (
+                        prometheus as obs_prom,
+                    )
+
+                    obs_prom.count_lora_switch("traced")
+                return
+        self._traced_lora = None
         if tags or self._active_loras:
             self.set_loras(tags)
 
@@ -1162,7 +1328,13 @@ class Engine:
         if self.family.text_encoder_2 is not None:
             depth = min(depth, self.family.text_encoder_2.num_layers)
         skip = min(12, depth - 1, max(0, int(payload.clip_skip or 0)))
-        enc = self._encode_fn()
+        # traced TE adapters (SDTPU_LORA_TRACED): only sets whose factors
+        # actually touch a text tower route to the sig'd encode variant —
+        # unet-only sets keep the adapterless executable AND its cached
+        # conditioning (unchanged by construction) across the switch
+        ts = self._traced_lora
+        te_sig = ts.sig if ts is not None and ts.te_content else ""
+        enc = self._encode_fn(te_sig)
         te = self.params["text_encoder"]
         te2 = self.params["text_encoder_2"]
         store_gen = (self.embedding_store.generation
@@ -1184,8 +1356,12 @@ class Engine:
 
         def encode_fresh(ids_c, w_c, inj_c, n_enc):
             pi, wi = pad_chunks(ids_c, w_c, n_enc, eos, bos)
-            return enc(te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
-                       *inj_arrays(inj_c, n_enc))
+            args = (te, te2, jnp.asarray(pi), jnp.asarray(wi), skip,
+                    *inj_arrays(inj_c, n_enc))
+            if te_sig:
+                return enc(*args, te_lora=ts.tree.get("text_encoder"),
+                           te2_lora=ts.tree.get("text_encoder_2"))
+            return enc(*args)
 
         def cached_enc(raw, ids_c, w_c, inj_c, negative=False, n_enc=None):
             # cross-request cache (webui's cached_c/uc): same text at the
@@ -1198,7 +1374,8 @@ class Engine:
                 return embed_cache.lookup_or_encode(
                     self, raw, skip, n_enc, negative,
                     lambda: encode_fresh(ids_c, w_c, inj_c, n_enc))
-            key = (raw, skip, n_enc, self._cond_epoch, store_gen)
+            key = (raw, skip, n_enc, self._cond_epoch, store_gen,
+                   self.traced_te_content())
             hit = self._cond_cache.get(key)
             if hit is not None:
                 self._cond_cache.move_to_end(key)
@@ -1494,7 +1671,8 @@ class Engine:
     def _denoise_range(self, payload, x, image_keys, conds, pooleds,
                        width, height, start_step, steps, job,
                        mask_lat, init_lat, controls=(), end_step=None,
-                       inpaint_cond=None, sync=True, ragged=None):
+                       inpaint_cond=None, sync=True, ragged=None,
+                       lora=None):
         """Obs-span wrapper around the chunk loop: one ``denoise_range``
         span (host-side perf_counter, no extra device sync) grouping the
         per-chunk ``denoise_chunk`` leaf spans StageStats feeds in."""
@@ -1508,12 +1686,13 @@ class Engine:
             return self._denoise_range_timed(
                 payload, x, image_keys, conds, pooleds, width, height,
                 start_step, steps, job, mask_lat, init_lat, controls,
-                end_step, inpaint_cond, sync, ragged)
+                end_step, inpaint_cond, sync, ragged, lora)
 
     def _denoise_range_timed(self, payload, x, image_keys, conds, pooleds,
                              width, height, start_step, steps, job,
                              mask_lat, init_lat, controls=(), end_step=None,
-                             inpaint_cond=None, sync=True, ragged=None):
+                             inpaint_cond=None, sync=True, ragged=None,
+                             lora=None):
         """Host-side chunk loop with interrupt/progress between dispatches
         (compiled-loop version of the reference's 0.5 s poll,
         worker.py:440-448). ``steps`` sizes the sigma ladder; the loop runs
@@ -1529,8 +1708,19 @@ class Engine:
         int32 vectors (serving/dispatcher.py ragged mode). Routes every
         chunk to the ragged executable variant; the step cache and prefix
         sharing are disabled for ragged ranges (their carries assume the
-        dense row layout end to end)."""
+        dense row layout end to end).
+
+        ``lora``: ``(sig, content, rows_tree)`` — the traced adapter
+        triple (models/lora.py): static sig for the chunk key, content
+        address for the prefix key, per-row [B, slots, ...] UNet delta
+        tree as traced data. None (the default) adopts the engine's
+        active traced set (_apply_prompt_loras), broadcast over this
+        range's batch — the dispatcher passes an explicit stacked triple
+        for heterogeneous coalesced groups."""
         if kd.resolve_sampler(payload.sampler_name).adaptive:
+            # the adaptive attempt executable carries no delta args;
+            # _apply_prompt_loras routes adaptive requests to the merged
+            # path, so no traced set can be live here
             return self._denoise_adaptive(
                 payload, x, image_keys, conds, pooleds, width, height,
                 start_step, steps, job, mask_lat, init_lat, controls,
@@ -1538,6 +1728,16 @@ class Engine:
         (ctx_u, ctx_c) = conds
         au, ac = self._added_cond(*pooleds, width, height)
         batch = x.shape[0]
+        if lora is None and self._traced_lora is not None:
+            from stable_diffusion_webui_distributed_tpu.models import (
+                lora as lora_mod,
+            )
+
+            ts = self._traced_lora
+            lora = (ts.sig, ts.content,
+                    lora_mod.broadcast_set(ts, batch)["unet"])
+        lora_sig, lora_content, lora_rows = lora or ("", "", None)
+        lora_kw = {} if lora_rows is None else {"lora": lora_rows}
         cfg = jnp.float32(payload.cfg_scale)
         masked = mask_lat is not None
         mask_arg = mask_lat if masked else jnp.float32(0)
@@ -1609,7 +1809,7 @@ class Engine:
                     steps=steps, end=end,
                     cadence=(sc.cadence if use_cache else 1),
                     sc_active=use_cache, precision=prec.name,
-                    cfg_stop=cfg_stop)
+                    cfg_stop=cfg_stop, lora=lora_content)
 
         self.state.begin(job, end - start_step)
         done = 0
@@ -1690,7 +1890,8 @@ class Engine:
                                 n_controls=len(active), inpaint=inpainting,
                                 ragged=ragged is not None,
                                 step_cache=cached_chunk,
-                                precision=prec.name)
+                                precision=prec.name,
+                                lora_sig=lora_sig)
             with trace.STATS.timer("denoise_chunk"), \
                     trace.annotate(f"denoise[{pos}:{pos + length}]"):
                 if ragged is not None:
@@ -1698,18 +1899,19 @@ class Engine:
                     carry, fence = fn(
                         self.params["unet"], carry, jnp.int32(pos), ctx_u,
                         ctx_c, cfg, image_keys, au, ac, true_rows,
-                        ctx_true_u, ctx_true_c)
+                        ctx_true_u, ctx_true_c, **lora_kw)
                 elif cached_chunk:
                     carry, cache, valid, fence = fn(
                         self.params["unet"], carry, cache, valid,
                         jnp.int32(pos), ctx_u, ctx_c, cfg, image_keys,
                         au, ac, mask_arg, init_arg, inp_arg,
-                        jnp.int32(sc.cadence), jnp.int32(cfg_stop))
+                        jnp.int32(sc.cadence), jnp.int32(cfg_stop),
+                        **lora_kw)
                 else:
                     carry, fence = fn(
                         self.params["unet"], carry, jnp.int32(pos), ctx_u,
                         ctx_c, cfg, image_keys, au, ac, mask_arg, init_arg,
-                        active, inp_arg)
+                        active, inp_arg, **lora_kw)
                     if valid is not None:
                         # a plain (CN-active) chunk advanced the latent
                         # outside the cache's view — refresh on re-entry

@@ -49,11 +49,29 @@ class ModelRegistry:
         self.chunk_size = chunk_size
         self.state = state
         self.mesh = mesh
+        from stable_diffusion_webui_distributed_tpu.cache.store import (
+            BoundedStore,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            env_float,
+        )
+
         self._paths: Dict[str, str] = {}
         self._lora_paths: Dict[str, str] = {}
         self._controlnet_paths: Dict[str, str] = {}
         self._controlnet_cache: Dict[tuple, Dict] = {}
-        self._lora_cache: Dict[str, Dict] = {}
+        # byte-capped LRU over loaded adapter state dicts (entries are
+        # (file mtime, sd) pairs; a stale mtime reloads from disk, so an
+        # adapter edited in place is never served stale after
+        # /refresh-loras). SDTPU_LORA_CACHE_MB caps resident bytes —
+        # adapter-diverse traffic can name hundreds of files.
+        self._lora_cache = BoundedStore(
+            "lora", int(env_float("SDTPU_LORA_CACHE_MB", 256.0) * 1e6))
+        #: reload generation: bumped by every refresh() so engines can
+        #: key merge latches and traced-set LRUs on it — an identical
+        #: request repeated across a rescan retries its unresolved names
+        #: exactly once instead of never (or every time)
+        self.lora_generation = 0
         self._vae_paths: Dict[str, str] = {}
         self._vae_cache: Dict[tuple, Dict] = {}
         self._upscaler_paths: Dict[str, str] = {}
@@ -134,6 +152,7 @@ class ModelRegistry:
         self._controlnet_cache.clear()
         self._lora_cache.clear()
         self._vae_cache.clear()
+        self.lora_generation += 1
         return found
 
     def available_loras(self) -> Dict[str, str]:
@@ -351,17 +370,27 @@ class ModelRegistry:
         return params
 
     def lora_provider(self, name: str):
-        """Load a LoRA state dict by name, cached until the next refresh
-        (engine callback for the ``<lora:...>`` prompt syntax)."""
-        if name in self._lora_cache:
-            return self._lora_cache[name]
+        """Load a LoRA state dict by name (engine callback for the
+        ``<lora:...>`` prompt syntax).
+
+        Entries live in a byte-capped LRU tagged with the source file's
+        mtime; a hit whose file changed on disk since load reloads to a
+        NEW dict object, so engines holding identity-keyed traced sets
+        (``ts.srcs``) see the swap and rebuild instead of serving the
+        stale factors.
+        """
         path = self._lora_paths.get(name)
         if path is None:
             return None
+        mtime = _mtime_or_none(path)
+        hit = self._lora_cache.get(name)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
         from stable_diffusion_webui_distributed_tpu.models.lora import load_lora
 
         sd = load_lora(path)
-        self._lora_cache[name] = sd
+        nbytes = sum(int(getattr(v, "nbytes", 0) or 0) for v in sd.values())
+        self._lora_cache.put(name, (mtime, sd), nbytes)
         return sd
 
     def available(self) -> Dict[str, str]:

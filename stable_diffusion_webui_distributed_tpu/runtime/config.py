@@ -72,6 +72,33 @@ def _logger():
 #   only): extra precision rungs the AOT warmup sweep pre-builds per
 #   bucket (serving/warmup.py) — precision is a static compile-key axis.
 #
+# Traced-LoRA knobs (models/lora.py; README "Recompile-free LoRA"):
+#
+# - ``SDTPU_LORA_TRACED`` (flag, default off): serve LoRA adapters as
+#   TRACED jit arguments instead of host-merging them into the param
+#   tree. Adapter up/down factors are padded onto a static
+#   (rank-bucket, slot-count) ladder and applied as ``W x + s·up(down x)``
+#   at each Dense site, so switching adapters changes only array
+#   CONTENTS — zero recompiles, zero cache purges (embed/result/prefix
+#   keys fold the set's content address instead of a model epoch). Off
+#   (the default), the merged path runs byte-identical to the pre-knob
+#   build; adaptive samplers and un-bucketable sets fall back to it
+#   even when on.
+# - ``SDTPU_LORA_RANKS`` (comma int list, default "8,16,32,64"): the
+#   rank-bucket ladder. Adapter ranks pad UP onto it; each distinct
+#   bucket is one executable variant per shape bucket.
+# - ``SDTPU_LORA_SLOTS`` (comma int list, default "1,2,4"): the
+#   adapter-slot ladder — how many simultaneous adapters a traced set
+#   can stack per request before falling back to the merge path.
+# - ``SDTPU_LORA_CACHE_MB`` (float MB, default 256): byte cap on the
+#   registry's loaded-adapter LRU (pipeline/registry.py); entries are
+#   mtime-validated so an adapter edited on disk reloads instead of
+#   serving stale.
+# - ``SDTPU_WARMUP_LORA`` (comma ``rXsY`` list, default "" = none):
+#   traced-LoRA ladder cells the AOT warmup sweep pre-builds with
+#   all-zero stand-in sets (serving/warmup.py) — every real adapter
+#   bucketed into a warmed cell shares its executables.
+#
 # Ragged-dispatch knobs (serving/bucketer.py, ops/ragged_attention.py;
 # README "Ragged dispatch"):
 #

@@ -221,9 +221,14 @@ class ServingDispatcher:
 
             if _cache_pkg.enabled():
                 cache_mod = _cache_pkg
+                # traced-adapter content rides the key (it is resolvable
+                # BEFORE _apply_prompt_loras runs); "" on the merged path,
+                # where model_fingerprint's _model_epoch already moves
+                lora_fn = getattr(self.engine, "traced_content_for_payload",
+                                  None)
                 ckey = _cache_pkg.keys.result_key(
                     payload, _cache_pkg.keys.model_fingerprint(self.engine),
-                    job)
+                    job, lora=lora_fn(payload) if lora_fn else "")
                 role, cached, flight = cache_mod.result_acquire(ckey)
                 if cached is not None:
                     if jr_on:
@@ -446,18 +451,56 @@ class ServingDispatcher:
             gate.release(entry)
 
     def _preempt_safe(self, p) -> bool:
-        """May this payload yield mid-denoise?  LoRA-tagged work cannot —
+        """May this payload yield mid-denoise?  MERGED LoRA work cannot —
         an interloper's tagless run restores pristine params under it —
-        and adaptive samplers drive a separate loop without the hook."""
+        but a traced set (SDTPU_LORA_TRACED) rides as jit arguments and
+        never touches the param tree, so nothing an interloper does can
+        corrupt it and resume re-installs the set without a re-merge.
+        Adaptive samplers drive a separate loop without the hook."""
         from stable_diffusion_webui_distributed_tpu.samplers import (
             kdiffusion as kd,
         )
 
-        if "<lora:" in (p.prompt or ""):
+        if "<lora:" in (p.prompt or "") and self._traced_rowspec(p) is None:
             return False
         return not kd.resolve_sampler(p.sampler_name).adaptive
 
     # -- grouping ----------------------------------------------------------
+
+    def _traced_rowspec(self, p):
+        """Traced-LoRA row cell for a payload: ``(0, 0)`` for tagless
+        rows, the ``(rank_bucket, slot_count)`` cell its TracedSet
+        occupies when SDTPU_LORA_TRACED serves the tags, and ``None``
+        when the tags must take the merged path (gate off, adaptive
+        sampler, or a set the bucketing ladder can't hold). The cell is
+        the ONLY adapter fact the group key needs: every set in one cell
+        runs the same chunk executable, so heterogeneous adapter combos
+        coalesce row-wise (stack_row_sets) — the direct unlock ISSUE 16
+        names for adapter-diverse traffic.
+
+        Tolerates ``self`` being None / engineless — tests call
+        ``_group_key`` unbound, and ETA probes have no engine."""
+        from stable_diffusion_webui_distributed_tpu.models import (
+            lora as lora_mod,
+        )
+        from stable_diffusion_webui_distributed_tpu.samplers import (
+            kdiffusion as kd,
+        )
+
+        if "<lora:" not in (p.prompt or ""):
+            return (0, 0)
+        if not lora_mod.traced_enabled():
+            return None
+        _, tags = lora_mod.extract_lora_tags(p.prompt or "")
+        if not tags:
+            return (0, 0)
+        if kd.resolve_sampler(p.sampler_name).adaptive:
+            return None
+        engine = getattr(self, "engine", None)
+        if engine is None or not hasattr(engine, "_traced_set_for"):
+            return None
+        ts = engine._traced_set_for(tuple(tags))
+        return None if ts is None else (ts.rank_bucket, ts.slots)
 
     def _coalescable(self, p) -> bool:
         from stable_diffusion_webui_distributed_tpu.samplers import (
@@ -468,7 +511,10 @@ class ServingDispatcher:
             return False
         if p.refiner_checkpoint and p.refiner_switch_at < 1.0:
             return False
-        if "<lora:" in (p.prompt or ""):
+        if "<lora:" in (p.prompt or "") and self._traced_rowspec(p) is None:
+            # merged-path adapters mutate engine params per request and
+            # can never share a dispatch; traced sets ride as per-row jit
+            # arguments and coalesce within their (rank, slots) cell
             return False
         if kd.resolve_sampler(p.sampler_name).adaptive:
             return False
@@ -519,12 +565,19 @@ class ServingDispatcher:
         # The resolved precision name is the LAST axis (consumers read
         # key[-1]): int8 and bf16 requests coalesce separately — a merged
         # batch runs one chunk executable, and precision is static in it.
+        # The traced-LoRA cell (rank_bucket, slot_count) sits at
+        # key[-3:-1]: (0, 0) for tagless rows, so adapterless grouping is
+        # untouched, while any two adapter combos in one cell share a
+        # group — the adapter NAMES never enter the key (they are traced
+        # inputs, not executable identity).
         sc = stepcache.resolve(run)
+        rs = ServingDispatcher._traced_rowspec(self, run) or (0, 0)
         return ("txt2img", run.sampler_name, int(run.steps),
                 int(run.width), int(run.height), float(run.cfg_scale),
                 run.negative_prompt or "", int(run.clip_skip or 0),
                 sc.cadence, sc.cutoff_sigma,
                 bool((run.override_settings or {}).get("ragged_true_wh")),
+                int(rs[0]), int(rs[1]),
                 ServingDispatcher._precision_name(self, run))
 
     def _dispatch_eta(self, run, batch_size: int) -> Optional[float]:
@@ -584,6 +637,11 @@ class ServingDispatcher:
             start_perf = time.perf_counter()
             leader_req = obs_spans.current()
             jr_on = obs_journal.enabled()
+            # adapter cell label for spans/journal/ledger; only attached
+            # when the group actually runs traced adapters, so the
+            # adapterless record stream is field-identical to before
+            lora_cell = {} if not (g.key[-3] or g.key[-2]) else \
+                {"lora": f"r{g.key[-3]}s{g.key[-2]}"}
             for t in g.tickets:
                 if t.cancelled.is_set():
                     # never dispatched: its wait must not feed the
@@ -600,7 +658,7 @@ class ServingDispatcher:
                 if jr_on:
                     obs_journal.emit("dispatched", t.request_id,
                                      group=len(g.tickets),
-                                     precision=str(g.key[-1]))
+                                     precision=str(g.key[-1]), **lora_cell)
             dsp = None
             wd = obs_watchdog.arm(
                 g.tickets[0].request_id, "dispatch.device",
@@ -610,7 +668,8 @@ class ServingDispatcher:
                 # recorder shows which precision a failed request ran at
                 with obs_spans.span("dispatch.device",
                                     requests=len(g.tickets),
-                                    precision=g.key[-1]) as dsp:
+                                    precision=g.key[-1],
+                                    **lora_cell) as dsp:
                     self._execute_group(g)
             except BaseException as e:  # noqa: BLE001 — delivered per ticket
                 for t in g.tickets:
@@ -707,9 +766,12 @@ class ServingDispatcher:
                 prec = self._precision_name(ticket.run)
                 METRICS.record_dispatch(1, precision=prec)
                 obs_prom.count_precision(prec, 1)
+                rs = self._traced_rowspec(ticket.run)
+                lora_cell = {"lora": f"r{rs[0]}s{rs[1]}"} \
+                    if rs and rs != (0, 0) else {}
                 if obs_journal.enabled():
                     obs_journal.emit("dispatched", ticket.request_id,
-                                     group=1, precision=prec)
+                                     group=1, precision=prec, **lora_cell)
                 # perf ledger (SDTPU_PERF): same passive attribution as
                 # the grouped path — no-op with the knob off
                 perf_on = obs_perf.enabled()
@@ -722,7 +784,7 @@ class ServingDispatcher:
                                        ticket.run.total_images))
                 try:
                     with obs_spans.span("dispatch.device", requests=1,
-                                        precision=prec):
+                                        precision=prec, **lora_cell):
                         result = self.engine.generate_range(
                             ticket.run, 0, None, ticket.job)
                 finally:
@@ -760,6 +822,8 @@ class ServingDispatcher:
                         bucket=f"{ticket.run.width}x{ticket.run.height}",
                         cadence=int(stepcache.resolve(ticket.run).cadence),
                         precision=prec,
+                        lora=(f"r{rs[0]}s{rs[1]}"
+                              if rs and rs != (0, 0) else ""),
                         device_s=time.perf_counter() - t0_dev,
                         flops=METRICS.unet_flops_snapshot() - flops0,
                         requests=1, batch_raw=n_img, batch_run=n_run,
@@ -814,7 +878,21 @@ class ServingDispatcher:
 
         engine.state.begin_request()
         engine._adaptive_incomplete = False
-        engine._apply_prompt_loras(rp)  # tagless: restores pristine params
+        # tagless groups: restores pristine params; traced groups
+        # (non-zero cell in the key): restores pristine params too — the
+        # deltas ride as jit arguments, installed per member below
+        engine._apply_prompt_loras(rp)
+        # traced-LoRA cell from the group key (key[-3:-1]): every member
+        # carries SOME adapter set in this (rank_bucket, slot_count) cell,
+        # possibly a different one per member — each row gets its own
+        # factor stack and one executable serves them all
+        lora_rb, lora_sc = int(g.key[-3]), int(g.key[-2])
+        traced_group = bool(lora_rb or lora_sc)
+        row_sets = []
+        if traced_group:
+            from stable_diffusion_webui_distributed_tpu.models import (
+                lora as lora_mod,
+            )
 
         # context length pinned to the group max so every merged request
         # pads its conditioning identically (same contract the fleet pins
@@ -839,6 +917,19 @@ class ServingDispatcher:
             p.context_chunks = chunks
             n_p = p.total_images
             counts.append(n_p)
+            if traced_group:
+                # install THIS member's set before its encode so its TE
+                # deltas (and the content-addressed cond-cache key) apply
+                # to its own conditioning rows
+                _, tags = lora_mod.extract_lora_tags(p.prompt or "")
+                ts = engine._traced_set_for(tuple(tags))
+                if ts is None:
+                    # registry changed between grouping and execution
+                    raise RuntimeError(
+                        f"traced LoRA set for {tags!r} no longer "
+                        f"resolvable at dispatch")
+                engine._traced_lora = ts
+                row_sets += [ts] * n_p
             if ragged_mode:
                 tw, th = engine._ragged_plan(p) or (width, height)
                 tr = min(h, -(-th // f))
@@ -899,6 +990,18 @@ class ServingDispatcher:
             ragged_arg = (jnp.asarray(true_rows_l, jnp.int32),
                           jnp.asarray(ctx_true_u_l, jnp.int32),
                           jnp.asarray(ctx_true_c_l, jnp.int32))
+        lora_arg = None
+        if traced_group:
+            # per-row factor stack (pad rows repeat the last member's set,
+            # matching the pad-and-drop image rows); content joins each
+            # DISTINCT member content so prefix capture can't alias across
+            # adapter combos
+            uniq: List[str] = []
+            for ts in row_sets:
+                if ts.content not in uniq:
+                    uniq.append(ts.content)
+            lora_arg = (row_sets[0].sig, "|".join(uniq),
+                        lora_mod.stack_row_sets(row_sets, b_run)["unet"])
 
         x = engine._place_batch(noise.astype(jnp.float32) * sigmas[0])
         # perf ledger (SDTPU_PERF): host-observed denoise seconds joined
@@ -912,7 +1015,7 @@ class ServingDispatcher:
         latents = engine._denoise_range(
             rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
             width, height, 0, rp.steps, "txt2img", None, None, (),
-            ragged=ragged_arg)
+            ragged=ragged_arg, lora=lora_arg)
         self._drain_cache_notes(live[0].request_id, embed=False)
         if perf_on:
             # masked pixels: resident tail rows the ragged kernel skips —
@@ -924,6 +1027,7 @@ class ServingDispatcher:
             obs_perf.LEDGER.record_dispatch(
                 bucket=f"{width}x{height}", cadence=int(g.key[8]),
                 precision=str(g.key[-1]),
+                lora=(f"r{lora_rb}s{lora_sc}" if traced_group else ""),
                 device_s=time.perf_counter() - t0_dev,
                 flops=METRICS.unet_flops_snapshot() - flops0,
                 requests=len(live), batch_raw=b_raw, batch_run=b_run,

@@ -19,10 +19,16 @@ default only) adds serving-precision rungs to the sweep — e.g.
 ``bf16,int8`` pre-builds the int8 ladder too, so the first fleet-degraded
 or user-requested int8 request dispatches instead of compiling
 (pipeline/precision.py; precision is a static compile-key axis).
+``SDTPU_WARMUP_LORA`` (comma-separated ``rXsY`` cells, default "" =
+none) adds traced-LoRA ladder cells — e.g. ``r16s1,r32s2`` pre-builds
+the executables every adapter bucketed into those cells will share
+(models/lora.py ladder; under SDTPU_LORA_TRACED adapter CONTENT is a
+jit argument, so one all-zero stand-in set per cell covers all of them).
 """
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Dict, List, Optional
 
@@ -55,6 +61,36 @@ def _warmup_precisions() -> List[str]:
     return out or [""]
 
 
+def _warmup_lora_cells() -> List[Optional[tuple]]:
+    """Traced-LoRA ladder cells to sweep, parsed from SDTPU_WARMUP_LORA
+    ("r16s1,r32s2" → [(16, 1), (32, 2)]); None = the adapterless point.
+    Cells are bucketed onto the configured ladders, so "r10s3" warms the
+    (16, 4) executables a rank-10, 3-adapter request would dispatch to.
+    Ignored (adapterless only) unless SDTPU_LORA_TRACED is on — the
+    merged path shares the adapterless executables."""
+    from stable_diffusion_webui_distributed_tpu.models import lora as lora_mod
+
+    raw = env_str("SDTPU_WARMUP_LORA", "")
+    if not raw.strip() or not lora_mod.traced_enabled():
+        return [None]
+    out: List[Optional[tuple]] = [None]
+    for part in raw.split(","):
+        part = part.strip().lower()
+        if not part:
+            continue
+        m = re.fullmatch(r"r(\d+)s(\d+)", part)
+        if m is None:
+            continue
+        rb = lora_mod.bucket_rank(int(m.group(1)))
+        sc = lora_mod.bucket_slots(int(m.group(2)))
+        if rb is None or sc is None:
+            continue
+        cell = (rb, sc)
+        if cell not in out:
+            out.append(cell)
+    return out
+
+
 def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
                   steps: Optional[int] = None,
                   sampler: Optional[str] = None,
@@ -78,20 +114,31 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
     sampler = sampler or env_str("SDTPU_WARMUP_SAMPLER", "Euler a")
 
     precisions = _warmup_precisions()
+    lora_cells = _warmup_lora_cells()
     before = dict(METRICS.summary()["compiles"])
     t0 = time.monotonic()
     warmed = []
-    for bw, bh in bucketer.shapes:
-        for nb in bucketer.batches:
-            for prec in precisions:
-                payload = GenerationPayload(
-                    prompt="", steps=steps, width=bw, height=bh,
-                    batch_size=nb, sampler_name=sampler, seed=0,
-                    precision=prec)
-                engine.state.begin_request()
-                engine.generate_range(payload, 0, None, "warmup")
-                warmed.append((bw, bh, nb) if prec == ""
-                              else (bw, bh, nb, prec))
+    try:
+        for bw, bh in bucketer.shapes:
+            for nb in bucketer.batches:
+                for prec in precisions:
+                    for cell in lora_cells:
+                        engine._warmup_lora = cell
+                        payload = GenerationPayload(
+                            prompt="", steps=steps, width=bw, height=bh,
+                            batch_size=nb, sampler_name=sampler, seed=0,
+                            precision=prec)
+                        engine.state.begin_request()
+                        engine.generate_range(payload, 0, None, "warmup")
+                        point = [bw, bh, nb]
+                        if prec != "":
+                            point.append(prec)
+                        if cell is not None:
+                            point.append("r%ds%d" % cell)
+                        warmed.append(tuple(point))
+    finally:
+        engine._warmup_lora = None
+        engine._traced_lora = None
     after = METRICS.summary()["compiles"]
     built = {k: after.get(k, 0) - before.get(k, 0)
              for k in after if after.get(k, 0) != before.get(k, 0)}
@@ -101,6 +148,7 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
         "steps": steps,
         "sampler": sampler,
         "precisions": precisions,
+        "lora_cells": ["r%ds%d" % c for c in lora_cells if c is not None],
         "stage_builds": built,
         "xla_cache_dir": active_cache,
         "wall_s": round(time.monotonic() - t0, 2),

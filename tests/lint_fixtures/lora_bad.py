@@ -1,0 +1,29 @@
+"""Known-bad traced-LoRA fixture (RC001).
+
+Adapter identity must never shape an executable: under traced serving
+(SDTPU_LORA_TRACED) the rank/slot pair is quantized onto the static
+ladder (models/lora.py bucket_rank / bucket_slots) and the factor
+CONTENTS travel as jit arguments. A request-derived adapter rank pinned
+as a jit STATIC argument mints one executable per distinct adapter —
+the recompile storm the ladder exists to kill. The ladder-bucketed
+variant below must stay clean.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import jax
+import jax.numpy as jnp
+
+from stable_diffusion_webui_distributed_tpu.models.lora import bucket_rank
+
+
+def apply_bad(payload):
+    fn = jax.jit(lambda x, rank: x * rank, static_argnums=(1,))
+    rank = payload.lora_rank
+    return fn(jnp.zeros(4), rank)  # RC001: raw adapter rank as static
+
+
+def apply_clean(payload):
+    fn = jax.jit(lambda x, rank: x * rank, static_argnums=(1,))
+    rank = bucket_rank(payload.lora_rank)
+    return fn(jnp.zeros(4), rank)  # clean: ladder-quantized

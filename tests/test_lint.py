@@ -134,6 +134,14 @@ class TestFixtures:
             ("RC003", 24),  # raw payload.precision attribute read
         }
 
+    def test_lora_family(self):
+        # the traced-LoRA ladder discipline: a request-derived adapter
+        # rank pinned as a jit static mints one executable per adapter
+        # (the recompile storm SDTPU_LORA_TRACED exists to kill); the
+        # bucket_rank-quantized variant in the same fixture stays clean
+        found = _rule_lines(_fixture_findings("lora_bad.py"))
+        assert found == {("RC001", 23)}
+
     def test_timing_family(self):
         # OB001 is path-scoped: load the fixture under a spoofed serving/
         # rel path so the wall-clock duration reads fire

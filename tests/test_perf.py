@@ -203,7 +203,7 @@ class TestCensus:
         census = perf.executables_census(engine)
         assert census["alarm"] is False and census["over_budget"] == []
         assert census["budget"] == {"step_cache": 2, "precision": 3,
-                                    "per_bucket": 6}
+                                    "lora": 4, "per_bucket": 6}
         (row,) = [r for r in census["buckets"]
                   if r["bucket"] == "Euler a/4st 48x48 b2"]
         assert row["executables"] == 3

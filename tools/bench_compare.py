@@ -64,6 +64,14 @@ THRESHOLDS = {
     # resume point moved
     "embed_cache_hit_rate": ("down", "abs", 0.05),
     "result_dedupe_hit_rate": ("down", "abs", 0.05),
+    # lora rows (bench.py run_lora): recompile-free serving is the whole
+    # contract — ANY chunk compile or host merge during the traced churn
+    # phase means adapter identity leaked back into a compile key or the
+    # merge path re-engaged; the embed cache surviving switches is what
+    # distinguishes content-addressed keys from epoch bumps
+    "lora_traced_chunk_compiles": ("up", "abs", 0.0),
+    "lora_traced_merges": ("up", "abs", 0.0),
+    "lora_embed_hit_rate": ("down", "abs", 0.05),
     "prefix_flops_reduction_pct": ("down", "abs", 5.0),
     # scenario rows (bench.py run_scenarios): requeue_recovery_rate and
     # slo_attainment above gate these too; per-scenario worst-class p95
