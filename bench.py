@@ -2357,14 +2357,39 @@ def _ledger_row(kind, metrics, device, tiny, recorded_at):
 def _run_lint_metrics():
     """Full-package sdtpu-lint run for the ledger: wall time (trajectory
     only) and finding count (zero-movement gated by bench_compare — the
-    repo gate is clean, so any nonzero count is a regression)."""
+    repo gate is clean, so any nonzero count is a regression). The
+    concurrency tier rides in the same row: ``lock_cycles`` counts LK005
+    entry-reachable deadlock cycles (zero-tolerance in bench_compare),
+    and ``schedule_explorer_seeds`` is the number of clean seeded
+    interleavings across the sim/harnesses.py subsystem harnesses."""
     from stable_diffusion_webui_distributed_tpu.analysis import run_analysis
+    from stable_diffusion_webui_distributed_tpu.runtime import locksan
+    from stable_diffusion_webui_distributed_tpu.runtime.config import env_int
+    from stable_diffusion_webui_distributed_tpu.sim import harnesses
     root = os.path.dirname(os.path.abspath(__file__))
     result = run_analysis(root, use_cache=False)
+    lock_cycles = sum(1 for f in result.findings
+                      if f.rule == "LK005" and "potential deadlock"
+                      in f.message)
+    seeds = max(1, env_int("SDTPU_SCHED_SEEDS", 64))
+    was = locksan.installed()
+    if not was:
+        locksan.install()
+    try:
+        clean_seeds = 0
+        for name in sorted(harnesses.HARNESSES):
+            clean_seeds += sum(
+                1 for r in harnesses.run_harness(name, range(seeds))
+                if r.ok)
+    finally:
+        if not was:
+            locksan.uninstall()
     return {
         "lint_wall_time_s": round(result.wall_time_s, 3),
         "lint_finding_count": len(result.findings),
         "lint_modules": result.modules,
+        "lock_cycles": lock_cycles,
+        "schedule_explorer_seeds": clean_seeds,
     }
 
 

@@ -21,9 +21,10 @@ from . import allowlist as allowlist_mod
 from . import cache as cache_mod
 from . import callgraph as callgraph_mod
 from . import summaries as summaries_mod
-from . import (alertrules, cacherules, donation, envrules, escape,
-               fleetrules, journalrules, locks, metricrules, netrules,
-               purity, recompile, timerules)
+from . import (alertrules, atomicity, cacherules, donation, envrules,
+               escape, fleetrules, journalrules, lockorder, locks,
+               metricrules, netrules, purity, recompile, threadrules,
+               timerules)
 from .core import RULES, Finding, ModuleInfo, walk_package
 
 __all__ = ["Finding", "RULES", "AnalysisResult", "run_analysis",
@@ -66,7 +67,11 @@ def analyze_modules(modules: List[ModuleInfo],
     findings.extend(journalrules.check(modules))
     findings.extend(alertrules.check(modules))
     findings.extend(netrules.check(modules))
-    findings.extend(locks.check(modules, prog=prog))
+    lock_res = locks.analyze(modules, prog=prog)
+    findings.extend(lock_res.findings)
+    findings.extend(lockorder.check(modules, prog=prog, base=lock_res))
+    findings.extend(atomicity.check(modules, prog=prog))
+    findings.extend(threadrules.check(modules, prog=prog))
     findings.extend(donation.check(modules, prog=prog))
     findings.extend(escape.check(modules, prog=prog))
     findings.extend(fleetrules.check(modules))

@@ -47,6 +47,11 @@ RULES = {
     "LK002": "guarded-by annotation names an unknown lock",
     "LK003": "lock-acquisition-order inversion",
     "LK004": "blocking device/network/time call while holding a lock",
+    "LK005": "lock-order cycle reachable from thread entry points "
+             "(potential deadlock)",
+    "AT001": "check-then-act across a re-acquired lock "
+             "(atomicity violation)",
+    "TH001": "raw daemon Thread loop outside runtime/daemon.py",
     "DN001": "donated buffer used after the donating jit call",
     "TP004": "tracer escapes the traced function into self/global state",
     "FL001": "unguarded mutable container in a lock-bearing fleet class",

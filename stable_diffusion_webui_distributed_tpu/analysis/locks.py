@@ -34,20 +34,55 @@ single-threaded), and nested ``def``s are scanned with an empty held-lock
 set — they run later on other threads. Unknown types produce no finding
 and no edge: the pass under-reports, never guesses.
 
+An explicit ``# sdtpu-lint: lockorder a<b`` comment declares the true
+global order between two locks the static model gets backwards (the
+classic cause: two instances of one class hand off to each other, and
+the runtime orders them by identity while the static names collapse to
+one ``Class.attr``). The annotation removes the contradicted reverse
+edge ``b -> a`` from the graph — and the runtime sanitizer enforces the
+honesty of that claim both ways: an annotation whose order no test
+exercises fails the LOCKSAN_ORDER session check, and a runtime
+acquisition in the annotated-away direction is a divergence.
+
 The static edge set is exported via :func:`lock_order_graph` so the
 runtime lockset sanitizer (``runtime/locksan.py``) can diff observed
-acquisition order against this model at test teardown.
+acquisition order against this model at test teardown; the richer
+:func:`analyze` result (scans, edge provenance, declared orders) feeds
+the entry-point-rooted LK005 pass (analysis/lockorder.py).
 """
 
 from __future__ import annotations
 
 import ast
+import re
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import callgraph
 from .core import Finding, FuncInfo, ModuleInfo
 
 LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+#: payload of ``# sdtpu-lint: lockorder A.x<B.y``
+_ORDER_RE = re.compile(r"^\s*([\w.]+)\s*<\s*([\w.]+)\s*$")
+
+
+def declared_orders(modules: List[ModuleInfo]
+                    ) -> List[Tuple[str, str, str, int]]:
+    """Every ``lockorder a<b`` annotation as ``(a, b, path, line)``."""
+    out: List[Tuple[str, str, str, int]] = []
+    for mod in modules:
+        for line in sorted(mod.comments):
+            text = mod.comments[line]
+            if "sdtpu-lint:" not in text:
+                continue
+            payload = text.split("sdtpu-lint:", 1)[1].strip()
+            if not payload.startswith("lockorder"):
+                continue
+            m = _ORDER_RE.match(payload[len("lockorder"):])
+            if m is not None:
+                out.append((m.group(1), m.group(2), mod.path, line))
+    return out
 
 #: HTTP verbs that block on the network when called on requests / a Session
 _HTTP_VERBS = {"get", "post", "put", "delete", "head", "patch", "request"}
@@ -374,8 +409,28 @@ def _edge_line(scan: _FuncScan) -> int:
     return getattr(scan.info.node, "lineno", 0)
 
 
+@dataclass
+class LockAnalysis:
+    """Everything the lock passes derive in one scan — LK005
+    (analysis/lockorder.py) and the conftest divergence graph reuse it
+    instead of re-walking the package."""
+    findings: List[Finding] = field(default_factory=list)
+    #: annotation-filtered acquisition digraph (lock -> locks taken under)
+    edges: Dict[str, Set[str]] = field(default_factory=dict)
+    #: (a, b) -> (path, line, symbol, contributing function qualname)
+    edge_src: Dict[Tuple[str, str], Tuple[str, int, str, str]] = \
+        field(default_factory=dict)
+    scans: Dict[str, "_FuncScan"] = field(default_factory=dict)
+    classes: Dict[str, ClassLocks] = field(default_factory=dict)
+    acquired: Dict[str, Set[str]] = field(default_factory=dict)
+    #: every ``lockorder a<b`` annotation (a, b, path, line)
+    declared: List[Tuple[str, str, str, int]] = field(default_factory=list)
+    #: declared pairs whose reverse edge actually existed (not stale)
+    suppressed: Set[Tuple[str, str]] = field(default_factory=set)
+
+
 def _analyze(modules: List[ModuleInfo], prog: Optional[callgraph.Program]
-             ) -> Tuple[List[Finding], Dict[str, Set[str]]]:
+             ) -> LockAnalysis:
     if prog is None:
         prog = callgraph.build(modules)
     findings: List[Finding] = []
@@ -426,23 +481,36 @@ def _analyze(modules: List[ModuleInfo], prog: Optional[callgraph.Program]
 
     # lock-order edges: nested withs + calls made while holding a lock
     edges: Dict[str, Set[str]] = {}
-    edge_src: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    edge_src: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
 
-    def add_edge(a: str, b: str, mod: ModuleInfo, line: int, sym: str):
+    def add_edge(a: str, b: str, mod: ModuleInfo, line: int, sym: str,
+                 qual: str):
         if a == b:
             return
         edges.setdefault(a, set()).add(b)
-        edge_src.setdefault((a, b), (mod.path, line, sym))
+        edge_src.setdefault((a, b), (mod.path, line, sym, qual))
 
     for scan in scans.values():
         line = _edge_line(scan)
         for (a, b) in scan.edges:
-            add_edge(a, b, scan.mod, line, scan._symbol())
+            add_edge(a, b, scan.mod, line, scan._symbol(), scan.qual)
         for held, tgt, _callline in scan.calls_under:
             for lk in acquired.get(tgt, set()):
                 for h in held:
                     add_edge(h, lk, scan.mod, line,
-                             f"{scan._symbol()} -> {tgt}")
+                             f"{scan._symbol()} -> {tgt}", scan.qual)
+
+    # lockorder annotations: the declared order wins — drop the
+    # contradicted reverse edge (LK005 reports a stale annotation, and
+    # the runtime sanitizer enforces that the declared order is actually
+    # exercised and never inverted)
+    declared = declared_orders(modules)
+    suppressed: Set[Tuple[str, str]] = set()
+    for a, b, _path, _line in declared:
+        if a in edges.get(b, set()):
+            edges[b].discard(a)
+            edge_src.pop((b, a), None)
+            suppressed.add((a, b))
 
     # LK003: cycles in the lock digraph
     seen_cycles: Set[frozenset] = set()
@@ -458,8 +526,8 @@ def _analyze(modules: List[ModuleInfo], prog: Optional[callgraph.Program]
                 cyc_key = frozenset(cyc)
                 if cyc_key not in seen_cycles:
                     seen_cycles.add(cyc_key)
-                    path, line, sym = edge_src.get(
-                        (node, nxt), ("<unknown>", 0, "<unknown>"))
+                    path, line, sym, _qual = edge_src.get(
+                        (node, nxt), ("<unknown>", 0, "<unknown>", ""))
                     findings.append(Finding(
                         "LK003", path, line, sym,
                         "lock-order inversion: " + " -> ".join(cyc) +
@@ -474,20 +542,28 @@ def _analyze(modules: List[ModuleInfo], prog: Optional[callgraph.Program]
         if node not in visited:
             dfs(node, [], set(), visited)
 
-    return findings, edges
+    return LockAnalysis(findings=findings, edges=edges, edge_src=edge_src,
+                        scans=scans, classes=classes, acquired=acquired,
+                        declared=declared, suppressed=suppressed)
+
+
+def analyze(modules: List[ModuleInfo],
+            prog: Optional[callgraph.Program] = None) -> LockAnalysis:
+    """The full lock-analysis result (LK005 and the divergence graph
+    build on it)."""
+    return _analyze(modules, prog)
 
 
 def check(modules: List[ModuleInfo],
           prog: Optional[callgraph.Program] = None) -> List[Finding]:
-    findings, _edges = _analyze(modules, prog)
-    return findings
+    return _analyze(modules, prog).findings
 
 
 def lock_order_graph(modules: List[ModuleInfo],
                      prog: Optional[callgraph.Program] = None
                      ) -> Dict[str, Set[str]]:
     """The static lock-acquisition digraph (``Class.attr`` -> set of
-    ``Class.attr`` acquired while held). runtime/locksan.py diffs the
-    observed runtime order graph against this model."""
-    _findings, edges = _analyze(modules, prog)
-    return edges
+    ``Class.attr`` acquired while held), with annotated-away reverse
+    edges removed. runtime/locksan.py diffs the observed runtime order
+    graph against this model."""
+    return _analyze(modules, prog).edges

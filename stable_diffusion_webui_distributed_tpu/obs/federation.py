@@ -44,6 +44,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..runtime.config import env_flag
+from ..runtime.daemon import StoppableDaemon
 from . import stitch
 
 #: A worker is stale when its freshest successful poll is older than
@@ -335,7 +336,7 @@ class FederationProber:
             fleet[name.split("/", 1)[1]] = (
                 float(latest[1]) if latest is not None else None)
         with _DAEMON_LOCK:
-            daemon_alive = _DAEMON is not None and _DAEMON.is_alive()
+            daemon_alive = _DAEMON is not None and _DAEMON.alive()
         return {
             "enabled": enabled(),
             "stale_after_s": deadline,
@@ -364,29 +365,16 @@ PROBER = FederationProber()
 # -- polling daemon ----------------------------------------------------------
 
 _DAEMON_LOCK = threading.Lock()
-_DAEMON: Optional["_Prober"] = None  # guarded-by: _DAEMON_LOCK
+_DAEMON: Optional[StoppableDaemon] = None  # guarded-by: _DAEMON_LOCK
 
 
-class _Prober(threading.Thread):
-    """Fixed-interval poll daemon on the TSDB sampler's cadence."""
-
-    def __init__(self, prober: FederationProber, period_s: float) -> None:
-        super().__init__(name="sdtpu-federation-prober", daemon=True)
-        self.prober = prober
-        self.period_s = period_s
-        # NOT named _stop: Thread.join() calls a private self._stop()
-        self._halt = threading.Event()
-
-    def run(self) -> None:
-        while not self._halt.is_set():
-            try:
-                self.prober.tick()
-            except Exception:  # noqa: BLE001 — the sweep must survive
-                pass
-            self._halt.wait(self.period_s)
-
-    def stop(self) -> None:
-        self._halt.set()
+def _probe_tick() -> None:
+    """One guarded poll sweep (reads PROBER at call time so reset()'s
+    rebind takes effect without a daemon restart)."""
+    try:
+        PROBER.tick()
+    except Exception:  # noqa: BLE001 — the sweep must survive
+        pass
 
 
 def set_source(source: Any) -> None:
@@ -418,9 +406,10 @@ def start_daemon() -> bool:
     from . import tsdb as obs_tsdb
 
     with _DAEMON_LOCK:
-        if _DAEMON is not None and _DAEMON.is_alive():
+        if _DAEMON is not None and _DAEMON.alive():
             return True
-        _DAEMON = _Prober(PROBER, obs_tsdb.interval_s())
+        _DAEMON = StoppableDaemon("sdtpu-federation-prober", _probe_tick,
+                                  obs_tsdb.interval_s)
         _DAEMON.start()
     return True
 
@@ -431,8 +420,7 @@ def stop_daemon() -> None:
         daemon = _DAEMON
         _DAEMON = None
     if daemon is not None:
-        daemon.stop()
-        daemon.join(timeout=2.0)
+        daemon.stop(timeout_s=2.0)
 
 
 def reset() -> None:

@@ -45,6 +45,7 @@ from collections import deque
 from typing import Any, Deque, Dict, Optional
 
 from ..runtime.config import env_float, env_str
+from ..runtime.daemon import StoppableDaemon
 from . import stitch
 
 #: Undelivered-transition queue depth; the newest transition past it is
@@ -56,6 +57,10 @@ _MAX_ATTEMPTS = 3
 
 #: Backoff base: sleep ``_BACKOFF_BASE_S * 2**attempt`` between tries.
 _BACKOFF_BASE_S = 0.05
+
+#: Idle re-check cadence of the drain daemon; ``wake()`` on enqueue cuts
+#: it short, so this only bounds shutdown/straggler latency.
+_DRAIN_PERIOD_S = 0.2
 
 DEFAULT_DEDUP_S = 60.0
 
@@ -87,19 +92,18 @@ class Notifier:
         self._last_sent: Dict[Any, float] = {}         # guarded-by: _lock
         self._counts: Dict[str, int] = {}              # guarded-by: _lock
         self._pending = 0                              # guarded-by: _lock
-        self._wake = threading.Event()
-        self._thread_lock = threading.Lock()
-        self._thread: Optional[threading.Thread] = None
-        # NOT named _stop: Thread.join() calls a private self._stop()
-        self._halt = threading.Event()
+        self._daemon = StoppableDaemon("sdtpu-notify-drain",
+                                       self._drain_once, _DRAIN_PERIOD_S)
 
     # -- enqueue (alert-engine side; cheap, lock only for the hand-off) ----
 
     def notify_transition(self, rule: str, event: str, value: Any,
-                          detail: str) -> bool:
+                          detail: str, *, force: bool = False) -> bool:
         """Queue one firing/resolved transition for delivery; returns
-        True when it was accepted (not deduped/dropped/gated off)."""
-        if not enabled():
+        True when it was accepted (not deduped/dropped/gated off).
+        ``force=True`` bypasses the env gate — the schedule-explorer
+        harness exercises the queue/drain protocol without a URL."""
+        if not force and not enabled():
             return False
         now = self._clock()
         item = {"rule": str(rule), "event": str(event), "value": value,
@@ -121,32 +125,19 @@ class Notifier:
         if rejected is not None:
             _count_outcome(rejected)
             return False
-        self._wake.set()
-        self._ensure_thread()
+        self._daemon.start()  # idempotent; restart-safe after stop()
+        self._daemon.wake()
         return True
 
-    def _ensure_thread(self) -> None:
-        with self._thread_lock:
-            if self._thread is not None and self._thread.is_alive():
-                return
-            self._halt.clear()
-            self._thread = threading.Thread(
-                target=self._drain_loop, daemon=True,
-                name="sdtpu-notify-drain")
-            self._thread.start()
+    # -- drain daemon (all blocking work lives here, no locks held) --------
 
-    # -- drain thread (all blocking work lives here, no locks held) --------
-
-    def _drain_loop(self) -> None:
-        while not self._halt.is_set():
-            item = None
+    def _drain_once(self) -> None:
+        """One daemon tick: drain everything queued right now."""
+        while not self._daemon.stopped():
             with self._lock:
-                if self._queue:
-                    item = self._queue.popleft()
-            if item is None:
-                self._wake.clear()
-                self._wake.wait(0.2)
-                continue
+                if not self._queue:
+                    return
+                item = self._queue.popleft()
             delivered, attempts = self._deliver(item)
             outcome = "sent" if delivered else "failed"
             with self._lock:
@@ -193,17 +184,11 @@ class Notifier:
                 return True
             if self._clock() >= deadline:
                 return False
-            self._wake.set()
+            self._daemon.wake()
             time.sleep(0.005)
 
     def stop(self) -> None:
-        self._halt.set()
-        self._wake.set()
-        with self._thread_lock:
-            thread = self._thread
-            self._thread = None
-        if thread is not None:
-            thread.join(timeout=2.0)
+        self._daemon.stop(timeout_s=2.0)
 
     def counts(self) -> Dict[str, int]:
         with self._lock:
@@ -214,8 +199,7 @@ class Notifier:
             queued = len(self._queue)
             pending = self._pending
             counts = dict(self._counts)
-        with self._thread_lock:
-            alive = self._thread is not None and self._thread.is_alive()
+        alive = self._daemon.alive()
         return {"enabled": enabled(), "dedup_s": dedup_s(),
                 "queued": queued, "pending": pending,
                 "outcomes": counts, "draining": alive}

@@ -43,6 +43,7 @@ from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ..runtime.config import env_flag, env_float, env_int, env_str
+from ..runtime.daemon import StoppableDaemon
 
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_POINTS = 512
@@ -480,31 +481,23 @@ def load_snapshot(store: Optional[SeriesStore] = None,
 # -- sampling daemon ---------------------------------------------------------
 
 _DAEMON_LOCK = threading.Lock()
-_DAEMON: Optional["_Sampler"] = None  # guarded-by: _DAEMON_LOCK
+_DAEMON: Optional[StoppableDaemon] = None  # guarded-by: _DAEMON_LOCK
+_DAEMON_STORE: Optional[SeriesStore] = None  # guarded-by: _DAEMON_LOCK
 
 
-class _Sampler(threading.Thread):
+def _make_sampler(store: SeriesStore, period_s: float) -> StoppableDaemon:
     """Fixed-interval sampling daemon; also drives the alert engine's
     evaluation when SDTPU_ALERTS is on (one clock for both)."""
+    ticks = 0
 
-    def __init__(self, store: SeriesStore, period_s: float) -> None:
-        super().__init__(name="sdtpu-tsdb-sampler", daemon=True)
-        self.store = store
-        self.period_s = period_s
-        # NOT named _stop: Thread.join() calls a private self._stop()
-        self._halt = threading.Event()
+    def sample() -> None:
+        nonlocal ticks
+        tick(store=store)
+        ticks += 1
+        if ticks % _SAVE_EVERY_TICKS == 0 and snapshot_dir():
+            save_snapshot(store)
 
-    def run(self) -> None:
-        ticks = 0
-        while not self._halt.is_set():
-            tick(store=self.store)
-            ticks += 1
-            if ticks % _SAVE_EVERY_TICKS == 0 and snapshot_dir():
-                save_snapshot(self.store)
-            self._halt.wait(self.period_s)
-
-    def stop(self) -> None:
-        self._halt.set()
+    return StoppableDaemon("sdtpu-tsdb-sampler", sample, period_s)
 
 
 def tick(store: Optional[SeriesStore] = None) -> int:
@@ -529,29 +522,29 @@ def tick(store: Optional[SeriesStore] = None) -> int:
 
 def start_daemon() -> bool:
     """Start the sampling daemon (idempotent); False with the gate off."""
-    global _DAEMON
+    global _DAEMON, _DAEMON_STORE
     if not enabled():
         return False
     with _DAEMON_LOCK:
-        if _DAEMON is not None and _DAEMON.is_alive():
+        if _DAEMON is not None and _DAEMON.alive():
             return True
         if snapshot_dir():
             load_snapshot(STORE)
-        _DAEMON = _Sampler(STORE, interval_s())
+        _DAEMON = _make_sampler(STORE, interval_s())
+        _DAEMON_STORE = STORE
         _DAEMON.start()
     return True
 
 
 def stop_daemon() -> None:
-    global _DAEMON
+    global _DAEMON, _DAEMON_STORE
     with _DAEMON_LOCK:
-        daemon = _DAEMON
-        _DAEMON = None
+        daemon, store = _DAEMON, _DAEMON_STORE
+        _DAEMON = _DAEMON_STORE = None
     if daemon is not None:
-        daemon.stop()
-        daemon.join(timeout=2.0)
-        if snapshot_dir():
-            save_snapshot(daemon.store)
+        daemon.stop(timeout_s=2.0)
+        if store is not None and snapshot_dir():
+            save_snapshot(store)
 
 
 def reset() -> None:
@@ -602,7 +595,7 @@ def summary() -> Dict[str, Any]:
     """The ``GET /internal/tsdb`` document (schema pinned by tests)."""
     stats = STORE.stats()
     with _DAEMON_LOCK:
-        daemon_alive = _DAEMON is not None and _DAEMON.is_alive()
+        daemon_alive = _DAEMON is not None and _DAEMON.alive()
     return {
         "enabled": enabled(),
         "interval_s": interval_s(),

@@ -30,6 +30,7 @@ import traceback
 from typing import Callable, Optional
 
 from ..runtime.config import env_float
+from ..runtime.daemon import StoppableDaemon
 
 
 def factor() -> float:
@@ -55,19 +56,16 @@ def dump_stacks(max_frames: int = 40) -> str:
 
 def arm(request_id: str, name: str, eta_s: Optional[float],
         on_stall: Optional[Callable[[], None]] = None,
-        ) -> Optional[threading.Event]:
-    """Start watching one operation; returns the disarm latch, or ``None``
-    when the watchdog is off or no ETA is known. The caller MUST
-    :func:`disarm` the returned event from a ``finally`` block."""
+        ) -> Optional[StoppableDaemon]:
+    """Start watching one operation; returns the disarm handle, or
+    ``None`` when the watchdog is off or no ETA is known. The caller
+    MUST :func:`disarm` the returned handle from a ``finally`` block."""
     k = factor()
     if k <= 0.0 or not eta_s or eta_s <= 0.0:
         return None
-    stop = threading.Event()
     deadline_s = k * float(eta_s)
 
-    def watch() -> None:
-        if stop.wait(deadline_s):
-            return  # disarmed in time: no stall
+    def fire() -> None:
         _record_stall(request_id, name, float(eta_s), deadline_s)
         if on_stall is not None:
             try:
@@ -75,14 +73,14 @@ def arm(request_id: str, name: str, eta_s: Optional[float],
             except Exception:
                 pass
 
-    threading.Thread(target=watch, daemon=True,
-                     name=f"watchdog-{name}").start()
-    return stop
+    timer = StoppableDaemon.one_shot(f"watchdog-{name}", deadline_s, fire)
+    timer.start()
+    return timer
 
 
-def disarm(stop: Optional[threading.Event]) -> None:
-    if stop is not None:
-        stop.set()
+def disarm(timer: Optional[StoppableDaemon]) -> None:
+    if timer is not None:
+        timer.halt()  # signal only: disarm runs on request hot paths
 
 
 def _record_stall(request_id: str, name: str, eta_s: float,

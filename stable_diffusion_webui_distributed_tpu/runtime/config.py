@@ -223,10 +223,27 @@ def _logger():
 # - ``SDTPU_LOCKSAN`` (flag, default off): runtime lockset sanitizer
 #   (runtime/locksan.py). When 1, tests/conftest.py wraps the
 #   ``threading`` lock factories to record observed lock-acquisition
-#   order and diffs it against the static LK003 graph at session end;
-#   any ordering the static model missed fails the run. Off by default:
-#   nothing is patched and the lock path is byte-identical to stock
-#   threading. Test harness only — never set in production serving.
+#   order and diffs it against the static LK005 lock-order graph at
+#   session end; any ordering the static model missed fails the run.
+#   Off by default: nothing is patched and the lock path is
+#   byte-identical to stock threading. Test harness only — never set in
+#   production serving.
+# - ``SDTPU_LOCKSAN_ORDER`` (flag, default ON when SDTPU_LOCKSAN=1):
+#   the ordering layer of the session gate (tests/conftest.py). Adds
+#   three checks on top of the divergence diff: Goodlock-style cycle
+#   detection over the union of per-thread observed acquisition edges
+#   (opposite orders that really executed fail the run even when this
+#   schedule happened not to deadlock), ``Condition.wait`` entered
+#   while holding an unrelated lock, and ``lockorder a<b`` annotations
+#   the suite never exercised (an undemonstrated order may not suppress
+#   LK005). Set 0 to drop back to the divergence diff alone while
+#   debugging.
+# - ``SDTPU_SCHED_SEEDS`` (int, default 64): seeds per subsystem
+#   harness for the deterministic schedule explorer sweep in
+#   ``bench.py --ledger`` (sim/sched.py + sim/harnesses.py). Each seed
+#   is one PCT-style priority interleaving; the ledger's
+#   ``schedule_explorer_seeds`` counts the clean ones. Same seed, same
+#   trace — raise it for a deeper prowl, never for determinism.
 # - ``SDTPU_CACHE`` (flag, default off): million-user caching tier
 #   (cache/). When 1, three layers arm over one bounded LRU store:
 #   content-addressed embedding dedupe over the CLIP text tower

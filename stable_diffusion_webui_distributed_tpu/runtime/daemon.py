@@ -1,0 +1,156 @@
+"""StoppableDaemon: the one daemon-loop base for the package.
+
+Before this module existed the package grew five hand-rolled daemon
+loops (TSDB sampler, federation prober, notifier drain, heartbeat
+prober, watchdog timer), each re-deriving the same start/stop/join
+protocol — and one of them shipped the ``Thread._stop`` shadowing bug
+(PR 14): subclassing ``threading.Thread`` and naming your stop event
+``_stop`` silently breaks ``join()``, because ``Thread.join`` calls a
+*private* ``self._stop()``. The lint rule TH001
+(analysis/threadrules.py) now flags raw ``threading.Thread(daemon=True)``
+loops outside this module, so the footgun class is closed for good.
+
+Design notes:
+
+- **Composition, not inheritance.** A StoppableDaemon *owns* a plain
+  ``threading.Thread``; it never subclasses it, so no attribute can
+  shadow a Thread private.
+- **Uniform lifecycle.** ``start()`` is idempotent and restart-safe,
+  ``stop()`` signals + joins + reports, ``alive()`` is the one liveness
+  probe. ``stop()`` of a never-started daemon is a no-op.
+- **Tick injection.** ``tick()`` runs one iteration inline on the
+  caller's thread — tests and bench drive deterministic clocks without
+  the thread ever starting (the same pattern obs/tsdb.py established).
+- **Wakeable waits.** The inter-tick pause waits on an Event, so
+  ``wake()`` (e.g. the notifier's enqueue path) and ``stop()`` both cut
+  a sleep short instead of paying the full period.
+- **One-shot timers.** ``StoppableDaemon.one_shot`` covers the
+  watchdog arm/disarm pattern: fire ``tick`` once after ``delay_s``
+  unless stopped first; ``stop()`` before expiry cancels the firing.
+
+The loop itself never swallows tick exceptions — a tick that can fail
+must guard itself (the TSDB tick already does); a daemon dying loudly
+beats one spinning on a poisoned state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Union
+
+__all__ = ["StoppableDaemon"]
+
+
+class StoppableDaemon:
+    """A restartable periodic (or one-shot) background loop.
+
+    ``tick`` is the loop body; ``period_s`` the inter-tick pause (a
+    float, or a zero-arg callable re-read every iteration so knob
+    changes land without a restart). ``immediate=True`` ticks before
+    the first pause (samplers); ``immediate=False`` pauses first
+    (heartbeats — nothing to probe at t=0).
+    """
+
+    def __init__(self, name: str, tick: Callable[[], object],
+                 period_s: Union[float, Callable[[], float]], *,
+                 immediate: bool = True) -> None:
+        self.name = name
+        self._tick = tick
+        self._period_s = period_s
+        self._immediate = immediate
+        self._one_shot = False
+        self._halt = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def one_shot(cls, name: str, delay_s: float,
+                 fire: Callable[[], object]) -> "StoppableDaemon":
+        """A timer: run ``fire`` once after ``delay_s`` unless ``stop()``
+        lands first (the watchdog arm/disarm pattern)."""
+        d = cls(name, fire, delay_s, immediate=False)
+        d._one_shot = True
+        return d
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> bool:
+        """Start the loop thread (idempotent; restart-safe after a
+        ``stop()``). Returns True when a thread is running on exit."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._halt.clear()
+            self._wake.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=self.name, daemon=True)
+            self._thread.start()
+            return True
+
+    def stop(self, timeout_s: float = 2.0) -> bool:
+        """Signal the loop to exit and join it. Returns True when the
+        thread is gone (or never ran) within the timeout."""
+        with self._lock:
+            thread = self._thread
+        self._halt.set()
+        self._wake.set()
+        if thread is None:
+            return True
+        thread.join(timeout=timeout_s)
+        gone = not thread.is_alive()
+        if gone:
+            with self._lock:
+                if self._thread is thread:
+                    self._thread = None
+        return gone
+
+    def halt(self) -> None:
+        """Signal the loop to exit without joining. The only legal way
+        for a tick to cancel its own loop (``stop()`` would self-join);
+        also right for hot paths that must not block on the join."""
+        self._halt.set()
+        self._wake.set()
+
+    def alive(self) -> bool:
+        with self._lock:
+            return self._thread is not None and self._thread.is_alive()
+
+    def stopped(self) -> bool:
+        """True once ``stop()`` has been signalled (a one-shot reads
+        this as "was I cancelled?")."""
+        return self._halt.is_set()
+
+    # -- tick plumbing -------------------------------------------------------
+
+    def tick(self) -> object:
+        """Run one loop body inline on the caller's thread (deterministic
+        clock injection for tests/bench). Independent of ``start()``."""
+        return self._tick()
+
+    def wake(self) -> None:
+        """Cut the current inter-tick pause short."""
+        self._wake.set()
+
+    def _period(self) -> float:
+        p = self._period_s
+        return float(p() if callable(p) else p)
+
+    def _pause(self, seconds: float) -> None:
+        """Wait out the period; ``wake()``/``stop()`` end it early."""
+        self._wake.wait(seconds)
+        self._wake.clear()
+
+    def _run(self) -> None:
+        if self._one_shot:
+            self._pause(self._period())
+            if not self._halt.is_set():
+                self._tick()
+            return
+        if self._immediate and not self._halt.is_set():
+            self._tick()
+        while not self._halt.is_set():
+            self._pause(self._period())
+            if self._halt.is_set():
+                break
+            self._tick()

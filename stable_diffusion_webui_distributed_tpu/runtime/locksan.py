@@ -1,19 +1,27 @@
 """Runtime lockset sanitizer (``SDTPU_LOCKSAN``, default off).
 
-The static lock analysis (analysis/locks.py) computes an acquisition-order
-digraph over ``Class.attr`` lock names. This module is the other half of
-the contract: when ``SDTPU_LOCKSAN=1``, the ``threading.Lock`` /
-``threading.RLock`` factories are replaced with wrappers that
+The static lock analysis (analysis/locks.py + analysis/lockorder.py)
+computes an acquisition-order digraph over ``Class.attr`` lock names.
+This module is the other half of the contract: when ``SDTPU_LOCKSAN=1``,
+the ``threading.Lock`` / ``threading.RLock`` / ``threading.Condition``
+factories are replaced with wrappers that
 
 - **name** each lock at creation by inspecting the creating frame: a lock
   born from ``self._lock = threading.Lock()`` inside ``WorkerNode.__init__``
   is named ``WorkerNode._lock`` — the same qualified name the static graph
   uses, so the two graphs diff cleanly;
 - **record** every nested acquisition as an ordered edge (held → acquired)
-  in a process-global edge set, per-thread via a thread-local held stack;
+  **per thread** (keyed by thread ident), plus a process-global union, via
+  a thread-local held stack;
 - implement the ``Condition`` protocol (``_release_save`` /
   ``_acquire_restore`` / ``_is_owned``) so ``cond.wait()`` correctly pops
-  and re-pushes the held stack.
+  and re-pushes the held stack, and **detect** a ``Condition.wait``
+  entered while an *unrelated* named lock is still held (the wait blocks
+  with that lock pinned — a convoy, and with a second thread a deadlock);
+- run **Goodlock-style cycle detection** over the union of all threads'
+  edges (:func:`runtime_cycles`) — a cycle means two threads acquired the
+  same locks in opposite orders at runtime, the deadlock precondition,
+  even if the interleaving that deadlocks never fired in this run.
 
 At teardown (tests/conftest.py wires this under ``SDTPU_LOCKSAN=1``),
 :func:`divergence` compares the observed edges against the static graph:
@@ -21,6 +29,16 @@ an observed edge between two statically-known lock names with no static
 path in that direction means the static model missed a real ordering —
 the run fails rather than letting the model rot. Anonymous locks (no
 ``self.<attr> =`` creation site, stdlib internals) never participate.
+``SDTPU_LOCKSAN_ORDER`` (default on) adds the runtime-cycle,
+wait-while-holding, and annotation-exercise session checks on top.
+
+The module is also the instrumentation seam for the deterministic
+schedule explorer (sim/sched.py): :func:`set_scheduler` installs a
+cooperative scheduler, and every lock acquire/release and condition
+wait/notify on a scheduler-managed thread routes through it instead of
+the raw primitive — those are exactly the yield points the explorer
+serializes. With no scheduler installed (the default, including every
+production and plain-test path) the branch is two ``None`` checks.
 
 Default off: importing this module patches nothing; ``install()`` is the
 only entry point with side effects, and ``uninstall()`` restores the real
@@ -40,11 +58,43 @@ _ATTR_ASSIGN = re.compile(r"self\s*\.\s*(\w+)\s*(?::[^=]+)?=")
 
 _real_lock = threading.Lock
 _real_rlock = threading.RLock
+_real_condition = threading.Condition
+#: Thread.start's code object, captured before anything (the explorer)
+#: can patch it — _note_wait uses it to recognize the bootstrap
+#: handshake wait on the child's _started event.
+_THREAD_START_CODE = threading.Thread.start.__code__
 
 _installed = False
+#: union of every thread's observed (held, acquired) edges
 _edges: Set[Tuple[str, str]] = set()
+#: thread ident -> that thread's observed edges (Goodlock input)
+_edges_per_thread: Dict[int, Set[Tuple[str, str]]] = {}
+#: (held-names, waiting-on) pairs for cond.wait entered with extra locks
+_wait_violations: Set[Tuple[Tuple[str, ...], str, str]] = set()
 _edges_guard = _real_lock()
 _tls = threading.local()
+
+#: the cooperative schedule explorer (sim/sched.py), or None. Never set
+#: outside an explorer run; every hot-path check is ``_sched is None``.
+_sched = None
+
+
+def set_scheduler(sched) -> None:
+    """Install (or with ``None`` remove) the cooperative scheduler that
+    lock/condition operations on managed threads route through."""
+    global _sched
+    _sched = sched
+
+
+def scheduler():
+    return _sched
+
+
+def _active_sched():
+    s = _sched
+    if s is not None and s.managed():
+        return s
+    return None
 
 
 def _held_stack() -> List["_SanLock"]:
@@ -71,6 +121,34 @@ def _name_from_frame(depth: int = 2) -> Optional[str]:
     return f"{type(obj).__name__}.{m.group(1)}"
 
 
+def _note_wait(lock: "_SanLock") -> None:
+    """Record a ``Condition.wait`` entered while other named locks are
+    held: the wait releases *its own* lock but keeps the rest pinned
+    for the whole sleep — a convoy, and (if the notifier needs one of
+    them) a deadlock.
+
+    One wait is exempt: ``Thread.start``'s bootstrap handshake on the
+    child's ``_started`` event. The interpreter's ``_bootstrap_inner``
+    sets that event *before* any user code runs on the child, so no
+    held lock can ever block the waker — flagging it would force every
+    "spawn a worker under my state lock" site into contortions for a
+    deadlock that cannot happen."""
+    held = [h._san_name for h in _held_stack()
+            if h is not lock and h._san_name is not None
+            and h._san_name != lock._san_name]
+    if not held:
+        return
+    f = sys._getframe(1)
+    while f is not None:
+        if f.f_code is _THREAD_START_CODE:
+            return
+        f = f.f_back
+    entry = (tuple(sorted(set(held))), lock._san_name or "<anon>",
+             threading.current_thread().name)
+    with _edges_guard:
+        _wait_violations.add(entry)
+
+
 class _SanLock:
     """Order-recording wrapper around a real Lock/RLock."""
 
@@ -87,8 +165,11 @@ class _SanLock:
                 (h._san_name, self._san_name) for h in stack
                 if h._san_name is not None and h._san_name != self._san_name]
             if new_edges:
+                ident = threading.get_ident()
                 with _edges_guard:
                     _edges.update(new_edges)
+                    _edges_per_thread.setdefault(ident, set()).update(
+                        new_edges)
         stack.append(self)
 
     def _pop(self) -> None:
@@ -100,14 +181,21 @@ class _SanLock:
 
     # -- lock protocol -------------------------------------------------------
 
-    def acquire(self, *args, **kwargs):
-        got = self._raw.acquire(*args, **kwargs)
+    def acquire(self, blocking=True, timeout=-1):
+        s = _active_sched()
+        if s is not None:
+            got = s.lock_acquire(self, blocking, timeout)
+        else:
+            got = self._raw.acquire(blocking, timeout)
         if got:
             self._push()
         return got
 
     def release(self):
         self._pop()
+        s = _active_sched()
+        if s is not None:
+            return s.lock_release(self)
         return self._raw.release()
 
     def locked(self):
@@ -124,6 +212,7 @@ class _SanLock:
     # -- Condition protocol (cond.wait releases and reacquires) -------------
 
     def _release_save(self):
+        _note_wait(self)
         self._pop()
         if hasattr(self._raw, "_release_save"):
             return self._raw._release_save()
@@ -149,6 +238,84 @@ class _SanLock:
         return f"<SanLock {self._san_name or 'anon'} {self._raw!r}>"
 
 
+class _SanCondition:
+    """Condition wrapper: pure delegation to a real ``threading.Condition``
+    normally (the real Condition drives the wrapped lock's
+    ``_release_save``/``_acquire_restore``, so edge and wait bookkeeping
+    happen exactly as before) — but on a scheduler-managed thread,
+    ``wait``/``notify`` become cooperative yield points so the explorer
+    can serialize them deterministically instead of sleeping real time."""
+
+    def __init__(self, lock=None):
+        if lock is None:
+            lock = _rlock_factory()
+        self._san_lock = lock if isinstance(lock, _SanLock) else None
+        self._real = _real_condition(lock)
+        #: cooperative waiters: per-waiter one-shot flags ([False] cells)
+        self._coop_waiters: List[List[bool]] = []
+
+    # -- lock passthrough ----------------------------------------------------
+
+    def acquire(self, *args, **kwargs):
+        return self._real.acquire(*args, **kwargs)
+
+    def release(self):
+        return self._real.release()
+
+    def __enter__(self):
+        self._real.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._real.__exit__(*exc)
+
+    def _is_owned(self):
+        return self._real._is_owned()
+
+    # -- wait/notify ---------------------------------------------------------
+
+    def wait(self, timeout=None):
+        s = _active_sched()
+        if s is not None and self._san_lock is not None:
+            _note_wait(self._san_lock)
+            return s.cond_wait(self, timeout)
+        return self._real.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        s = _active_sched()
+        if s is not None and self._san_lock is not None:
+            result = predicate()
+            while not result:
+                if not self.wait(timeout):
+                    return predicate()
+                result = predicate()
+            return result
+        return self._real.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        if _sched is not None and self._coop_waiters:
+            woken = 0
+            while self._coop_waiters and woken < n:
+                self._coop_waiters.pop(0)[0] = True
+                woken += 1
+            if woken >= n:
+                return
+            n -= woken
+        return self._real.notify(n)
+
+    def notify_all(self):
+        if _sched is not None and self._coop_waiters:
+            for cell in self._coop_waiters:
+                cell[0] = True
+            del self._coop_waiters[:]
+        return self._real.notify_all()
+
+    notifyAll = notify_all
+
+    def __repr__(self):
+        return f"<SanCondition {self._real!r}>"
+
+
 def _lock_factory():
     return _SanLock(_real_lock(), _name_from_frame())
 
@@ -157,15 +324,22 @@ def _rlock_factory(*args, **kwargs):
     return _SanLock(_real_rlock(*args, **kwargs), _name_from_frame())
 
 
+def _cond_factory(lock=None):
+    return _SanCondition(lock)
+
+
 def install() -> None:
-    """Patch the threading lock factories (idempotent). ``Condition()``
-    with no explicit lock picks the patch up too: CPython resolves
-    ``RLock`` through the threading module globals at call time."""
+    """Patch the threading factories (idempotent). ``Condition()`` with
+    no explicit lock picks the RLock patch up too: CPython resolves
+    ``RLock`` through the threading module globals at call time — and
+    ``Event``/``Barrier`` built after install resolve ``Condition`` the
+    same way, so their waits are cooperative under the explorer."""
     global _installed
     if _installed:
         return
     threading.Lock = _lock_factory
     threading.RLock = _rlock_factory
+    threading.Condition = _cond_factory
     _installed = True
 
 
@@ -175,6 +349,7 @@ def uninstall() -> None:
         return
     threading.Lock = _real_lock
     threading.RLock = _real_rlock
+    threading.Condition = _real_condition
     _installed = False
 
 
@@ -185,6 +360,8 @@ def installed() -> bool:
 def reset() -> None:
     with _edges_guard:
         _edges.clear()
+        _edges_per_thread.clear()
+        _wait_violations.clear()
 
 
 def observed_edges() -> Set[Tuple[str, str]]:
@@ -192,12 +369,73 @@ def observed_edges() -> Set[Tuple[str, str]]:
         return set(_edges)
 
 
+def edges_by_thread() -> Dict[int, Set[Tuple[str, str]]]:
+    with _edges_guard:
+        return {k: set(v) for k, v in _edges_per_thread.items()}
+
+
+def wait_violations() -> List[Tuple[Tuple[str, ...], str, str]]:
+    """Sorted (held-names, waiting-on, thread-name) records for every
+    ``Condition.wait`` entered while holding an unrelated named lock."""
+    with _edges_guard:
+        return sorted(_wait_violations)
+
+
+def runtime_cycles() -> List[List[str]]:
+    """Goodlock-style check: cycles in the union of all threads' observed
+    acquisition edges. A cycle means opposite-order acquisitions really
+    executed — a deadlock waiting for the right interleaving — even when
+    this run happened not to interleave them fatally."""
+    edges = observed_edges()
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen: Set[frozenset] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str],
+            visited: Set[str]) -> None:
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(cyc)
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: Set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return cycles
+
+
 def static_graph(root: str) -> Dict[str, Set[str]]:
-    """The package's static lock-order digraph (pure AST; no device)."""
+    """The package's static lock-order digraph (pure AST; no device).
+    Annotation-aware: a ``# sdtpu-lint: lockorder a<b`` in the package
+    removes the contradicted reverse edge from this graph, so a runtime
+    acquisition in the annotated-away direction is a divergence."""
     from ..analysis import callgraph, locks
     from ..analysis.core import walk_package
     modules = walk_package(root)
     return locks.lock_order_graph(modules, callgraph.build(modules))
+
+
+def declared_orders(root: str) -> Set[Tuple[str, str]]:
+    """The package's ``lockorder a<b`` annotation pairs. The session gate
+    requires each to be exercised at runtime (observed as an edge) —
+    an annotation no test demonstrates is not allowed to suppress."""
+    from ..analysis import locks
+    from ..analysis.core import walk_package
+    return {(a, b) for a, b, _path, _line
+            in locks.declared_orders(walk_package(root))}
 
 
 def divergence(observed: Set[Tuple[str, str]],

@@ -40,6 +40,9 @@ from stable_diffusion_webui_distributed_tpu.runtime.config import (
     WARMUP_SAMPLES,
     RECORDED_SAMPLES,
 )
+from stable_diffusion_webui_distributed_tpu.runtime.daemon import (
+    StoppableDaemon,
+)
 from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 from stable_diffusion_webui_distributed_tpu.scheduler import eta as eta_mod
 
@@ -364,7 +367,7 @@ class WorkerNode:
             return None
         finally:
             if stop_watch is not None:
-                stop_watch.set()
+                stop_watch.halt()  # hot path: signal only, never join
         elapsed = time.monotonic() - started
         self.response_time = elapsed
         self.health.record_result(True, elapsed)
@@ -381,7 +384,7 @@ class WorkerNode:
         self.set_state(State.IDLE)
         return result
 
-    def _start_interrupt_watchdog(self) -> Optional[threading.Event]:
+    def _start_interrupt_watchdog(self) -> Optional[StoppableDaemon]:
         """Poll the local interrupt flag every 0.5 s while a request is in
         flight and fire ``backend.interrupt()`` the moment it latches — the
         reference's mid-request propagation loop
@@ -395,25 +398,27 @@ class WorkerNode:
         )
 
         state = self.interrupt_state or interrupt_mod.STATE
-        stop = threading.Event()
 
         def watch():
-            while not stop.wait(self.interrupt_poll_s):
-                if state.flag.interrupted:
-                    get_logger().info(
-                        "interrupt: aborting in-flight request on '%s'",
-                        self.label)
-                    try:
-                        self.backend.interrupt()
-                    except Exception as e:  # noqa: BLE001
-                        get_logger().error(
-                            "in-flight interrupt of '%s' failed: %s",
-                            self.label, e)
-                    return
+            if not state.flag.interrupted:
+                return
+            get_logger().info(
+                "interrupt: aborting in-flight request on '%s'",
+                self.label)
+            try:
+                self.backend.interrupt()
+            except Exception as e:  # noqa: BLE001
+                get_logger().error(
+                    "in-flight interrupt of '%s' failed: %s",
+                    self.label, e)
+            daemon.halt()  # fired once: the watch is done
 
-        threading.Thread(target=watch, daemon=True,
-                         name=f"interrupt-watch-{self.label}").start()
-        return stop
+        # immediate=False: first poll lands one period in, like the
+        # reference's stop.wait(period) loop
+        daemon = StoppableDaemon(f"interrupt-watch-{self.label}", watch,
+                                 self.interrupt_poll_s, immediate=False)
+        daemon.start()
+        return daemon
 
     def _probe_memory(self) -> None:
         """First-contact memory probe (reference worker.py:319-340): record

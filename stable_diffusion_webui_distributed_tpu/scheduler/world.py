@@ -33,6 +33,9 @@ from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     GenerationResult,
 )
 from stable_diffusion_webui_distributed_tpu.runtime import config as config_mod
+from stable_diffusion_webui_distributed_tpu.runtime.daemon import (
+    StoppableDaemon,
+)
 from stable_diffusion_webui_distributed_tpu.runtime.logging import get_logger
 from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
     State,
@@ -129,7 +132,7 @@ class World:
         # optional heartbeat prober (SDTPU_HEARTBEAT_S > 0): a daemon
         # sweep of ping_workers so UNAVAILABLE nodes recover without an
         # operator ping; off by default (no thread spawned)
-        self._heartbeat_stop: Optional[threading.Event] = None
+        self._heartbeat: Optional[StoppableDaemon] = None
         self.start_heartbeat()
         # with SDTPU_FEDERATION on, this World is the metrics prober's
         # worker source (obs/federation.py); gate off = no registration
@@ -776,33 +779,32 @@ class World:
             t.join()
         return results
 
-    def start_heartbeat(self) -> Optional[threading.Event]:
+    def start_heartbeat(self) -> Optional[StoppableDaemon]:
         """Spawn the heartbeat prober when ``SDTPU_HEARTBEAT_S`` > 0: a
-        daemon thread running :meth:`ping_workers` every period so
-        UNAVAILABLE workers recover to IDLE (and freshly dead ones are
-        demoted) without operator traffic. Idempotent; returns the stop
-        latch, or None when the knob is off (the default — no thread)."""
+        daemon running :meth:`ping_workers` every period so UNAVAILABLE
+        workers recover to IDLE (and freshly dead ones are demoted)
+        without operator traffic. Idempotent; returns the daemon handle,
+        or None when the knob is off (the default — no thread)."""
         period = config_mod.env_float("SDTPU_HEARTBEAT_S", 0.0) or 0.0
-        if period <= 0.0 or self._heartbeat_stop is not None:
-            return self._heartbeat_stop
-        stop = threading.Event()
+        if period <= 0.0 or self._heartbeat is not None:
+            return self._heartbeat
 
         def beat():
-            while not stop.wait(period):
-                try:
-                    self.ping_workers()
-                except Exception as e:  # noqa: BLE001 — sweep must survive
-                    get_logger().debug("heartbeat sweep failed: %s", e)
+            try:
+                self.ping_workers()
+            except Exception as e:  # noqa: BLE001 — sweep must survive
+                get_logger().debug("heartbeat sweep failed: %s", e)
 
-        threading.Thread(target=beat, daemon=True,
-                         name="worker-heartbeat").start()
-        self._heartbeat_stop = stop
-        return stop
+        # immediate=False: nothing to probe at t=0, the fleet just pinged
+        self._heartbeat = StoppableDaemon("worker-heartbeat", beat, period,
+                                          immediate=False)
+        self._heartbeat.start()
+        return self._heartbeat
 
     def stop_heartbeat(self) -> None:
-        if self._heartbeat_stop is not None:
-            self._heartbeat_stop.set()
-            self._heartbeat_stop = None
+        if self._heartbeat is not None:
+            self._heartbeat.stop(timeout_s=2.0)
+            self._heartbeat = None
 
     def health_summary(self) -> Dict[str, Dict]:
         """Per-worker behavioural health + state: the autoscaler's

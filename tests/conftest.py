@@ -65,25 +65,61 @@ _SLOW_MODULES = {
 
 def pytest_sessionfinish(session, exitstatus):
     """SDTPU_LOCKSAN=1: diff the observed lock-order graph against the
-    static LK003 graph; an edge the static model has no path for fails
-    the run — the model must not silently diverge from reality."""
+    static LK005 graph; an edge the static model has no path for fails
+    the run — the model must not silently diverge from reality.
+
+    SDTPU_LOCKSAN_ORDER (default on with the sanitizer) layers the
+    ordering checks on top: a Goodlock-style cycle in the union of the
+    observed per-thread acquisition edges, a ``Condition.wait`` entered
+    while holding an unrelated lock, or a ``lockorder a<b`` annotation
+    no test exercised each fail the session — a cycle that happened not
+    to interleave fatally this run is still a deadlock waiting for the
+    right schedule, and an unexercised annotation is suppressing the
+    static analyzer on faith."""
     if os.environ.get("SDTPU_LOCKSAN") != "1":
         return
     from stable_diffusion_webui_distributed_tpu.runtime import locksan
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = []
     diverged = locksan.divergence(locksan.observed_edges(),
                                   locksan.static_graph(root))
     if diverged:
-        print("\nlocksan: observed lock orderings missing from the static "
-              "graph (analysis/locks.py):", file=sys.stderr)
-        for a, b in diverged:
-            print(f"  {a} -> {b}", file=sys.stderr)
+        failures.append(
+            "observed lock orderings missing from the static graph "
+            "(analysis/locks.py):\n" + "\n".join(
+                f"  {a} -> {b}" for a, b in diverged))
+    if os.environ.get("SDTPU_LOCKSAN_ORDER", "1") != "0":
+        cycles = locksan.runtime_cycles()
+        if cycles:
+            failures.append(
+                "runtime lock-order cycles (Goodlock union of per-thread "
+                "acquisition edges):\n" + "\n".join(
+                    "  " + " -> ".join(c) for c in cycles))
+        waits = locksan.wait_violations()
+        if waits:
+            failures.append(
+                "Condition.wait entered while holding unrelated lock(s):\n"
+                + "\n".join(f"  held {list(held)} waiting on {cv} "
+                            f"in thread {thread}"
+                            for held, cv, thread in waits))
+        unexercised = locksan.declared_orders(root) \
+            - locksan.observed_edges()
+        if unexercised:
+            failures.append(
+                "lockorder annotations no test exercised (an order the "
+                "suite cannot demonstrate may not suppress LK005):\n"
+                + "\n".join(f"  {a} < {b}"
+                            for a, b in sorted(unexercised)))
+    if failures:
+        print("\nlocksan session gate failed:", file=sys.stderr)
+        for f in failures:
+            print(f, file=sys.stderr)
         session.exitstatus = 1
     else:
         print(f"\nlocksan: {len(locksan.observed_edges())} observed "
-              f"edge(s), zero divergence from the static graph",
-              file=sys.stderr)
+              f"edge(s), zero divergence, zero runtime cycles, zero "
+              f"wait-while-holding violations", file=sys.stderr)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,59 @@
+"""Known-bad check-then-act fixture (AT001).
+
+Three violation shapes — stale value written back, stale branch gating
+a write, and the interprocedural accessor form — plus the sanctioned
+fix (re-validate inside the second critical section), which must stay
+clean.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+
+
+class Quota:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._balance = {}  # guarded-by: _lock
+
+    def reserve_value(self, tenant, cost):
+        with self._lock:
+            bal = self._balance[tenant]
+        # the world can move here: another thread may spend the balance
+        with self._lock:
+            self._balance[tenant] = bal - cost  # line 24: AT001 (value)
+
+    def reserve_branch(self, tenant, cost):
+        with self._lock:
+            bal = self._balance[tenant]
+        if bal >= cost:
+            with self._lock:
+                self._balance[tenant] = 0  # line 31: AT001 (branch)
+
+    def reserve_ok(self, tenant, cost):
+        with self._lock:
+            bal = self._balance[tenant]
+        del bal  # gave up on the stale read
+        with self._lock:
+            if self._balance[tenant] >= cost:  # fresh re-read validates
+                self._balance[tenant] = self._balance[tenant] - cost
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._used = 0  # guarded-by: _lock
+
+    def used(self):
+        with self._lock:
+            return self._used
+
+    def set_used(self, value):
+        with self._lock:
+            self._used = value
+
+
+def refund(amount):
+    meter = Meter()
+    u = meter.used()
+    meter.set_used(u - amount)  # line 59: AT001 (accessor)

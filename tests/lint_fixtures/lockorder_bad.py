@@ -1,0 +1,36 @@
+"""Known-bad lock-order fixture (LK005, alongside LK003).
+
+Two thread entry points acquire the same pair of locks in opposite
+orders — the classic AB/BA deadlock — plus a stale lockorder
+annotation that contradicts no derived edge.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+
+
+class Pair:  # line 13: LK003 + LK005 pin here (edge anchored at the class)
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def forward(self):
+        with self.a:
+            with self.b:  # edge Pair.a -> Pair.b
+                pass
+
+    def backward(self):
+        with self.b:
+            with self.a:  # edge Pair.b -> Pair.a closes the cycle
+                pass
+
+
+def launch():
+    pair = Pair()
+    threading.Thread(target=pair.forward, daemon=True).start()
+    threading.Thread(target=pair.backward, daemon=True).start()
+
+
+# next line (36) is an LK005 stale annotation — no Ghost lock edges exist
+# sdtpu-lint: lockorder Ghost.a<Ghost.b

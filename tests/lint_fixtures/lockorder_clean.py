@@ -1,0 +1,34 @@
+"""Clean twin of lockorder_bad.py: the same AB/BA shape, with the
+inversion annotated away.
+
+The annotation asserts the runtime discipline is ``Pair.a`` before
+``Pair.b`` (the order the paired runtime test exercises), which removes
+the contradicted static ``Pair.b -> Pair.a`` edge — so neither LK003
+nor LK005 fires, and the annotation is not stale.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+"""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def forward(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def backward(self):
+        # sdtpu-lint: lockorder Pair.a<Pair.b
+        with self.b:
+            with self.a:
+                pass
+
+
+def launch():
+    pair = Pair()
+    threading.Thread(target=pair.forward, daemon=True).start()
+    threading.Thread(target=pair.backward, daemon=True).start()

@@ -1,0 +1,41 @@
+"""Known-bad raw-daemon-thread fixture (TH001).
+
+A hand-rolled daemon loop (Thread around a looping target) and a
+Thread subclass with a run() loop both fire; a single-shot background
+task stays legal.
+
+Analyzed by tests/test_lint.py as AST only — never imported, never run.
+Line numbers are asserted exactly; edit with care.
+"""
+import threading
+
+
+def _poll(halt):
+    while not halt.is_set():
+        halt.wait(1.0)
+
+
+def _report():
+    pass
+
+
+def start_poller(halt):
+    t = threading.Thread(target=_poll, daemon=True)  # line 23: TH001
+    t.start()
+    return t
+
+
+def start_once():
+    t = threading.Thread(target=_report, daemon=True)  # clean: no loop
+    t.start()
+    return t
+
+
+class Watcher(threading.Thread):  # line 34: TH001 (run loop subclass)
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.is_set():
+            self._halt.wait(1.0)

@@ -1,0 +1,202 @@
+"""Tests for runtime/daemon.py (StoppableDaemon), the one daemon-loop
+base every background thread in the package now rides on (TSDB sampler,
+federation prober, notifier drain, heartbeat, watchdog timer — enforced
+by lint rule TH001).
+
+Real-thread lifecycle tests keep periods tiny and always stop in a
+finally; the schedule-explorer coverage of the stop/restart race lives
+in tests/test_sched.py (daemon_restart harness)."""
+
+import threading
+import time
+
+from stable_diffusion_webui_distributed_tpu.runtime.daemon import (
+    StoppableDaemon,
+)
+
+
+def _wait_until(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+class TestLifecycle:
+    def test_start_runs_ticks_and_stop_joins(self):
+        hits = []
+        d = StoppableDaemon("t-sampler", lambda: hits.append(1), 0.005)
+        try:
+            assert d.start()
+            assert _wait_until(lambda: len(hits) >= 3)
+            assert d.alive()
+        finally:
+            assert d.stop(timeout_s=5.0)
+        assert not d.alive()
+        n = len(hits)
+        time.sleep(0.05)
+        assert len(hits) == n  # no tick after stop returned
+
+    def test_start_is_idempotent(self):
+        d = StoppableDaemon("t-idem", lambda: None, 0.005)
+        try:
+            d.start()
+            first = d._thread
+            d.start()
+            assert d._thread is first  # no second loop thread spawned
+        finally:
+            d.stop(timeout_s=5.0)
+
+    def test_restart_after_stop_spawns_a_fresh_loop(self):
+        hits = []
+        d = StoppableDaemon("t-restart", lambda: hits.append(1), 0.005)
+        try:
+            d.start()
+            assert _wait_until(lambda: hits)
+            assert d.stop(timeout_s=5.0)
+            assert d.stopped()
+            n = len(hits)
+            d.start()
+            assert not d.stopped()  # restart clears the halt flag
+            assert _wait_until(lambda: len(hits) > n)
+        finally:
+            d.stop(timeout_s=5.0)
+
+    def test_stop_never_started_is_a_noop(self):
+        d = StoppableDaemon("t-cold", lambda: None, 0.005)
+        assert d.stop() is True
+        assert not d.alive()
+
+    def test_halt_signals_without_joining(self):
+        entered = threading.Event()
+        release = threading.Event()
+
+        def tick():
+            entered.set()
+            release.wait(5.0)
+
+        d = StoppableDaemon("t-halt", tick, 0.005)
+        try:
+            d.start()
+            assert entered.wait(5.0)
+            t0 = time.monotonic()
+            d.halt()  # must return immediately, mid-tick
+            assert time.monotonic() - t0 < 0.5
+            assert d.stopped()
+        finally:
+            release.set()
+            d.stop(timeout_s=5.0)
+
+    def test_tick_may_halt_its_own_loop(self):
+        hits = []
+        d = StoppableDaemon("t-self", lambda: (hits.append(1),
+                                               d.halt()), 0.005)
+        try:
+            d.start()
+            assert _wait_until(lambda: not d.alive())
+            assert hits == [1]  # halted itself after exactly one tick
+        finally:
+            d.stop(timeout_s=5.0)
+
+
+class TestTickPlumbing:
+    def test_inline_tick_needs_no_thread(self):
+        hits = []
+        d = StoppableDaemon("t-inline", lambda: hits.append(1) or 7, 60.0)
+        assert d.tick() == 7
+        assert hits == [1]
+        assert not d.alive()
+
+    def test_wake_cuts_the_pause_short(self):
+        hits = []
+        d = StoppableDaemon("t-wake", lambda: hits.append(1), 60.0,
+                            immediate=False)
+        try:
+            d.start()
+            time.sleep(0.02)
+            assert not hits  # parked in the 60s pause
+            d.wake()
+            assert _wait_until(lambda: hits)
+        finally:
+            d.stop(timeout_s=5.0)
+
+    def test_callable_period_is_reread_each_iteration(self):
+        periods = []
+
+        def period():
+            periods.append(1)
+            return 0.005
+
+        d = StoppableDaemon("t-knob", lambda: None, period)
+        try:
+            d.start()
+            assert _wait_until(lambda: len(periods) >= 2)
+        finally:
+            d.stop(timeout_s=5.0)
+
+    def test_immediate_false_pauses_before_first_tick(self):
+        hits = []
+        d = StoppableDaemon("t-heartbeat", lambda: hits.append(1), 60.0,
+                            immediate=False)
+        try:
+            d.start()
+            time.sleep(0.02)
+            assert not hits
+        finally:
+            d.stop(timeout_s=5.0)
+
+
+class TestOneShot:
+    def test_fires_once_after_delay(self):
+        hits = []
+        d = StoppableDaemon.one_shot("t-timer", 0.01, lambda: hits.append(1))
+        try:
+            d.start()
+            assert _wait_until(lambda: hits)
+            assert _wait_until(lambda: not d.alive())
+            assert hits == [1]
+        finally:
+            d.stop(timeout_s=5.0)
+
+    def test_stop_before_expiry_cancels(self):
+        hits = []
+        d = StoppableDaemon.one_shot("t-wd", 60.0, lambda: hits.append(1))
+        d.start()
+        assert d.stop(timeout_s=5.0)
+        assert not hits
+        assert d.stopped()  # the watchdog reads this as "cancelled"
+
+    def test_halt_disarms_without_join(self):
+        hits = []
+        d = StoppableDaemon.one_shot("t-disarm", 0.05,
+                                     lambda: hits.append(1))
+        try:
+            d.start()
+            d.halt()  # obs/watchdog.disarm: signal only, hot path
+            assert _wait_until(lambda: not d.alive())
+            assert not hits
+        finally:
+            d.stop(timeout_s=5.0)
+
+
+class TestErrorPropagation:
+    def test_tick_exception_kills_the_loop_loudly(self):
+        """The loop never swallows tick exceptions: a poisoned tick must
+        end the daemon (dying loudly beats spinning on bad state)."""
+        caught = []
+        prev_hook = threading.excepthook
+        threading.excepthook = lambda args: caught.append(args.exc_type)
+
+        def tick():
+            raise RuntimeError("poisoned state")
+
+        d = StoppableDaemon("t-boom", tick, 0.005)
+        try:
+            d.start()
+            assert _wait_until(lambda: not d.alive())
+            assert caught == [RuntimeError]  # exactly one tick ran
+        finally:
+            threading.excepthook = prev_hook
+            d.stop(timeout_s=5.0)

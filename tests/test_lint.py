@@ -70,8 +70,9 @@ class TestRepoGate:
     def test_every_rule_has_a_description(self):
         for rule in ("TP001", "TP002", "TP003", "TP004", "RC001", "RC002",
                      "RC003", "EV001", "OB001", "OB002", "OB003", "OB004",
-                     "OB005", "LK001", "LK002", "LK003", "LK004", "DN001",
-                     "FL001", "AL001", "AL002", "CA001"):
+                     "OB005", "LK001", "LK002", "LK003", "LK004", "LK005",
+                     "AT001", "TH001", "DN001", "FL001", "AL001", "AL002",
+                     "CA001"):
             assert rule in RULES and RULES[rule]
 
 
@@ -356,6 +357,44 @@ class TestFixtures:
         from stable_diffusion_webui_distributed_tpu.analysis import locks
         assert not hasattr(locks, "CLASS_HINTS")
 
+    def test_lockorder_family(self):
+        findings = _fixture_findings("lockorder_bad.py")
+        found = _rule_lines(findings)
+        assert found == {
+            ("LK003", 13),  # opposite-order pair (intra-class edge view)
+            ("LK005", 13),  # the cycle, walked from both Thread entries
+            ("LK005", 36),  # stale annotation: contradicts no edge
+        }
+        cycle = next(f for f in findings
+                     if f.rule == "LK005" and f.line == 13)
+        # the finding must carry BOTH acquisition paths, entry-labelled
+        assert "path 1:" in cycle.message and "path 2:" in cycle.message
+        assert "Pair.a" in cycle.message and "Pair.b" in cycle.message
+
+    def test_lockorder_clean_fixture_is_clean(self):
+        findings = _fixture_findings("lockorder_clean.py")
+        rendered = "\n".join(f.render() for f in findings)
+        assert not findings, \
+            f"exercised lockorder annotation must suppress:\n{rendered}"
+
+    def test_atomicity_family(self):
+        found = _rule_lines(_fixture_findings("atomicity_bad.py"))
+        assert found == {
+            ("AT001", 24),  # stale value written back under re-acquire
+            ("AT001", 31),  # stale branch gating a locked write
+            ("AT001", 59),  # interprocedural: accessor read -> write
+        }
+        # reserve_ok (fresh re-read validates inside the second critical
+        # section) stays clean
+
+    def test_thread_family(self):
+        found = _rule_lines(_fixture_findings("thread_bad.py"))
+        assert found == {
+            ("TH001", 23),  # raw daemon Thread around a looping target
+            ("TH001", 34),  # Thread subclass with a looping run()
+        }
+        # the non-looping one-shot report thread stays clean
+
     def test_clean_fixture_has_zero_findings(self):
         findings = _fixture_findings("clean.py")
         rendered = "\n".join(f.render() for f in findings)
@@ -531,6 +570,74 @@ class TestRegressionInjections:
                 return latents + out
             """)
         assert {f.rule for f in findings} == {"DN001"}
+
+    def test_injected_lock_order_inversion(self, tmp_path):
+        # the dynamic half of this pair (the same shape deadlocking
+        # under the schedule explorer) lives in tests/test_sched.py
+        findings = _analyze_source(tmp_path, """\
+            import threading
+
+
+            class Pair:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def forward(self):
+                    with self.a:
+                        with self.b:
+                            pass
+
+                def backward(self):
+                    with self.b:
+                        with self.a:
+                            pass
+
+
+            def launch():
+                p = Pair()
+                threading.Thread(target=p.forward, daemon=True).start()
+                threading.Thread(target=p.backward, daemon=True).start()
+            """)
+        assert ("LK005", 4) in {(f.rule, f.line) for f in findings}
+        cycle = next(f for f in findings if f.rule == "LK005")
+        assert "path 1:" in cycle.message and "path 2:" in cycle.message
+
+    def test_injected_check_then_act_race(self, tmp_path):
+        # the dynamic half (lost update under the explorer) lives in
+        # tests/test_sched.py
+        findings = _analyze_source(tmp_path, """\
+            import threading
+
+
+            class Ledger:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._free = 0  # guarded-by: _lock
+
+                def take(self, n):
+                    with self._lock:
+                        free = self._free
+                    if free >= n:
+                        with self._lock:
+                            self._free = free - n
+            """)
+        assert {(f.rule, f.line) for f in findings} == {("AT001", 14)}
+
+    def test_injected_raw_daemon_loop(self, tmp_path):
+        findings = _analyze_source(tmp_path, """\
+            import threading
+
+
+            def _poll():
+                while True:
+                    pass
+
+
+            def start():
+                threading.Thread(target=_poll, daemon=True).start()
+            """)
+        assert {(f.rule, f.line) for f in findings} == {("TH001", 10)}
 
 
 # -- cache + --changed mechanics ---------------------------------------------

@@ -18,6 +18,7 @@ under ``SDTPU_LOCKSAN=1``.
 import hashlib
 import os
 import threading
+import time
 
 import pytest
 
@@ -150,6 +151,121 @@ class TestWrapperMechanics:
                 box.cv.notify()
         t.join(timeout=5)
         assert hits == [1]  # cv reacquired -> exactly the cv lock held
+
+
+class TestOrderingChecks:
+    """The SDTPU_LOCKSAN_ORDER session layer: Goodlock cycles over the
+    union of per-thread edges, and wait-while-holding detection."""
+
+    def _run_in_thread(self, fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_opposite_orders_in_two_threads_form_a_cycle(self, sanitized):
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+        p = Pair()
+
+        def forward():
+            with p.a:
+                with p.b:
+                    pass
+
+        def backward():
+            with p.b:
+                with p.a:
+                    pass
+
+        self._run_in_thread(forward)
+        assert locksan.runtime_cycles() == []  # one order alone is fine
+        self._run_in_thread(backward)
+        cycles = locksan.runtime_cycles()
+        assert cycles, "AB/BA across two threads must report a cycle"
+        assert {"Pair.a", "Pair.b"} <= set(cycles[0])
+
+    def test_edges_by_thread_keeps_threads_apart(self, sanitized):
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+        p = Pair()
+
+        def forward():
+            with p.a:
+                with p.b:
+                    pass
+
+        self._run_in_thread(forward)
+        per_thread = locksan.edges_by_thread()
+        # exactly one recording thread, holding exactly the one edge
+        assert [{("Pair.a", "Pair.b")}] == list(per_thread.values())
+
+    def test_wait_while_holding_unrelated_lock_is_flagged(self, sanitized):
+        class Box:
+            def __init__(self):
+                self.outer = threading.Lock()
+                self._lock = threading.Lock()
+                self.cv = threading.Condition(self._lock)
+
+        box = Box()
+
+        def bad_waiter():
+            with box.outer:       # unrelated lock held across the wait
+                with box.cv:
+                    box.cv.wait(timeout=0.01)
+
+        self._run_in_thread(bad_waiter)
+        violations = locksan.wait_violations()
+        assert violations, "wait under an unrelated lock must be recorded"
+        held, cv_name, _thread = violations[0]
+        assert "Box.outer" in held
+        assert cv_name == "Box._lock"
+
+    def test_wait_holding_only_the_cv_lock_is_clean(self, sanitized):
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.cv = threading.Condition(self._lock)
+
+        box = Box()
+
+        def good_waiter():
+            with box.cv:
+                box.cv.wait(timeout=0.01)
+
+        self._run_in_thread(good_waiter)
+        assert locksan.wait_violations() == []
+
+    def test_thread_start_bootstrap_wait_is_exempt(self, sanitized):
+        """Thread.start blocks on the child's _started event; the
+        interpreter sets it before any user code runs, so starting a
+        thread while holding a lock can't deadlock and must not be
+        flagged. Delay the child's set() so the parent deterministically
+        loses the bootstrap race and really enters the cond wait."""
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        owner = Owner()
+        child = threading.Thread(target=lambda: None, daemon=True)
+        started = child._started  # sanitized Event: built post-install
+        real_set = started.set
+
+        def slow_set():
+            time.sleep(0.05)
+            real_set()
+
+        started.set = slow_set
+        with owner._lock:
+            child.start()
+        child.join(timeout=5)
+        assert locksan.wait_violations() == []
 
 
 class TestDivergence:

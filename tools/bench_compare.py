@@ -58,6 +58,12 @@ THRESHOLDS = {
     # without fixing or allowlisting it (wall time is trajectory-only —
     # machine-dependent, never gated)
     "lint_finding_count": ("up", "abs", 0.0),
+    # concurrency tier (same lint row): a lock-order cycle reachable
+    # from a thread entry point is a deadlock waiting for a schedule —
+    # zero tolerance; fewer clean explorer seeds means an interleaving
+    # started deadlocking or breaking an invariant
+    "lock_cycles": ("up", "abs", 0.0),
+    "schedule_explorer_seeds": ("down", "abs", 0.0),
     # caching-tier rows (bench.py run_cache): the redundant mix is fixed,
     # so hit rates and the prefix FLOP cut are structural — meaningful
     # movement means a key family broke (over-keying kills dedupe) or the
