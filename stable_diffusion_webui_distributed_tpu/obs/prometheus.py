@@ -245,6 +245,8 @@ def clear_histograms() -> None:
         _FLEET_QUEUE_WAIT.clear()
     with _COMPILE_LOCK:
         _COMPILE_LAT.clear()
+    with _STAGE_GRAPH_LOCK:
+        _STAGE_GRAPH_LAT.clear()
     for c in FLEET_COUNTERS.values():
         c.clear()
     PRECISION_COUNTER.clear()
@@ -281,6 +283,31 @@ def observe_compile(kind: str, seconds: float) -> None:
                 "XLA stage-build (compile) latency by stage kind.",
                 labels=f'kind="{_label(kind)}"')
             _COMPILE_LAT[kind] = h
+    h.observe(seconds)
+
+
+# -- stage-graph executor (parallel/stage_graph.py) --------------------------
+
+_STAGE_GRAPH_LOCK = threading.Lock()
+#: per-stage-node host latency histograms, created on first observation.
+#: Family name is sdtpu_stage_graph_seconds, NOT the sdtpu_stage_seconds
+#: the issue sketch suggested: that family is already registered as a
+#: GAUGE (StageStats rolling stats above) and register_metric enforces
+#: one type per name — a histogram re-registration would raise.
+_STAGE_GRAPH_LAT: Dict[str, Histogram] = {}  # guarded-by: _STAGE_GRAPH_LOCK
+
+
+def observe_stage_graph(stage: str, seconds: float) -> None:
+    """One stage-graph node's host interval (encode / denoise dispatch /
+    decode dispatch / merge fetch), labeled by stage name."""
+    with _STAGE_GRAPH_LOCK:
+        h = _STAGE_GRAPH_LAT.get(stage)
+        if h is None:
+            h = Histogram(
+                "sdtpu_stage_graph_seconds",
+                "Stage-graph node host seconds by stage.",
+                labels=f'stage="{_label(stage)}"')
+            _STAGE_GRAPH_LAT[stage] = h
     h.observe(seconds)
 
 
@@ -821,6 +848,11 @@ def render() -> str:
     with _COMPILE_LOCK:
         compile_hists = [_COMPILE_LAT[k] for k in sorted(_COMPILE_LAT)]
     for i, h in enumerate(compile_hists):
+        lines.extend(h.render(header=(i == 0)))
+    with _STAGE_GRAPH_LOCK:
+        stage_hists = [_STAGE_GRAPH_LAT[k]
+                       for k in sorted(_STAGE_GRAPH_LAT)]
+    for i, h in enumerate(stage_hists):
         lines.extend(h.render(header=(i == 0)))
     _render_perf(lines)
 

@@ -1,0 +1,437 @@
+"""N-node stage-graph executor: async Encode / Denoise / ControlNet /
+Decode overlap on the host timeline.
+
+``parallel/stage_pipeline.py`` proved the two-stage form of this design:
+drive several device groups from ONE host thread by dispatching every
+stage async (the engines' ``sync=False`` denoise mode) and hopping
+latents between meshes with ``jax.device_put``. This module generalizes
+that hand-rolled ``in_flight`` list into an explicit dependency graph:
+
+- :class:`StageGraph` — one dispatch group's stages as named nodes with
+  data-dependency edges. Nodes run in topological order on the
+  dispatching thread; the device work INSIDE a node is dispatched
+  without blocking, so the host races ahead and group *i*'s VAE decode
+  or group *i+1*'s CLIP encode overlaps group *i+1*'s denoise.
+- :class:`GraphRunner` — the depth-limited FIFO in-flight window across
+  groups. ``submit`` dispatches a graph now and defers its ``flush``
+  (host materialization of the decode) until more than ``depth`` groups
+  are in flight; ``drain`` flushes everything in order, which is also
+  the interrupt/preempt seam (gallery order is global-image-index
+  order, so the OLDEST group must always materialize first).
+- :class:`OverlapClock` — host-timeline accounting: encode/decode/merge
+  intervals are scored against OTHER groups' open or closed denoise
+  windows, producing the ``stage_overlap_ratio`` the perf ledger and
+  ``bench.py --stages`` report. Overlap is measured, never asserted.
+
+Byte-identity contract: the graph never changes WHAT is computed — the
+seed contract keys every noise draw by global image index and
+``sync=False`` only changes host pacing — so gate-on images are
+byte-identical to the serial path (tests/test_stagegraph.py pins both
+directions). Gate: ``SDTPU_STAGE_GRAPH`` (default OFF; the off path
+never imports this module on a hot path). ``SDTPU_STAGE_DEPTH`` sizes
+the in-flight window; ``SDTPU_STAGE_CN_DEVICES`` carves the last N
+visible devices into a mesh slice for the stage-ahead ControlNet tower
+(pipeline/engine.py:_denoise_range_staged_cn).
+
+This module stays importable without JAX on purpose (jax only inside
+:func:`to_mesh`): the schedule-explorer harness
+(sim/harnesses.py:stage_graph_harness) races real StageGraph/GraphRunner
+objects under the cooperative scheduler, where device work is stubbed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_flag,
+    env_int,
+)
+
+__all__ = [
+    "CLOCK",
+    "GraphRunner",
+    "OverlapClock",
+    "StageGraph",
+    "StageNode",
+    "cn_slice_devices",
+    "depth",
+    "enabled",
+    "to_mesh",
+]
+
+#: Fixed trace lanes (/internal/trace.json tid field) so every stage kind
+#: renders on its own swimlane instead of the dispatching thread's —
+#: overlapped stages from different groups would otherwise collapse into
+#: one visually-serial row.
+LANES = {
+    "encode": -101,
+    "controlnet": -102,
+    "denoise": -103,
+    "decode": -104,
+    "merge": -105,
+    "refine": -106,
+}
+
+
+def enabled() -> bool:
+    """SDTPU_STAGE_GRAPH: route txt2img (engine) and grouped dispatch
+    (serving dispatcher) through the stage-graph executor."""
+    return env_flag("SDTPU_STAGE_GRAPH", False)
+
+
+def depth() -> int:
+    """SDTPU_STAGE_DEPTH: in-flight group window (>=1). Depth 1 matches
+    the serial path's decode-trails-one-group pipelining."""
+    return max(1, env_int("SDTPU_STAGE_DEPTH", 1))
+
+
+def cn_slice_devices() -> int:
+    """SDTPU_STAGE_CN_DEVICES: devices carved off for the ControlNet
+    stage's own mesh slice (0 = evaluate on the UNet's devices)."""
+    return max(0, env_int("SDTPU_STAGE_CN_DEVICES", 0))
+
+
+def to_mesh(x, mesh, batch: bool):
+    """Commit ``x`` to ``mesh`` (dp-sharded batch dim when it divides,
+    replicated otherwise); None mesh = leave placement alone. Moved from
+    stage_pipeline (which re-exports it) so the ControlNet slice hop and
+    the base/refiner hop share one implementation."""
+    import jax
+
+    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+        batch_sharding,
+        replicated,
+    )
+
+    if mesh is None or x is None:
+        return x
+    dp = mesh.shape.get("dp", 1)
+    if batch and dp > 1 and x.shape[0] % dp == 0:
+        return jax.device_put(x, batch_sharding(mesh))
+    return jax.device_put(x, replicated(mesh))
+
+
+class OverlapClock:
+    """Host-timeline overlap accounting across dispatch groups.
+
+    Denoise windows open when a group's denoise stage starts dispatching
+    and close when the group's flush materializes (async engine path) or
+    when the blocking denoise returns (sync dispatcher path). A stage
+    interval (encode / decode dispatch / merge fetch) scores the seconds
+    it spent inside ANY other group's denoise window — its own group is
+    excluded so a stage can never overlap the denoise it feeds. Open
+    windows clamp to "now", which is what makes eager scoring correct:
+    by the time group *i*'s merge interval ends, group *i+1*'s denoise
+    window has already opened even though it hasn't closed.
+    """
+
+    _KEEP = 512  # windows retained; bench runs stay far under this
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: _lock (every field below)
+        self._open: List[List[Any]] = []     # [t0, group], still running
+        self._closed: List[Tuple[float, float, Any]] = []
+        self._stage_s = 0.0
+        self._overlap_s = 0.0
+        self._events = 0
+
+    def begin_denoise(self, group: Any, t0: Optional[float] = None) -> None:
+        with self._lock:
+            self._open.append([time.perf_counter() if t0 is None else t0,
+                               group])
+
+    def end_denoise(self, group: Any, t1: Optional[float] = None) -> None:
+        t1 = time.perf_counter() if t1 is None else t1
+        with self._lock:
+            for idx, (t0, grp) in enumerate(self._open):
+                if grp == group:
+                    self._open.pop(idx)
+                    self._closed.append((t0, t1, grp))
+                    if len(self._closed) > self._KEEP:
+                        del self._closed[:-self._KEEP]
+                    return
+
+    def note_stage(self, t0: float, t1: float, group: Any) -> float:
+        """Record one encode/decode/merge host interval; returns (and
+        accumulates) the seconds of it overlapped with other groups'
+        denoise windows."""
+        ov = self.overlap_of(t0, t1, exclude_group=group)
+        with self._lock:
+            self._stage_s += max(0.0, t1 - t0)
+            self._overlap_s += ov
+            self._events += 1
+        return ov
+
+    def overlap_of(self, t0: float, t1: float,
+                   exclude_group: Any = None) -> float:
+        """Seconds of [t0, t1] covered by the union of denoise windows
+        belonging to other groups (open windows clamp to now)."""
+        now = time.perf_counter()
+        with self._lock:
+            wins = [(a, b) for a, b, grp in self._closed
+                    if grp != exclude_group and b > t0 and a < t1]
+            wins += [(a, now) for a, grp in self._open
+                     if grp != exclude_group and now > t0 and a < t1]
+        if not wins or t1 <= t0:
+            return 0.0
+        wins.sort()
+        total = 0.0
+        cur_a, cur_b = wins[0]
+        for a, b in wins[1:]:
+            if a > cur_b:
+                total += max(0.0, min(cur_b, t1) - max(cur_a, t0))
+                cur_a, cur_b = a, b
+            else:
+                cur_b = max(cur_b, b)
+        total += max(0.0, min(cur_b, t1) - max(cur_a, t0))
+        return total
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            ratio = (self._overlap_s / self._stage_s) if self._stage_s \
+                else 0.0
+            return {"stage_s": self._stage_s,
+                    "overlap_s": self._overlap_s,
+                    "events": float(self._events),
+                    "stage_overlap_ratio": ratio}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self._closed.clear()
+            self._stage_s = 0.0
+            self._overlap_s = 0.0
+            self._events = 0
+
+
+#: Process-wide clock the engine and dispatcher feed; bench.py --stages
+#: reads/resets it. Module-level singleton — explorer harnesses construct
+#: fresh OverlapClock instances instead (its lock was born raw at import,
+#: sim/harnesses.py ground rules).
+CLOCK = OverlapClock()
+
+
+class StageNode:
+    """One stage of a dispatch group: name, callable, dependency names,
+    and the host-timeline record of its execution."""
+
+    __slots__ = ("name", "fn", "deps", "kind", "result", "t0", "t1",
+                 "overlap", "ran")
+
+    def __init__(self, name: str, fn: Callable[..., Any],
+                 deps: Tuple[str, ...], kind: Optional[str]) -> None:
+        self.name = name
+        self.fn = fn
+        self.deps = deps
+        self.kind = kind
+        self.result: Any = None
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.overlap = 0.0
+        self.ran = False
+
+    def seconds(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+
+class StageGraph:
+    """Stages of ONE dispatch group as an explicit dependency graph.
+
+    ``add`` requires every dependency to already exist, so insertion
+    order is a topological order and cycles are impossible by
+    construction. ``run(until=...)`` executes the not-yet-run prefix on
+    the calling thread — the serving dispatcher uses the split point to
+    run encode/denoise/decode under the device lock and the merge node
+    after releasing it.
+
+    Node ``kind`` routes host-interval accounting:
+
+    - ``"stage"``   — scored against other groups' denoise windows
+      (:meth:`OverlapClock.note_stage`).
+    - ``"denoise"`` — opens a denoise window at node start; the
+      GraphRunner closes it when the group's flush materializes (the
+      async engine path, where the node's host return means "dispatched",
+      not "done").
+    - ``"denoise_sync"`` — opens and closes the window around the node
+      (the dispatcher path, whose denoise blocks).
+    - ``None``      — no clock accounting.
+
+    ``on_stage(name, seconds)`` fires after every node — the per-stage
+    completion callback surface (serving/dispatcher.py Ticket.on_stage).
+    ``obs=False`` skips prometheus/span emission entirely (explorer
+    harnesses run without the obs singletons).
+    """
+
+    def __init__(self, label: str = "", group: Any = None,
+                 clock: Optional[OverlapClock] = None,
+                 on_stage: Optional[Callable[[str, float], None]] = None,
+                 obs: bool = True) -> None:
+        self.label = label
+        self.group = group
+        self.clock = clock
+        self.on_stage = on_stage
+        self.obs = obs
+        self.open_denoise = False  # async window awaiting runner close
+        self._nodes: "Dict[str, StageNode]" = {}  # insertion = topo order
+
+    def add(self, name: str, fn: Callable[..., Any],
+            deps: Sequence[str] = (), kind: Optional[str] = "stage") -> None:
+        if name in self._nodes:
+            raise ValueError(f"stage graph: duplicate node {name!r}")
+        for d in deps:
+            if d not in self._nodes:
+                raise ValueError(
+                    f"stage graph: node {name!r} depends on undefined "
+                    f"{d!r} (dependencies must be added first)")
+        self._nodes[name] = StageNode(name, fn, tuple(deps), kind)
+
+    def node(self, name: str) -> StageNode:
+        return self._nodes[name]
+
+    def results(self) -> Dict[str, Any]:
+        return {n.name: n.result for n in self._nodes.values() if n.ran}
+
+    def stage_seconds(self) -> float:
+        """Host seconds of every completed ``"stage"``-kind node."""
+        return sum(n.seconds() for n in self._nodes.values()
+                   if n.ran and n.kind == "stage")
+
+    def stage_overlap(self) -> float:
+        return sum(n.overlap for n in self._nodes.values()
+                   if n.ran and n.kind == "stage")
+
+    def run(self, until: Optional[str] = None) -> Dict[str, Any]:
+        """Execute not-yet-run nodes in insertion (= topological) order,
+        stopping AFTER ``until`` when given; returns name -> result for
+        everything completed so far."""
+        for node in self._nodes.values():
+            if node.ran:
+                if node.name == until:
+                    break
+                continue
+            node.t0 = time.perf_counter()
+            if node.kind in ("denoise", "denoise_sync") \
+                    and self.clock is not None:
+                self.clock.begin_denoise(self.group, node.t0)
+                self.open_denoise = True
+            node.result = node.fn(
+                *(self._nodes[d].result for d in node.deps))
+            node.t1 = time.perf_counter()
+            node.ran = True
+            if self.clock is not None:
+                if node.kind == "denoise_sync":
+                    self.clock.end_denoise(self.group, node.t1)
+                    self.open_denoise = False
+                elif node.kind == "stage":
+                    node.overlap = self.clock.note_stage(
+                        node.t0, node.t1, self.group)
+            self._observe(node)
+            if node.name == until:
+                break
+        return self.results()
+
+    def close_denoise(self, t1: Optional[float] = None) -> None:
+        """Close this group's async denoise window (GraphRunner calls
+        this when the group's flush has materialized)."""
+        if self.open_denoise and self.clock is not None:
+            self.clock.end_denoise(self.group, t1)
+            self.open_denoise = False
+
+    def _observe(self, node: StageNode) -> None:
+        secs = node.seconds()
+        if self.obs:
+            try:
+                from stable_diffusion_webui_distributed_tpu.obs import (
+                    prometheus as obs_prom,
+                )
+                from stable_diffusion_webui_distributed_tpu.obs import (
+                    spans as obs_spans,
+                )
+
+                obs_prom.observe_stage_graph(node.name, secs)
+                obs_spans.add_span(
+                    obs_spans.current(), f"stage.{node.name}", node.t0,
+                    secs, attrs={"group": str(self.group),
+                                 "graph": self.label},
+                    lane=LANES.get(node.name))
+            except Exception:  # noqa: BLE001 — obs stays best-effort
+                pass
+        if self.on_stage is not None:
+            try:
+                self.on_stage(node.name, secs)
+            except Exception:  # noqa: BLE001 — callbacks stay best-effort
+                pass
+
+
+class GraphRunner:
+    """Depth-limited FIFO in-flight window of per-group StageGraphs.
+
+    ``submit`` runs the graph's nodes NOW (device work inside them
+    dispatches async) and queues its ``flush`` — the host
+    materialization step — until more than ``depth`` groups are in
+    flight, so the newest group's device work always dispatches ahead of
+    an older group's blocking fetch (the same decode-trails-one-group
+    rule the serial loop and stage_pipeline use). ``drain`` flushes
+    everything in order: the interrupt/preempt seam.
+
+    Thread-safety: submit/drain may race (the engine's preempt protocol
+    drains from the dispatching thread while a cancel drains elsewhere);
+    flushes execute UNDER the runner lock so a racing drain can never
+    reorder or double-run a flush — gallery order is the invariant the
+    explorer harness checks.
+    """
+
+    def __init__(self, depth: int = 1,
+                 clock: Optional[OverlapClock] = None) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: _lock (_in_flight, flushed; flushes run under it)
+        self._in_flight: List[Tuple[StageGraph, Callable[[Dict[str, Any]],
+                                                         None]]] = []
+        self._depth = max(1, int(depth))
+        self._clock = clock
+        self.flushed = 0
+
+    def submit(self, graph: StageGraph,
+               flush: Callable[[Dict[str, Any]], None]) -> None:
+        graph.run()
+        with self._lock:
+            self._in_flight.append((graph, flush))
+            excess = len(self._in_flight) - self._depth
+        self._flush_n(excess)
+
+    def drain(self) -> None:
+        self._flush_n(None)
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._in_flight)
+
+    def _flush_n(self, k: Optional[int]) -> None:
+        """Flush up to ``k`` oldest graphs (None = everything). Each
+        pop+flush pair runs under the lock, so racing drains serialize
+        per item and can never reorder or double-run a flush; a
+        competitor that already emptied the window just ends this loop
+        early."""
+        done = 0
+        while k is None or done < k:
+            with self._lock:
+                if not self._in_flight:
+                    return
+                graph, flush = self._in_flight.pop(0)
+                t0 = time.perf_counter()
+                try:
+                    flush(graph.results())
+                finally:
+                    t1 = time.perf_counter()
+                    # the fetch returning is the proof the group's device
+                    # work is done — close its denoise window here, then
+                    # score the fetch interval against the OTHER open ones
+                    graph.close_denoise(t1)
+                    if self._clock is not None:
+                        self._clock.note_stage(t0, t1, graph.group)
+                    self.flushed += 1
+            done += 1

@@ -15,6 +15,13 @@ model is small enough to replicate, so stages split by MODEL (base |
 refiner), not by layer — no microbatch bubbles beyond the first/last
 group, and each mesh can still shard dp/tp internally.
 
+The hand-rolled ``in_flight`` list this module shipped with grew into
+``parallel/stage_graph.py``'s general N-node executor; each dispatch
+group is now an encode → denoise → refine :class:`~.stage_graph.StageGraph`
+and the decode-trails-one-group pacing is the
+:class:`~.stage_graph.GraphRunner`'s depth window (depth 1 reproduces the
+original schedule exactly; ``SDTPU_STAGE_DEPTH`` widens it).
+
 Scope: txt2img, fixed-grid samplers, no hires/inpaint/ControlNet (the
 config-#2 shape). Single-chip runs gain nothing (a device executes
 serially) — this exists for multi-chip meshes and is validated on the
@@ -28,23 +35,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from stable_diffusion_webui_distributed_tpu.parallel import stage_graph
+from stable_diffusion_webui_distributed_tpu.parallel.stage_graph import (
+    to_mesh as _to_mesh,  # noqa: F401 — long-standing re-export
+)
 from stable_diffusion_webui_distributed_tpu.runtime import rng
 from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
-
-
-def _to_mesh(x, mesh, batch: bool):
-    """Commit ``x`` to ``mesh`` (dp-sharded batch dim when it divides,
-    replicated otherwise); None mesh = leave placement alone."""
-    from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
-        batch_sharding, replicated,
-    )
-
-    if mesh is None or x is None:
-        return x
-    dp = mesh.shape.get("dp", 1)
-    if batch and dp > 1 and x.shape[0] % dp == 0:
-        return jax.device_put(x, batch_sharding(mesh))
-    return jax.device_put(x, replicated(mesh))
 
 
 def pipelined_txt2img(base, refiner, payload, *, group_size: Optional[int] = None):
@@ -109,59 +105,78 @@ def pipelined_txt2img(base, refiner, payload, *, group_size: Optional[int] = Non
     group = max(1, group_size or payload.batch_size)
     total = payload.total_images
     pos = 0
-    pending = []   # (decode entries, already queued on base mesh)
-    in_flight = []  # (refined latents on refiner mesh, pos, n)
+    pending = []   # decode entries, already queued on base mesh
+    # depth 1 = the original schedule: the NEWEST group stays in flight so
+    # base(g+1) dispatches ahead of decode(g) on the base mesh's in-order
+    # stream (flushing eagerly would chain decode(g) behind refine(g) and
+    # re-serialize the stages)
+    runner = stage_graph.GraphRunner(depth=stage_graph.depth(),
+                                     clock=stage_graph.CLOCK)
 
-    def flush_one():
-        lat_r, p0, n0 = in_flight.pop(0)
-        lat_back = _to_mesh(lat_r, base.mesh, batch=True) \
-            if base.mesh is not None else jax.device_put(lat_r)
-        pending.extend(base._queue_decoded(lat_back, p0, n0,
-                                           width, height))
+    def make_flush(p0, n0):
+        def flush(res):
+            state, lat = res["refine"]
+            if state == "refined":
+                lat = _to_mesh(lat, base.mesh, batch=True) \
+                    if base.mesh is not None else jax.device_put(lat)
+            # "partial": base-half latents already on the base mesh — an
+            # interrupt skipped the refiner and they decode as-is
+            pending.extend(base._queue_decoded(lat, p0, n0,
+                                               width, height))
+            if len(pending) > 1:
+                base._flush_decoded(out, payload, pending[:-1])
+                del pending[:-1]
+        return flush
 
     while pos < total and not base.state.flag.interrupted:
         n = min(group, total - pos)
-        noise = rng.batch_noise(
-            payload.seed, payload.subseed, payload.subseed_strength,
-            pos, n, (h, w, C),
-            seed_resize=base._seed_resize_latent(payload),
-            pin_index=payload.same_seed)
-        x = base._place_batch(noise.astype(jnp.float32) * sigmas[0])
-        keys = base._image_keys(payload, pos, n)
-        # base half on mesh A — dispatched without host blocking
-        lat = base._denoise_range(
-            payload, x, keys, conds, pooleds, width, height, 0, steps,
-            "txt2img", None, None, (), end_step=switch, sync=False)
-        if base.state.flag.interrupted:
-            # like _split_denoise: an interrupt during the base half skips
-            # the refiner; the partial latents decode as-is. Drain the
-            # in-flight (earlier-index) refined groups FIRST so the gallery
-            # stays in global-index order — the interrupted group is the
-            # newest and must decode last.
-            while in_flight:
-                flush_one()
-            pending.extend(base._queue_decoded(lat, pos, n, width, height))
-            break
-        # hop to mesh B (async ICI copy; arguments may still be futures)
-        lat_b = _to_mesh(lat, rmesh, batch=True)
-        keys_b = _to_mesh(keys, rmesh, batch=True)
-        refined = refiner._denoise_range(
-            payload, lat_b, keys_b, ref_conds, ref_pooleds, width, height,
-            switch, steps, "txt2img+refiner", None, None, sync=False)
-        in_flight.append((refined, pos, n))
-        # decode trails one group behind — the NEWEST group stays in
-        # flight so base(g+1) dispatches ahead of decode(g) on the base
-        # mesh's in-order stream (draining it here would chain decode(g)
-        # behind refine(g) and re-serialize the stages)
-        while len(in_flight) > 1:
-            flush_one()
-        if len(pending) > 1:
-            base._flush_decoded(out, payload, pending[:-1])
-            pending = pending[-1:]
-        pos += n
+        graph = stage_graph.StageGraph(
+            label=f"base+refine[{pos}:{pos + n}]", group=pos,
+            clock=stage_graph.CLOCK)
 
-    while in_flight:
-        flush_one()
+        def _encode(p0=pos, n0=n):
+            noise = rng.batch_noise(
+                payload.seed, payload.subseed, payload.subseed_strength,
+                p0, n0, (h, w, C),
+                seed_resize=base._seed_resize_latent(payload),
+                pin_index=payload.same_seed)
+            x = base._place_batch(noise.astype(jnp.float32) * sigmas[0])
+            return x, base._image_keys(payload, p0, n0)
+
+        def _denoise(enc):
+            x, keys = enc
+            # base half on mesh A — dispatched without host blocking
+            lat = base._denoise_range(
+                payload, x, keys, conds, pooleds, width, height, 0, steps,
+                "txt2img", None, None, (), end_step=switch, sync=False)
+            return lat, keys
+
+        def _refine(den):
+            lat, keys = den
+            if base.state.flag.interrupted:
+                # like _split_denoise: an interrupt during the base half
+                # skips the refiner; the partial latents decode as-is
+                return ("partial", lat)
+            # hop to mesh B (async ICI copy; args may still be futures)
+            lat_b = _to_mesh(lat, rmesh, batch=True)
+            keys_b = _to_mesh(keys, rmesh, batch=True)
+            refined = refiner._denoise_range(
+                payload, lat_b, keys_b, ref_conds, ref_pooleds, width,
+                height, switch, steps, "txt2img+refiner", None, None,
+                sync=False)
+            return ("refined", refined)
+
+        graph.add("encode", _encode, kind="stage")
+        graph.add("denoise", _denoise, deps=("encode",), kind="denoise")
+        graph.add("refine", _refine, deps=("denoise",), kind="stage")
+        runner.submit(graph, make_flush(pos, n))
+        pos += n
+        if graph.node("refine").result[0] == "partial":
+            # drain in submit order so the gallery stays in global-index
+            # order — the interrupted group is the newest and decodes last
+            break
+
+    runner.drain()
     base._flush_decoded(out, payload, pending)
     base.state.finish()
     return out

@@ -35,6 +35,7 @@ from stable_diffusion_webui_distributed_tpu.models.configs import ModelFamily
 from stable_diffusion_webui_distributed_tpu.models.unet import (
     UNet,
     cache_supported,
+    control_residual_count,
     deep_cache_shape,
     make_added_cond,
 )
@@ -239,6 +240,9 @@ class Engine:
         # yield sees the same attribute and no-ops — so installation needs
         # no lock: only the gate-holding thread ever swaps it.
         self.preempt_hook = None
+        # stage-graph ControlNet slice (SDTPU_STAGE_CN_DEVICES): built on
+        # first use, cached per device count (_stage_cn_mesh)
+        self._stage_cn_mesh_cache = None
 
     # -- compiled stage factories ------------------------------------------
 
@@ -388,7 +392,7 @@ class Engine:
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
                          added_u, added_c, controls=(), total_steps=1,
                          inpaint_cond=None, unet=None, controlnet=None,
-                         ragged=None, lora=None):
+                         ragged=None, lora=None, residuals_in=None):
         """Closure: x0-prediction denoiser with classifier-free guidance and
         optional ControlNet residual injection.
 
@@ -409,7 +413,13 @@ class Engine:
         ``lora``: per-row [B, slots, ...] traced delta tree for the UNet
         component (models/lora.py) — doubled along the batch axis here so
         each image's adapter set rides both of its CFG rows; None (the
-        default trace) leaves the graph byte-identical."""
+        default trace) leaves the graph byte-identical.
+
+        ``residuals_in``: already-computed ControlNet residual tuple fed
+        in as a stage input (the stage-graph executor evaluates the
+        ControlNet tower one sigma-step ahead on its own mesh slice,
+        _denoise_range_staged_cn) — mutually exclusive with ``controls``;
+        None (the default trace) leaves the graph byte-identical."""
         unet = unet if unet is not None else self.unet
         controlnet = (controlnet if controlnet is not None
                       else self.controlnet_module)
@@ -439,7 +449,7 @@ class Engine:
                     jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
                 ])
 
-            residuals = None
+            residuals = residuals_in
             frac = (step.astype(jnp.float32) + 0.5) / total_steps
             for cn_params, hint, weight, g_start, g_end in controls:
                 gate = jnp.where(
@@ -2024,6 +2034,19 @@ class Engine:
 
         controls = self._prepare_controls(payload, width, height)
         refiner = self._refiner_engine(payload)
+        from stable_diffusion_webui_distributed_tpu.parallel import (
+            stage_graph,
+        )
+
+        if (stage_graph.enabled() and refiner is None
+                and not payload.enable_hr and not spec.adaptive):
+            # stage-graph executor (SDTPU_STAGE_GRAPH=1): byte-identical
+            # images — the graph only reorders host dispatch and the seed
+            # contract keys draws by global image index. Hires, refiner
+            # and adaptive keep the serial loop (multi-pass handoffs and
+            # host-driven step control don't decompose into fixed nodes).
+            return self._run_txt2img_staged(payload, start, count, job,
+                                            width, height, controls)
         # ragged solo dispatch (SDTPU_RAGGED): the bucketer stamped the
         # true requested shape; denoise at the bucket shape with the true
         # latent row count as traced data. Guarded by the same exclusions
@@ -2113,6 +2136,374 @@ class Engine:
             remaining -= n
         self._flush_decoded(out, payload, pending)
         return out
+
+    def _run_txt2img_staged(self, payload, start, count, job,
+                            width, height, controls) -> GenerationResult:
+        """Stage-graph txt2img executor (SDTPU_STAGE_GRAPH=1,
+        parallel/stage_graph.py): each dispatch group becomes an explicit
+        Encode -> Denoise -> Decode graph whose nodes dispatch async
+        (``sync=False``), with the flush (host materialization) deferred
+        through a depth-limited GraphRunner — group *i*'s VAE fetch and
+        group *i+1*'s CLIP encode overlap group *i+1*'s denoise on the
+        host timeline. ControlNet requests that qualify additionally run
+        the tower one sigma-step ahead (_denoise_range_staged_cn).
+
+        Byte-identity with the serial loop: noise/keys are keyed by
+        global image index, pad-and-drop uses the same bucket probe, and
+        decode order is FIFO (the runner's invariant) — only host pacing
+        changes. Preemption happens at GROUP boundaries here (the async
+        denoise loop never polls the hook): drain everything in flight,
+        yield, re-apply this request's adapters, restore the interrupt
+        latch — the same protocol the chunk loop runs mid-range."""
+        from stable_diffusion_webui_distributed_tpu.parallel import (
+            stage_graph,
+        )
+
+        h, w = self._latent_hw(width, height)
+        C = self.family.vae.latent_channels
+        spec = kd.resolve_sampler(payload.sampler_name)
+        sigmas = kd.build_sigmas(spec, self.schedule, payload.steps)
+        conds = pooleds = None
+        if not payload.all_prompts:
+            conds, pooleds = self.encode_prompts(payload)
+        out = GenerationResult(parameters=payload.model_dump())
+        group = max(1, payload.group_size or payload.batch_size)
+        runner = stage_graph.GraphRunner(depth=stage_graph.depth(),
+                                         clock=stage_graph.CLOCK)
+        # ControlNet-on-slice eligibility: the stage-ahead residual
+        # executable reproduces the in-chunk math only when the sampler
+        # makes exactly ONE denoise eval per step at (x_i, sigma_i), the
+        # step cache is off (cached chunks would diverge), no traced
+        # adapter deltas ride the chunk args, and the checkpoint isn't an
+        # inpainting hybrid. Everything else keeps CN inside the chunk
+        # executable — still dispatched async.
+        sc = stepcache.resolve(payload)
+        cn_staged = bool(controls) and spec.evals_per_step == 1 \
+            and not sc.active and self._traced_lora is None \
+            and not self.family.inpaint
+        pos = start
+        remaining = count
+        while remaining > 0 and not self.state.flag.interrupted:
+            hook = self.preempt_hook
+            if hook is not None and hook.should_yield():
+                # group-boundary yield: quiesce every in-flight graph
+                # (ordered flush keeps the gallery in index order), hand
+                # the device over, then restore this request's view
+                runner.drain()
+                interrupted_before_yield = self.state.flag.interrupted
+                hook.yield_device()
+                self._apply_prompt_loras(payload)
+                self.state.restore_interrupt(interrupted_before_yield)
+                continue
+            n = min(group, remaining)
+            gen_n = n
+            if n < group and self._has_batch_bucket(
+                    payload.sampler_name, payload.steps, width, height,
+                    group):
+                gen_n = group  # pad-and-drop, same probe as the serial loop
+            graph = stage_graph.StageGraph(
+                label=f"txt2img[{pos}:{pos + n}]", group=pos,
+                clock=stage_graph.CLOCK)
+
+            def encode_stage(p0=pos, g_n=gen_n):
+                if payload.all_prompts:
+                    c, pl, _ = self._group_conds(payload, p0, g_n, None)
+                    return c, pl
+                return conds, pooleds
+
+            def denoise_stage(cp, p0=pos, g_n=gen_n):
+                c, pl = cp
+                noise = rng.batch_noise(
+                    payload.seed, payload.subseed, payload.subseed_strength,
+                    p0, g_n, (h, w, C),
+                    seed_resize=self._seed_resize_latent(payload),
+                    pin_index=payload.same_seed)
+                x = self._place_batch(noise.astype(jnp.float32) * sigmas[0])
+                keys = self._image_keys(payload, p0, g_n)
+                if cn_staged:
+                    return self._denoise_range_staged_cn(
+                        payload, x, keys, c, pl, width, height,
+                        payload.steps, job, controls)
+                inp = (self._blank_inpaint_cond(g_n, width, height)
+                       if self.family.inpaint else None)
+                return self._denoise_range(
+                    payload, x, keys, c, pl, width, height, 0,
+                    payload.steps, job, None, None, controls,
+                    inpaint_cond=inp, sync=False)
+
+            def decode_stage(lat, p0=pos, keep=n):
+                return self._queue_decoded(lat, p0, keep, width, height)
+
+            graph.add("encode", encode_stage, kind="stage")
+            graph.add("denoise", denoise_stage, deps=("encode",),
+                      kind="denoise")
+            graph.add("decode", decode_stage, deps=("denoise",),
+                      kind="stage")
+            runner.submit(graph, flush=lambda res: self._flush_decoded(
+                out, payload, res["decode"]))
+            pos += n
+            remaining -= n
+        runner.drain()
+        return out
+
+    def _denoise_range_staged_cn(self, payload, x, image_keys, conds,
+                                 pooleds, width, height, steps, job,
+                                 controls):
+        """Denoise [0, steps) with the ControlNet tower evaluated one
+        sigma-step AHEAD of the UNet in its own executable — and, when
+        ``SDTPU_STAGE_CN_DEVICES`` carves a mesh slice, on its own
+        devices (models/unet.py takes the residual tuple as a stage
+        input via ``control_residuals``).
+
+        Bitwise equality with the in-executable path: residuals for step
+        *i* are computed from exactly the inputs the fused chunk uses —
+        ``carry.x`` at step *i*, ``sigmas[i]``, the same CFG doubling —
+        and unit gating replicates the serial loop's CHUNK-window drop
+        (a unit inactive for the whole chunk is absent, not zero-gated;
+        a zero-gated residual row could still flip -0.0 to +0.0 in the
+        skip adds). Eligibility is enforced by the caller
+        (_run_txt2img_staged): 1-eval-per-step samplers, no step cache,
+        no traced LoRA, no inpainting hybrid."""
+        (ctx_u, ctx_c) = conds
+        au, ac = self._added_cond(*pooleds, width, height)
+        batch = x.shape[0]
+        cfg = jnp.float32(payload.cfg_scale)
+        spec = kd.resolve_sampler(payload.sampler_name)
+        prec = precision_mod.resolve(payload, self.policy)
+        cn_mesh = self._stage_cn_mesh()
+        carry = kd.init_carry(x)
+        self.state.begin(job, steps)
+
+        # CN-side per-request constants hop to the slice once per range
+        cn_ctx_u, cn_ctx_c, cn_au, cn_ac = ctx_u, ctx_c, au, ac
+        cn_controls = controls
+        if cn_mesh is not None:
+            from stable_diffusion_webui_distributed_tpu.parallel import (
+                stage_graph,
+            )
+            from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+                replicated,
+            )
+
+            cn_controls = jax.device_put(controls, replicated(cn_mesh))
+            cn_ctx_u = stage_graph.to_mesh(ctx_u, cn_mesh, batch=False)
+            cn_ctx_c = stage_graph.to_mesh(ctx_c, cn_mesh, batch=False)
+            cn_au = stage_graph.to_mesh(au, cn_mesh, batch=False)
+            cn_ac = stage_graph.to_mesh(ac, cn_mesh, batch=False)
+
+        def active_idxs(chunk_pos):
+            # the serial loop drops units whose window misses the whole
+            # chunk — replicate per chunk window, not per step
+            length = min(self.chunk_size, steps - chunk_pos)
+            lo = (chunk_pos + 0.5) / steps
+            hi = (chunk_pos + length - 0.5) / steps
+            return tuple(k for k, c in enumerate(controls)
+                         if c[3] <= hi and c[4] >= lo)
+
+        def residuals_for(x_now, i):
+            idxs = active_idxs((i // self.chunk_size) * self.chunk_size)
+            if not idxs:
+                return None
+            resfn = self._cn_residual_fn(
+                payload.sampler_name, steps, width, height, batch,
+                len(idxs), prec.name)
+            x_cn = x_now
+            if cn_mesh is not None:
+                from stable_diffusion_webui_distributed_tpu.parallel import (
+                    stage_graph,
+                )
+
+                x_cn = stage_graph.to_mesh(x_now, cn_mesh, batch=True)
+            rs = resfn(x_cn, jnp.int32(i), cn_ctx_u, cn_ctx_c, cn_au,
+                       cn_ac, tuple(cn_controls[k] for k in idxs))
+            # Host-side stage-input check: the UNet's traced assert on
+            # residual arity only fires inside the step executable, long
+            # after the CN-slice dispatch — validate here instead.
+            want = control_residual_count(self.family.unet)
+            if len(rs) != want:
+                raise RuntimeError(
+                    f"controlnet residual stage input has {len(rs)} "
+                    f"tensors, UNet expects {want}")
+            if cn_mesh is not None:
+                from stable_diffusion_webui_distributed_tpu.parallel import (
+                    stage_graph,
+                )
+
+                # Hop back REPLICATED: when the residuals are computed
+                # on the engine mesh the jitted stage emits them with a
+                # replicated layout (the CFG doubling concat defeats
+                # batch-dim propagation), and the step executable is
+                # keyed on input shardings — handing it a batch-sharded
+                # copy would compile a second, differently-partitioned
+                # executable whose rounding breaks byte identity.
+                rs = tuple(
+                    stage_graph.to_mesh(r, self.mesh, batch=False)
+                    if self.mesh is not None else jax.device_put(r)
+                    for r in rs)
+            return rs
+
+        stepfn = self._cn_step_fn(payload.sampler_name, steps, width,
+                                  height, batch, prec.name)
+        dispatched = []
+        fences = []  # completed-dispatch fences; depth-2 host pacing
+        done = 0
+        res = residuals_for(carry.x, 0)
+        i = 0
+        while i < steps:
+            if self.state.flag.interrupted:
+                break
+            with trace.STATS.timer("denoise_chunk"), \
+                    trace.annotate(f"denoise[{i}:{i + 1}]"):
+                carry, fence = stepfn(
+                    self.params["unet"], carry, jnp.int32(i), ctx_u,
+                    ctx_c, cfg, image_keys, au, ac, res)
+            dispatched.append((i, 1, False))
+            fences.append(fence)
+            i += 1
+            if i < steps:
+                # one sigma-step ahead: step i's UNet is still running
+                # when step i's residual dispatch (for the NEXT step)
+                # enqueues on the slice — the towers overlap on silicon
+                res = residuals_for(carry.x, i)
+            while len(fences) > 2:
+                fences.pop(0).block_until_ready()
+                done += 1
+                self.state.step(done)
+        # NO final drain: like _denoise_range(sync=False), the tail
+        # steps stay in flight so the caller's decode dispatch — and the
+        # NEXT group's stages — overlap this group's denoise window on
+        # the host timeline. The depth-2 pacing above already bounds
+        # in-flight buffers; finish() only snapshots progress.
+        self.state.finish()
+        self._record_unet_flops(dispatched, 1, 0, spec.evals_per_step,
+                                steps, batch, x.shape[1], x.shape[2],
+                                ctx_c.shape[1], precision=prec.name)
+        return carry.x
+
+    def _cn_residual_fn(self, sampler_name: str, steps: int, width: int,
+                        height: int, batch: int, n_controls: int,
+                        precision: str) -> Callable:
+        """Compiled ControlNet residual stage: the EXACT CFG input build
+        and control loop from _make_denoise_fn, lifted into its own
+        executable so it can run a step ahead of (and on different
+        devices than) the UNet. Key family ``cnres`` is deliberately not
+        ``chunk``: obs/perf.py census_from_keys counts only chunk keys,
+        so the stage split can never fragment the chunk census
+        (bench_compare gates ``stage_graph_chunk_compiles`` at 0)."""
+        spec = kd.resolve_sampler(sampler_name)
+        prec = precision_mod.bucket_precision(
+            precision, self._default_precision.name)
+        _unet, cn_module = self._modules_for(prec)
+        key = ("cnres", sampler_name, steps, width, height, batch,
+               n_controls, self.family.name, prec)
+
+        def build():
+            sigmas = kd.build_sigmas(spec, self.schedule, steps)
+
+            def run_res(x, step, ctx_u, ctx_c, added_u, added_c, controls):
+                B = x.shape[0]
+                sigma = sigmas[step]
+                c_in = 1.0 / jnp.sqrt(sigma**2 + 1.0)
+                t = self.schedule.sigma_to_t(sigma)
+                xin = (x * c_in).astype(x.dtype)
+                both = batch_concat([xin, xin])
+                tb = jnp.full((2 * B,), t, jnp.float32)
+                ctx = batch_concat([
+                    jnp.broadcast_to(ctx_u, (B,) + ctx_u.shape[1:]),
+                    jnp.broadcast_to(ctx_c, (B,) + ctx_c.shape[1:]),
+                ])
+                added = None
+                if added_u is not None:
+                    added = batch_concat([
+                        jnp.broadcast_to(added_u, (B,) + added_u.shape[1:]),
+                        jnp.broadcast_to(added_c, (B,) + added_c.shape[1:]),
+                    ])
+                residuals = None
+                frac = (step.astype(jnp.float32) + 0.5) / steps
+                for cn_params, hint, weight, g_start, g_end in controls:
+                    gate = jnp.where(
+                        (frac >= g_start) & (frac <= g_end), weight, 0.0
+                    ).astype(jnp.float32)
+                    hint_b = jnp.broadcast_to(hint, (B,) + hint.shape[1:])
+                    hint2 = batch_concat([hint_b, hint_b])
+                    rs = cn_module.apply(
+                        {"params": cn_params}, both, tb, ctx, hint2, added)
+                    rs = tuple(r.astype(jnp.float32) * gate for r in rs)
+                    residuals = rs if residuals is None else tuple(
+                        a + b for a, b in zip(residuals, rs))
+                return residuals
+
+            return jax.jit(run_res)
+
+        return self._cached(key, build)
+
+    def _cn_step_fn(self, sampler_name: str, steps: int, width: int,
+                    height: int, batch: int, precision: str) -> Callable:
+        """One-sampler-step executable taking the ControlNet residual
+        tuple as a TRACED stage input (fed to models/unet.py via
+        ``control_residuals``). Same (carry, fence) contract as the chunk
+        executables — the carry is donated, the host paces on the fence.
+        ``cnstep`` is its own key family (never enters the chunk census);
+        the None-residual and tuple-residual pytrees retrace under one
+        cached wrapper, so at most two traces serve a range."""
+        spec = kd.resolve_sampler(sampler_name)
+        prec = precision_mod.bucket_precision(
+            precision, self._default_precision.name)
+        unet, cn_module = self._modules_for(prec)
+        key = ("cnstep", sampler_name, steps, width, height, batch,
+               self.family.name, prec)
+
+        def build():
+            sigmas = kd.build_sigmas(spec, self.schedule, steps)
+
+            def run_step(unet_params, carry, i, ctx_u, ctx_c, cfg,
+                         image_keys, added_u, added_c, residuals):
+                denoise = self._make_denoise_fn(
+                    unet_params, ctx_u, ctx_c, cfg, added_u, added_c,
+                    total_steps=steps, unet=unet, controlnet=cn_module,
+                    residuals_in=residuals)
+                base_step = kd.make_sampler_step(
+                    spec, denoise, sigmas, image_keys)
+                carry, _ = base_step(carry, i)
+                return carry, carry.x.reshape(-1)[:1]
+
+            return jax.jit(run_step, donate_argnums=(1,))
+
+        return self._cached(key, build)
+
+    def _stage_cn_mesh(self):
+        """Mesh slice for the stage-ahead ControlNet tower
+        (``SDTPU_STAGE_CN_DEVICES=N``): the last N visible devices OUTSIDE
+        the engine's mesh when that many are free, else the trailing N of
+        all devices. None when the knob is 0 or the slice would swallow
+        every device (the tower then shares the UNet's devices — still
+        correct, just no disaggregation win)."""
+        from stable_diffusion_webui_distributed_tpu.parallel import (
+            stage_graph,
+        )
+
+        n = stage_graph.cn_slice_devices()
+        if n <= 0:
+            return None
+        cached = self._stage_cn_mesh_cache
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        devs = list(jax.devices())
+        pool = devs
+        if self.mesh is not None:
+            used = {d.id for d in self.mesh.devices.flat}
+            free = [d for d in devs if d.id not in used]
+            if len(free) >= n:
+                pool = free
+        mesh = None
+        if len(pool) >= n and not (pool is devs and len(devs) <= n):
+            from stable_diffusion_webui_distributed_tpu.runtime.mesh import (
+                build_mesh,
+            )
+
+            mesh = build_mesh(f"dp={n}", devices=pool[-n:])
+        self._stage_cn_mesh_cache = (n, mesh)
+        return mesh
 
     def _refiner_engine(self, payload) -> Optional["Engine"]:
         if not payload.refiner_checkpoint or payload.refiner_switch_at >= 1.0:

@@ -43,7 +43,7 @@ import dataclasses
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -112,6 +112,11 @@ class Ticket:
         #: submitting thread's obs trace; the coalesce leader records
         #: queue-wait and mirrored device spans into it cross-thread
         self.obs_req = obs_spans.current()
+        #: per-stage completion callback (stage-graph mode): called as
+        #: ``on_stage(request_id, stage_name, seconds)`` after each of the
+        #: group's encode/denoise/decode/merge stages instead of one
+        #: blocking _execute_group return; best-effort, errors swallowed
+        self.on_stage: Optional[Callable[[str, str, float], None]] = None
 
 
 class _Group:
@@ -660,6 +665,7 @@ class ServingDispatcher:
                                      group=len(g.tickets),
                                      precision=str(g.key[-1]), **lora_cell)
             dsp = None
+            finalize = None
             wd = obs_watchdog.arm(
                 g.tickets[0].request_id, "dispatch.device",
                 self._dispatch_eta(g.tickets[0].run, g.images))
@@ -670,27 +676,64 @@ class ServingDispatcher:
                                     requests=len(g.tickets),
                                     precision=g.key[-1],
                                     **lora_cell) as dsp:
-                    self._execute_group(g)
+                    if self._stage_graph_on():
+                        # stage-graph mode: encode/denoise/decode dispatch
+                        # under the device lock; the returned finalize
+                        # (blocking fetch + merge) runs after release so
+                        # the next group's stages overlap it
+                        finalize = self._execute_group_staged(g)
+                    else:
+                        self._execute_group(g)
             except BaseException as e:  # noqa: BLE001 — delivered per ticket
+                finalize = None
                 for t in g.tickets:
                     if t.error is None and t.result is None:
                         t.error = e
             finally:
                 obs_watchdog.disarm(wd)
-                # leader/follower link: mirror the leader's device span
-                # into every follower's trace so a follower's tree shows
-                # where its wall-clock went
-                if dsp is not None and leader_req is not None:
-                    for t in g.tickets:
-                        if t.obs_req is not None \
-                                and t.obs_req is not leader_req:
-                            obs_spans.mirror_span(
-                                t.obs_req, "coalesced.dispatch", dsp,
-                                leader_request_id=leader_req.request_id,
-                                leader_span_id=dsp.span_id)
+                if finalize is None:
+                    self._finish_group(g, dsp, leader_req)
+        if finalize is not None:
+            # outside the device lock: group i's merge overlaps group
+            # i+1's encode/denoise on the host timeline; tickets complete
+            # only after their images actually materialized
+            try:
+                finalize()
+            except BaseException as e:  # noqa: BLE001 — delivered per ticket
                 for t in g.tickets:
-                    self._record_slo(t)
-                    t.done.set()
+                    if t.error is None and t.result is None:
+                        t.error = e
+            finally:
+                self._finish_group(g, dsp, leader_req)
+
+    def _finish_group(self, g: _Group, dsp, leader_req) -> None:
+        """Terminal bookkeeping for a dispatched group: mirror the
+        leader's device span into follower traces, record SLO samples,
+        and release every waiting ticket."""
+        # leader/follower link: mirror the leader's device span into
+        # every follower's trace so a follower's tree shows where its
+        # wall-clock went
+        if dsp is not None and leader_req is not None:
+            for t in g.tickets:
+                if t.obs_req is not None \
+                        and t.obs_req is not leader_req:
+                    obs_spans.mirror_span(
+                        t.obs_req, "coalesced.dispatch", dsp,
+                        leader_request_id=leader_req.request_id,
+                        leader_span_id=dsp.span_id)
+        for t in g.tickets:
+            self._record_slo(t)
+            t.done.set()
+
+    @staticmethod
+    def _stage_graph_on() -> bool:
+        """Gate probe for the stage-graph dispatch path (import is cheap:
+        parallel/stage_graph.py pulls no jax at module scope)."""
+        from stable_diffusion_webui_distributed_tpu.parallel import (
+            stage_graph,
+        )
+
+        return stage_graph.enabled()
 
     def _record_slo(self, ticket: Ticket) -> None:
         """Feed the perf ledger's per-(tenant, class) SLO attainment and
@@ -849,11 +892,98 @@ class ServingDispatcher:
     # -- merged execution --------------------------------------------------
 
     def _execute_group(self, g: _Group) -> None:
+        """Serial group execution: the four stages back-to-back on the
+        calling thread, byte-identical to the pre-stage-graph code (the
+        stages are the same statements, split at data-dependency seams)."""
+        built = self._group_build_inputs(g)
+        if built is None:
+            return
+        latents = self._group_denoise(g, built)
+        entries = self._group_decode(g, built, latents)
+        self._group_merge(g, built, entries)
+
+    def _execute_group_staged(self, g: _Group):
+        """Stage-graph group execution (SDTPU_STAGE_GRAPH): the same four
+        stages as explicit :class:`StageGraph` nodes. Encode, async
+        denoise dispatch, and decode dispatch run NOW (under the device
+        lock the caller holds); the returned finalize closure — the
+        blocking image fetch + per-ticket merge — runs after the caller
+        releases the device, so the next group's encode/denoise overlap
+        it on the host timeline. Per-stage completion fans out to every
+        ticket's ``on_stage`` callback as stages land."""
+        from stable_diffusion_webui_distributed_tpu.parallel import (
+            stage_graph,
+        )
+
+        leader_rid = g.tickets[0].request_id
+        graph = stage_graph.StageGraph(
+            label=f"group[{leader_rid}]", group=leader_rid,
+            clock=stage_graph.CLOCK, on_stage=self._stage_notifier(g))
+        # None flows through when every ticket cancelled before dispatch
+        # (build returns None): downstream nodes become no-ops, matching
+        # the serial path's early return
+        graph.add("encode", lambda: self._group_build_inputs(g),
+                  kind="stage")
+        graph.add("denoise",
+                  lambda built: None if built is None
+                  else self._group_denoise(g, built, sync=False),
+                  deps=("encode",), kind="denoise")
+        graph.add("decode",
+                  lambda built, latents: None if built is None
+                  else self._group_decode(g, built, latents),
+                  deps=("encode", "denoise"), kind="stage")
+        graph.add("merge",
+                  lambda built, entries: None if built is None
+                  else self._group_merge(g, built, entries),
+                  deps=("encode", "decode"), kind="stage")
+        graph.run(until="decode")
+
+        def finalize() -> None:
+            try:
+                graph.run()  # merge: np fetch blocks until device done
+            finally:
+                # fetch returned (or failed): the group's device work is
+                # over — close its denoise window, then ledger the
+                # per-group stage/overlap seconds
+                graph.close_denoise()
+                if obs_perf.enabled():
+                    try:
+                        lora_rb, lora_sc = int(g.key[-3]), int(g.key[-2])
+                        obs_perf.LEDGER.record_stages(
+                            bucket=f"{int(g.key[3])}x{int(g.key[4])}",
+                            cadence=int(g.key[8]),
+                            precision=str(g.key[-1]),
+                            lora=(f"r{lora_rb}s{lora_sc}"
+                                  if (lora_rb or lora_sc) else ""),
+                            stage_s=graph.stage_seconds(),
+                            overlap_s=graph.stage_overlap())
+                    except Exception:  # noqa: BLE001 — ledger best-effort
+                        pass
+
+        return finalize
+
+    def _stage_notifier(self, g: _Group):
+        """Per-stage completion fan-out: each finished stage calls every
+        ticket's ``on_stage(request_id, stage, seconds)``; best-effort —
+        a callback error never fails the group."""
+        def notify(stage: str, seconds: float) -> None:
+            for t in g.tickets:
+                cb = t.on_stage
+                if cb is not None:
+                    try:
+                        cb(t.request_id, stage, seconds)
+                    except Exception:  # noqa: BLE001 — callback isolation
+                        pass
+
+        return notify
+
+    def _group_build_inputs(self, g: _Group) -> Optional[Dict]:
+        """Encode stage: cancellation filter, per-ticket prompt encodes +
+        noise draws, batch concat, pad-and-drop, LoRA row stacking, and
+        the initial latent placement. Returns the denoise/decode/merge
+        inputs, or None when no ticket is still live."""
         import jax.numpy as jnp
 
-        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
-            GenerationResult,
-        )
         from stable_diffusion_webui_distributed_tpu.runtime import rng
         from stable_diffusion_webui_distributed_tpu.samplers import (
             kdiffusion as kd,
@@ -1004,6 +1134,33 @@ class ServingDispatcher:
                         lora_mod.stack_row_sets(row_sets, b_run)["unet"])
 
         x = engine._place_batch(noise.astype(jnp.float32) * sigmas[0])
+        return {
+            "live": live, "counts": counts, "rp": rp,
+            "width": width, "height": height, "h": h, "f": f,
+            "x": x, "keys": keys,
+            "ctx": (ctx_u, ctx_c), "pooled": (pooled_u, pooled_c),
+            "ragged": ragged_arg, "lora": lora_arg,
+            "ragged_mode": ragged_mode, "b_raw": b_raw, "b_run": b_run,
+            "true_rows": true_rows_l,
+            "true_tok": true_tok, "padded_tok": padded_tok,
+            "perf_on": perf_on, "traced_group": traced_group,
+            "lora_rb": lora_rb, "lora_sc": lora_sc,
+        }
+
+    def _group_denoise(self, g: _Group, built: Dict, *,
+                       sync: bool = True):
+        """Denoise stage: the single coalesced ``_denoise_range`` call
+        plus its perf-ledger record. ``sync=False`` (stage-graph mode)
+        returns as soon as the chunk executables are dispatched — the
+        ledger's device_s then measures dispatch host time, with the
+        stage-overlap columns carrying the pipelining story."""
+        engine = self.engine
+        live, counts, rp = built["live"], built["counts"], built["rp"]
+        width, height = built["width"], built["height"]
+        ctx_u, ctx_c = built["ctx"]
+        pooled_u, pooled_c = built["pooled"]
+        b_raw, b_run = built["b_raw"], built["b_run"]
+        perf_on = built["perf_on"]
         # perf ledger (SDTPU_PERF): host-observed denoise seconds joined
         # with the FLOPs delta the engine prices for this exact range —
         # passive perf_counter reads, no extra device syncs, and with the
@@ -1013,21 +1170,24 @@ class ServingDispatcher:
             flops0 = METRICS.unet_flops_snapshot()
             t0_dev = time.perf_counter()
         latents = engine._denoise_range(
-            rp, x, keys, (ctx_u, ctx_c), (pooled_u, pooled_c),
+            rp, built["x"], built["keys"], (ctx_u, ctx_c),
+            (pooled_u, pooled_c),
             width, height, 0, rp.steps, "txt2img", None, None, (),
-            ragged=ragged_arg, lora=lora_arg)
+            ragged=built["ragged"], lora=built["lora"], sync=sync)
         self._drain_cache_notes(live[0].request_id, embed=False)
         if perf_on:
             # masked pixels: resident tail rows the ragged kernel skips —
             # reported separately so padding attribution can split masked
             # residency from compute padding
             masked_px = 0
-            if ragged_mode:
-                masked_px = (h * b_run - sum(true_rows_l)) * f * width
+            if built["ragged_mode"]:
+                masked_px = (built["h"] * b_run
+                             - sum(built["true_rows"])) * built["f"] * width
             obs_perf.LEDGER.record_dispatch(
                 bucket=f"{width}x{height}", cadence=int(g.key[8]),
                 precision=str(g.key[-1]),
-                lora=(f"r{lora_rb}s{lora_sc}" if traced_group else ""),
+                lora=(f"r{built['lora_rb']}s{built['lora_sc']}"
+                      if built["traced_group"] else ""),
                 device_s=time.perf_counter() - t0_dev,
                 flops=METRICS.unet_flops_snapshot() - flops0,
                 requests=len(live), batch_raw=b_raw, batch_run=b_run,
@@ -1035,13 +1195,35 @@ class ServingDispatcher:
                                 for t, n_p in zip(live, counts)),
                 padded_pixels=width * height * b_run,
                 masked_pixels=masked_px,
-                true_tokens=true_tok, padded_tokens=padded_tok,
+                true_tokens=built["true_tok"],
+                padded_tokens=built["padded_tok"],
                 hbm=obs_tsdb.dispatch_memory_sample())
         elif obs_tsdb.enabled():
             # per-dispatch HBM watermark still lands in the TSDB series
             # even when the perf ledger is off
             obs_tsdb.dispatch_memory_sample()
-        entries = engine._queue_decoded(latents, 0, b_raw, width, height)
+        return latents
+
+    def _group_decode(self, g: _Group, built: Dict, latents):
+        """Decode stage: dispatch the VAE on the denoised latents. The
+        returned entries hold device arrays — nothing blocks here; the
+        merge stage's np fetch is the materialization point."""
+        return self.engine._queue_decoded(
+            latents, 0, built["b_raw"], built["width"], built["height"])
+
+    def _group_merge(self, g: _Group, built: Dict, entries) -> None:
+        """Merge stage: block on the decoded images, then split the
+        coalesced batch back into per-ticket results (bucket crops,
+        gallery assembly, journal records) and finish the progress
+        record."""
+        from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+            GenerationResult,
+        )
+
+        engine = self.engine
+        live, counts = built["live"], built["counts"]
+        b_raw, b_run = built["b_raw"], built["b_run"]
+        ragged_mode = built["ragged_mode"]
         imgs = np.concatenate(
             [np.asarray(e[0])[:e[2]] for e in entries], axis=0)
         jr_on = obs_journal.enabled()

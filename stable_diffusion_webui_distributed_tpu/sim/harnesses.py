@@ -25,10 +25,12 @@ Ground rules (these are load-bearing — see sim/sched.py):
   instance, and the notifier harness uses ``notify_transition``'s
   ``force=True`` seam instead of setting ``SDTPU_NOTIFY_URL`` (EV001).
 
-The four harnesses cover the four protocols the static tier reasons
-about: condition-variable handoff (FleetGate), two-lock leader/follower
+The harnesses cover the lock protocols the static tier reasons about:
+condition-variable handoff (FleetGate), two-lock leader/follower
 coalescing with cancellation (dispatcher), producer/drain-daemon
-shutdown (notifier), and daemon stop/restart (StoppableDaemon).
+shutdown (notifier), daemon stop/restart (StoppableDaemon), and the
+stage-graph runner's submit/drain FIFO with per-stage completion
+callbacks racing cancel and preempt (GraphRunner).
 """
 
 from __future__ import annotations
@@ -53,6 +55,7 @@ __all__ = [
     "fleet_gate_harness",
     "notifier_drain_harness",
     "run_harness",
+    "stage_graph_harness",
 ]
 
 
@@ -290,12 +293,88 @@ def daemon_restart_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
     return check
 
 
+# -- StageGraph/GraphRunner: submit vs preempt-drain vs cancel ---------------
+
+def stage_graph_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
+    """A producer submits three encode→denoise→decode StageGraphs through
+    one GraphRunner while a preemptor drains mid-stream (the engine's
+    chunk-boundary yield runs drain() from a racing thread) and a
+    canceller stops the producer between submissions (the interrupt
+    seam). Whatever the interleaving: each submitted group's per-stage
+    completion callbacks fire in dependency order, every submitted group
+    flushes exactly once in submission (FIFO = gallery) order, nothing
+    stays in flight, and every denoise window is closed."""
+    from ..parallel import stage_graph
+
+    # fresh objects: the module-level CLOCK's lock was born raw at import
+    clock = stage_graph.OverlapClock()
+    runner = stage_graph.GraphRunner(depth=1, clock=clock)
+    stages: List[tuple] = []   # (group, stage) completion log
+    flushes: List[int] = []    # group ids in flush order
+    submitted: List[int] = []
+    cancel = threading.Event()  # post-install: cooperative wait
+
+    def make_graph(gid: int):
+        g = stage_graph.StageGraph(
+            label=f"g{gid}", group=gid, clock=clock,
+            on_stage=lambda name, secs, gid=gid: stages.append((gid, name)),
+            obs=False)
+        g.add("encode", lambda gid=gid: f"enc{gid}", kind="stage")
+        g.add("denoise", lambda e, gid=gid: f"lat{gid}",
+              deps=("encode",), kind="denoise")
+        g.add("decode", lambda e, lat, gid=gid: f"img{gid}",
+              deps=("encode", "denoise"), kind="stage")
+        return g
+
+    def producer() -> None:
+        for gid in range(3):
+            if cancel.is_set():
+                break
+            submitted.append(gid)
+            runner.submit(make_graph(gid),
+                          lambda res, gid=gid: flushes.append(gid))
+        runner.drain()
+
+    def preemptor() -> None:
+        runner.drain()
+
+    def canceller() -> None:
+        cancel.set()
+
+    ex.spawn(producer, "producer")
+    ex.spawn(preemptor, "preempt-drain")
+    ex.spawn(canceller, "cancel")
+
+    def check() -> List[str]:
+        out: List[str] = []
+        for gid in submitted:
+            order = [s for g, s in stages if g == gid]
+            if order != ["encode", "denoise", "decode"]:
+                out.append(f"group {gid} stage callbacks out of order: "
+                           f"{order}")
+        if flushes != submitted:
+            out.append(f"flush order {flushes} != submit order {submitted}")
+        if runner.in_flight():
+            out.append(f"{runner.in_flight()} graphs left in flight")
+        if runner.flushed != len(submitted):
+            out.append(f"flushed {runner.flushed} != "
+                       f"submitted {len(submitted)}")
+        with clock._lock:
+            left_open = len(clock._open)
+        if left_open:
+            out.append(f"{left_open} denoise windows left open")
+        return out
+
+    return check
+
+
 HARNESSES: Dict[str, Callable[["sched.Explorer"],
                               Optional[Callable[[], List[str]]]]] = {
     "fleet_gate": fleet_gate_harness,
     "dispatcher_coalesce": dispatcher_coalesce_harness,
     "notifier_drain": notifier_drain_harness,
     "daemon_restart": daemon_restart_harness,
+    "stage_graph": stage_graph_harness,
 }
 
 

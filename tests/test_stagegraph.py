@@ -1,0 +1,230 @@
+"""Stage-graph executor (SDTPU_STAGE_GRAPH, parallel/stage_graph.py).
+
+The contract under test is byte-identity: the executor only reorders
+HOST work (async dispatch, deferred flushes, the ControlNet tower one
+sigma-step ahead on its own executable/mesh slice) — images, seeds and
+infotexts must match the serial path bit for bit, gate on or off, solo
+or coalesced, preempted or not.  The gate-off path is additionally
+hash-pinned through tests/goldens.json so a refactor of the staged code
+can never silently move the default path.
+"""
+
+import threading
+
+import jax
+import pytest
+
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.parallel import stage_graph
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.mesh import build_mesh
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+from test_goldens import _check, _controlnet_params, _hint_b64
+from test_pipeline import init_params
+
+
+def payload(**kw):
+    defaults = dict(prompt="a stage cow", steps=4, width=32, height=32,
+                    seed=7, sampler_name="Euler a")
+    defaults.update(kw)
+    return GenerationPayload(**defaults)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(TINY, init_params(TINY), chunk_size=4,
+                  state=GenerationState(),
+                  controlnet_provider=lambda name: _controlnet_params())
+
+
+class TestGateOff:
+    def test_gate_off_golden_pin(self, engine):
+        """SDTPU_STAGE_GRAPH=0 (the default) is hash-pinned: the staged
+        executor landing must leave the serial path byte-identical, and
+        every later PR inherits the pin."""
+        p = payload(prompt="stage graph pin", seed=77, n_iter=2)
+        _check("stagegraph/gate-off", engine.txt2img(p))
+
+
+class TestStagedByteIdentity:
+    def test_multi_group_matches_serial(self, engine, monkeypatch):
+        p = payload(seed=81, n_iter=3)
+        serial = engine.txt2img(p)
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        staged = engine.txt2img(p)
+        assert staged.images == serial.images  # pixel bytes
+        assert staged.seeds == serial.seeds
+        assert staged.infotexts == serial.infotexts
+
+    def test_depth_two_matches_serial(self, engine, monkeypatch):
+        """A wider flush window reorders more host work — never pixels."""
+        p = payload(seed=82, n_iter=3)
+        serial = engine.txt2img(p)
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        monkeypatch.setenv("SDTPU_STAGE_DEPTH", "2")
+        staged = engine.txt2img(p)
+        assert staged.images == serial.images
+
+    def test_dispatcher_coalesced_groups_match_serial(self, engine,
+                                                      monkeypatch):
+        """Coalesced dispatcher groups through the per-stage completion
+        path (_execute_group_staged): same bytes as gate-off serial
+        submission of the same payloads."""
+        bucketer = ShapeBucketer(shapes=[(32, 32)], batches=[2])
+        payloads = [payload(prompt=f"stage cow {i % 2}", seed=200 + i)
+                    for i in range(4)]
+        serial = ServingDispatcher(engine, bucketer=bucketer, window=0.0)
+        baseline = [serial.submit(p) for p in payloads]
+
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        coalesced = ServingDispatcher(engine, bucketer=bucketer,
+                                      window=0.6)
+        results = [None] * 4
+        errors = []
+
+        def run(i, p):
+            try:
+                results[i] = coalesced.submit(p)
+            except Exception as e:  # noqa: BLE001 — surfaced by assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i, p))
+                   for i, p in enumerate(payloads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for got, want in zip(results, baseline):
+            assert got.seeds == want.seeds
+            assert got.infotexts == want.infotexts
+            assert got.images == want.images
+
+    def test_preempt_mid_graph_resume(self, engine, monkeypatch):
+        """A device yield between staged groups (the runner drains, the
+        interloper runs re-entrantly, the request resumes) changes no
+        bytes on either side."""
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        batch_p = payload(seed=70, n_iter=3)
+        inter_p = payload(seed=71)
+        baseline = engine.txt2img(batch_p)
+        inter_base = engine.txt2img(inter_p)
+
+        class OneShotHook:
+            polls = 0
+            fired = 0
+            result = None
+
+            def should_yield(self):
+                self.polls += 1
+                return self.fired == 0 and self.polls >= 2
+
+            def yield_device(self):
+                self.fired += 1
+                self.result = engine.txt2img(inter_p)
+
+        hook = OneShotHook()
+        engine.preempt_hook = hook
+        try:
+            resumed = engine.txt2img(batch_p)
+        finally:
+            engine.preempt_hook = None
+        assert hook.fired == 1
+        assert resumed.images == baseline.images
+        assert hook.result.images == inter_base.images
+
+
+class TestControlNetStage:
+    def _cn_payload(self, **kw):
+        # a full-window unit plus a WINDOWED one: the stage-ahead
+        # residual executable must replicate the serial loop's
+        # chunk-window unit drop (steps=6, chunk=4 -> the windowed unit
+        # is live in chunk 0 and absent — not zero-gated — in chunk 1)
+        units = [
+            {"enabled": True, "image": _hint_b64(), "module": "canny",
+             "model": "gold-cn", "weight": 1.0},
+            {"enabled": True, "image": _hint_b64(), "module": "none",
+             "model": "gold-cn", "weight": 0.7,
+             "guidance_start": 0.0, "guidance_end": 0.3},
+        ]
+        defaults = dict(prompt="staged control", steps=6, width=32,
+                        height=32, seed=46, sampler_name="Euler a",
+                        alwayson_scripts={"controlnet": {"args": units}})
+        defaults.update(kw)
+        return GenerationPayload(**defaults)
+
+    def test_stage_ahead_matches_in_executable(self, engine, monkeypatch):
+        p = self._cn_payload(n_iter=2)
+        serial = engine.txt2img(p)
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        staged = engine.txt2img(p)
+        assert staged.images == serial.images
+
+    def test_two_eval_sampler_keeps_cn_in_chunk(self, engine, monkeypatch):
+        """Heun makes two UNet evals per step — stage-ahead residuals
+        cannot reproduce the second eval's inputs, so the staged path
+        must keep ControlNet inside the chunk executable (and still
+        match serial bytes)."""
+        p = self._cn_payload(sampler_name="Heun", seed=47)
+        serial = engine.txt2img(p)
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        staged = engine.txt2img(p)
+        assert staged.images == serial.images
+
+    @pytest.mark.skipif(len(jax.devices()) < 4,
+                        reason="needs >=4 devices for a disjoint slice")
+    def test_cn_mesh_slice_matches(self, monkeypatch):
+        """ControlNet on its own mesh slice (SDTPU_STAGE_CN_DEVICES):
+        residuals hop back to the UNet mesh as stage inputs — bytes
+        unchanged vs the in-executable path on the same dp=2 mesh."""
+        mesh = build_mesh("dp=2", devices=jax.devices()[:2])
+        eng = Engine(TINY, init_params(TINY), chunk_size=4,
+                     state=GenerationState(), mesh=mesh,
+                     controlnet_provider=lambda name: _controlnet_params())
+        p = self._cn_payload(batch_size=2)
+        serial = eng.txt2img(p)
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        monkeypatch.setenv("SDTPU_STAGE_CN_DEVICES", "2")
+        staged = eng.txt2img(p)
+        assert staged.images == serial.images
+
+
+class TestInterruptDrain:
+    def test_interrupt_drains_in_flight_stages(self, engine, monkeypatch):
+        """An interrupt lands between staged groups: the loop stops
+        submitting, the runner drains EVERY in-flight graph (gallery
+        stays a byte-exact prefix in global-index order), and no denoise
+        window is left open on the clock."""
+        monkeypatch.setenv("SDTPU_STAGE_GRAPH", "1")
+        p = payload(seed=90, n_iter=3)
+        baseline = engine.txt2img(p)
+        assert len(baseline.images) == 3
+
+        flushes = []
+        orig = engine._flush_decoded
+
+        def flush_and_interrupt(out, pl, entries):
+            orig(out, pl, entries)
+            flushes.append(len(entries))
+            if len(flushes) == 1:
+                engine.state.flag.interrupt()
+
+        monkeypatch.setattr(engine, "_flush_decoded", flush_and_interrupt)
+        got = engine.txt2img(p)
+        # group 0 flushed (then the latch rose), group 1 was in flight
+        # and still drained; group 2 was never submitted
+        assert 0 < len(got.images) < 3
+        assert got.images == baseline.images[:len(got.images)]
+        with stage_graph.CLOCK._lock:
+            assert not stage_graph.CLOCK._open  # every window closed
