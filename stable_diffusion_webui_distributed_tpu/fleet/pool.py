@@ -1,0 +1,282 @@
+"""Warm engine pool: pre-initialized residents the autoscaler can act on.
+
+The autoscaler (``fleet/slices.py``) has emitted scale decisions into an
+audit ring since ISSUE 6d — but every entry read ``no_executor`` because
+acting on a decision meant eating a cold-start compile storm. With AOT
+artifacts (``serving/aot.py``) a fresh engine hydrates in seconds, so
+this module closes the loop: a :class:`WarmPool` of in-process engine
+*residents*, each built by a caller-supplied factory and warmed through
+the artifact store, with
+
+- **checkout routing** — the dispatcher borrows the least-loaded healthy
+  resident per execution (``Dispatcher(pool=...)``), so admitted
+  requests spread across residents the way the source paper's World/Job
+  ipm optimization spreads jobs across a heterogeneous worker pool;
+- **real executors** — :meth:`attach_autoscale` registers a hook that
+  turns ``up`` decisions into spawns and ``down`` decisions into
+  retirements, then upgrades the audit entry to ``executed`` / ``failed``
+  via ``AutoscaleEngine.record_execution``;
+- **healing** — a resident killed by a chaos fault (``sim/``) stops
+  taking checkouts immediately (requests already inflight on it finish
+  or fail on their own engine — never double-merge onto a replacement),
+  and :meth:`heal` spawns back to target size, timing the heal through
+  the ``sdtpu_cold_start_seconds`` histogram.
+
+Everything is in-process and synchronous — no daemon threads, no device
+assumptions — so the schedule explorer can drive spawn/teardown
+interleavings deterministically. Gated ``SDTPU_POOL`` (default off);
+knobs: ``SDTPU_POOL_SIZE`` (target residents, default 2),
+``SDTPU_POOL_COOLDOWN_S`` (min seconds between autoscale-driven
+spawn/retire executions, default 0).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from stable_diffusion_webui_distributed_tpu.runtime.config import (
+    env_flag, env_float, env_int,
+)
+
+DEFAULT_POOL_SIZE = 2
+
+
+def enabled() -> bool:
+    """Pool gate — re-read per call so tests/bench phases can flip it."""
+    return env_flag("SDTPU_POOL", False)
+
+
+class EngineResident:
+    """One pooled engine and its serving state.
+
+    States: ``ready`` (takes checkouts), ``dead`` (chaos-killed — takes
+    no new checkouts; its inflight work belongs to it alone), ``retired``
+    (scale-down — drains and drops). State flips are O(1) under the pool
+    lock; the engine itself is built and warmed outside it."""
+
+    def __init__(self, name: str, engine: Any, spawn_s: float) -> None:
+        self.name = name
+        self.engine = engine
+        self.spawn_s = spawn_s
+        self.state = "ready"
+        self.inflight = 0
+        self.checkouts_total = 0
+        self.spawned_at = time.time()
+
+
+class WarmPool:
+    """A fixed-target pool of engine residents with least-loaded checkout.
+
+    ``factory(name) -> engine`` builds one resident's engine; ``warm``
+    (optional, ``warm(engine)``) runs after construction — typically
+    ``serving.warmup.warmup_engine`` so the resident hydrates every
+    manifest cell before it ever sees traffic. Both run OUTSIDE the pool
+    lock; only the bookkeeping is serialized."""
+
+    def __init__(self, factory: Callable[[str], Any],
+                 size: Optional[int] = None,
+                 warm: Optional[Callable[[Any], Any]] = None,
+                 clock=time.monotonic) -> None:
+        self.factory = factory
+        self.warm = warm
+        self.size = max(1, env_int("SDTPU_POOL_SIZE", DEFAULT_POOL_SIZE)
+                        if size is None else int(size))
+        self.cooldown_s = env_float("SDTPU_POOL_COOLDOWN_S", 0.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._residents: Dict[str, EngineResident] = {}  # guarded-by: _lock
+        self._spawn_seq = 0  # guarded-by: _lock
+        self._last_exec = -1e18  # guarded-by: _lock (autoscale cooldown)
+        self._spawns_total = 0  # guarded-by: _lock
+        self._retires_total = 0  # guarded-by: _lock
+        self._kills_total = 0  # guarded-by: _lock
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _next_name(self) -> str:
+        with self._lock:
+            self._spawn_seq += 1
+            return f"resident-{self._spawn_seq}"
+
+    def spawn(self, name: Optional[str] = None) -> EngineResident:
+        """Build + warm one resident (outside the lock) and register it.
+        The build-to-ready wall time is the pool's cold start — it lands
+        in ``sdtpu_cold_start_seconds``, which is what the AOT bench
+        squeezes."""
+        name = name or self._next_name()
+        t0 = self._clock()
+        engine = self.factory(name)
+        if self.warm is not None:
+            self.warm(engine)
+        spawn_s = max(0.0, self._clock() - t0)
+        res = EngineResident(name, engine, spawn_s)
+        with self._lock:
+            self._residents[name] = res
+            self._spawns_total += 1
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+            prometheus as obs_prom,
+        )
+
+        obs_prom.observe_cold_start(spawn_s)
+        if obs_journal.enabled():
+            obs_journal.emit("pool_spawned", f"pool-{name}",
+                             spawn_s=round(spawn_s, 4))
+        return res
+
+    def kill(self, name: str) -> bool:
+        """Chaos entry point (``sim/``): the resident stops taking new
+        checkouts NOW. Work already inflight on it keeps its engine —
+        a request never re-runs on a replacement, so a heal can never
+        double-merge images."""
+        with self._lock:
+            res = self._residents.get(name)
+            if res is None or res.state != "ready":
+                return False
+            res.state = "dead"
+            self._kills_total += 1
+        return True
+
+    def retire_one(self) -> Optional[str]:
+        """Scale-down: mark the least-loaded ready resident retired (it
+        drains naturally; a retired resident with zero inflight is
+        dropped from the table). Refuses to retire the last ready one."""
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+        )
+
+        with self._lock:
+            ready = [r for r in self._residents.values()
+                     if r.state == "ready"]
+            if len(ready) <= 1:
+                return None
+            res = min(ready, key=lambda r: (r.inflight, r.name))
+            res.state = "retired"
+            self._retires_total += 1
+            if res.inflight == 0:
+                self._residents.pop(res.name, None)
+            name = res.name
+        if obs_journal.enabled():
+            obs_journal.emit("pool_retired", f"pool-{name}")
+        return name
+
+    def heal(self) -> List[str]:
+        """Spawn residents until the ready count reaches the target size
+        (the chaos scenario times this). Spawns run outside the lock,
+        one at a time — deterministic under the schedule explorer."""
+        spawned: List[str] = []
+        while True:
+            with self._lock:
+                ready = sum(1 for r in self._residents.values()
+                            if r.state == "ready")
+            if ready >= self.size:
+                return spawned
+            spawned.append(self.spawn().name)
+
+    # -- checkout routing -------------------------------------------------
+
+    def acquire(self) -> EngineResident:
+        """Least-loaded ready resident (ties break by name for
+        determinism); spawns synchronously when the pool is empty."""
+        while True:
+            with self._lock:
+                ready = [r for r in self._residents.values()
+                         if r.state == "ready"]
+                if ready:
+                    res = min(ready, key=lambda r: (r.inflight, r.name))
+                    res.inflight += 1
+                    res.checkouts_total += 1
+                    return res
+            # empty pool: build one (outside the lock), then retry the
+            # selection — a racing acquire may win it, which is fine
+            self.spawn()
+
+    def release(self, res: EngineResident) -> None:
+        with self._lock:
+            res.inflight = max(0, res.inflight - 1)
+            if res.state == "retired" and res.inflight == 0:
+                self._residents.pop(res.name, None)
+
+    # -- autoscale executor -----------------------------------------------
+
+    def attach_autoscale(self, autoscale) -> None:
+        """Wire an ``AutoscaleEngine``'s decisions to real capacity: up
+        spawns a resident, down retires one, and the decision's audit
+        entry is upgraded from ``no_executor`` to ``executed`` /
+        ``failed`` (detail says why — cooldown, last resident, error)."""
+
+        def execute(decision) -> None:
+            now = self._clock()
+            with self._lock:
+                if now - self._last_exec < self.cooldown_s:
+                    in_cooldown = True
+                else:
+                    in_cooldown = False
+                    self._last_exec = now
+            if in_cooldown:
+                autoscale.record_execution(decision, "failed", "cooldown")
+                return
+            try:
+                if decision.direction == "up":
+                    name = self.spawn().name
+                    autoscale.record_execution(
+                        decision, "executed", f"spawned {name}")
+                else:
+                    name = self.retire_one()
+                    if name is None:
+                        autoscale.record_execution(
+                            decision, "failed", "last ready resident")
+                    else:
+                        autoscale.record_execution(
+                            decision, "executed", f"retired {name}")
+            except Exception as exc:  # noqa: BLE001 — audit, don't raise
+                autoscale.record_execution(
+                    decision, "failed", f"{type(exc).__name__}: {exc}")
+
+        autoscale.add_hook(execute)
+
+    # -- introspection ----------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``/internal/status`` pool block."""
+        with self._lock:
+            residents = [
+                {"name": r.name, "state": r.state, "inflight": r.inflight,
+                 "checkouts_total": r.checkouts_total,
+                 "spawn_s": round(r.spawn_s, 4)}
+                for r in sorted(self._residents.values(),
+                                key=lambda r: r.name)
+            ]
+            return {
+                "enabled": enabled(),
+                "size": self.size,
+                "ready": sum(1 for r in self._residents.values()
+                             if r.state == "ready"),
+                "residents": residents,
+                "spawns_total": self._spawns_total,
+                "retires_total": self._retires_total,
+                "kills_total": self._kills_total,
+                "cooldown_s": self.cooldown_s,
+            }
+
+
+# -- module-level active pool (server/api.py reads it) -----------------------
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[WarmPool] = None  # guarded-by: _ACTIVE_LOCK
+
+
+def set_pool(pool: Optional[WarmPool]) -> None:
+    """Install ``pool`` as the process-wide warm pool (last one wins);
+    the deployment that builds the pool calls this so
+    ``/internal/status`` can report it."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = pool
+
+
+def get_pool() -> Optional[WarmPool]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE

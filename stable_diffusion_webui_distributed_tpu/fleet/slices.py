@@ -228,6 +228,10 @@ class AutoscaleEngine:
                 self._decisions.append(decision)
                 entry = dict(dataclasses.asdict(decision))
                 entry["decided_at"] = time.time()  # audit-log wall clock
+                # execution outcome: seeded "no_executor"; an attached
+                # executor (fleet/pool.py attach_autoscale) upgrades it
+                # to executed/failed via record_execution
+                entry["execution"] = {"outcome": "no_executor"}
                 self._audit.append(entry)
                 self._audit_total += 1
                 hooks = list(self._hooks)
@@ -235,6 +239,28 @@ class AutoscaleEngine:
                 hook(decision)
             out.append(decision)
         return out
+
+    def record_execution(self, decision: ScaleDecision, outcome: str,
+                         detail: str = "") -> bool:
+        """Upgrade a decision's audit entry with its execution outcome
+        (``executed`` / ``failed``) once an attached executor (the warm
+        pool) has actually spawned or retired capacity. Matches the most
+        recent still-``no_executor`` entry for this decision; returns
+        False if the ring has already evicted it."""
+        want = dataclasses.asdict(decision)
+        with self._lock:
+            for entry in reversed(self._audit):
+                if entry.get("execution", {}).get("outcome") \
+                        != "no_executor":
+                    continue
+                if all(entry.get(k) == v for k, v in want.items()):
+                    entry["execution"] = {
+                        "outcome": str(outcome),
+                        "detail": str(detail),
+                        "executed_at": time.time(),
+                    }
+                    return True
+        return False
 
     def history(self) -> List[ScaleDecision]:
         with self._lock:

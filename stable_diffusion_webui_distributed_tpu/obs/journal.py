@@ -85,6 +85,10 @@ EVENTS = frozenset({
     "notify_sent",
     "notify_failed",
     "federation_poll_failed",
+    # AOT artifact / warm-pool plane (serving/aot.py, fleet/pool.py)
+    "aot_fallback",
+    "pool_spawned",
+    "pool_retired",
 })
 
 DEFAULT_CAPACITY = 4096

@@ -132,6 +132,9 @@ class PerfLedger:
             = OrderedDict()  # guarded-by: _lock
         self._groups_evicted = 0  # guarded-by: _lock
         self._compiles: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
+        #: artifact deserializes, keyed like _compiles but never mixed in
+        #: (serving/aot.py record_compile(source="aot_load"))
+        self._aot_loads: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
         self._slo: "OrderedDict[Tuple[str, str], Dict[str, Any]]" \
             = OrderedDict()  # guarded-by: _lock
         self._slo_evicted = 0  # guarded-by: _lock
@@ -258,14 +261,22 @@ class PerfLedger:
         except Exception:  # noqa: BLE001 — telemetry must not fail dispatch
             pass
 
-    def record_compile(self, kind: str, seconds: float) -> None:
+    def record_compile(self, kind: str, seconds: float,
+                       source: str = "fresh_compile") -> None:
         """One compiled-stage build (``Engine._cached``); also feeds the
-        per-kind Prometheus compile-latency histogram."""
+        per-kind Prometheus compile-latency histogram. ``source`` splits
+        the accounting: ``fresh_compile`` is a real XLA build,
+        ``aot_load`` is an artifact deserialize (serving/aot.py) — the
+        two land in separate accumulators and separate Prometheus
+        families so MFU/ledger analysis never mistakes a 200ms hydration
+        for a compile."""
         if not enabled():
             return
         try:
+            aot = str(source) == "aot_load"
             with self._lock:
-                c = self._compiles.setdefault(
+                table = self._aot_loads if aot else self._compiles
+                c = table.setdefault(
                     str(kind), {"count": 0, "total_s": 0.0, "max_s": 0.0,
                                 "last_s": 0.0})
                 c["count"] += 1
@@ -276,7 +287,10 @@ class PerfLedger:
                 prometheus as obs_prom,
             )
 
-            obs_prom.observe_compile(str(kind), float(seconds))
+            if aot:
+                obs_prom.observe_aot_load(str(kind), float(seconds))
+            else:
+                obs_prom.observe_compile(str(kind), float(seconds))
         except Exception:  # noqa: BLE001 — telemetry must not fail compiles
             pass
 
@@ -412,8 +426,13 @@ class PerfLedger:
                       for k, g in self._groups.items()]
             slo = [self._slo_row(k, r) for k, r in self._slo.items()]
             compiles = {k: dict(c) for k, c in self._compiles.items()}
+            aot_loads = {k: dict(c) for k, c in self._aot_loads.items()}
             evicted, slo_evicted = self._groups_evicted, self._slo_evicted
             device_kind = self._device_kind or ""
+        # hit rate over the stage materializations this ledger saw:
+        # loads / (loads + fresh compiles); None until either happens
+        n_loads = sum(int(c["count"]) for c in aot_loads.values())
+        n_fresh = sum(int(c["count"]) for c in compiles.values())
         out = {
             "enabled": enabled(),
             "device_kind": device_kind,
@@ -421,6 +440,9 @@ class PerfLedger:
             "groups": groups,
             "groups_evicted": evicted,
             "compiles": compiles,
+            "aot_loads": aot_loads,
+            "aot_hit_rate": (n_loads / (n_loads + n_fresh)
+                             if (n_loads + n_fresh) else None),
             "slo": slo,
             "slo_evicted": slo_evicted,
             "slo_target": self.slo_target,
@@ -440,6 +462,7 @@ class PerfLedger:
         with self._lock:
             self._groups.clear()
             self._compiles.clear()
+            self._aot_loads.clear()
             self._slo.clear()
             self._groups_evicted = 0
             self._slo_evicted = 0

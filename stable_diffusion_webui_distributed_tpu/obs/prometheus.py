@@ -207,6 +207,10 @@ HISTOGRAMS: Dict[str, Histogram] = {
         "LoRA adapter activation latency: traced factor-set builds "
         "(SDTPU_LORA_TRACED, host-side padding/bucketing only — zero "
         "merges, zero recompiles) observed per build."),
+    "cold_start": Histogram(
+        "sdtpu_cold_start_seconds",
+        "Fresh-engine time to first served image (AOT bench and warm "
+        "pool spawns, serving/aot.py + fleet/pool.py)."),
 }
 
 #: StageStats stage name -> histogram key (stages not listed only appear as
@@ -245,12 +249,15 @@ def clear_histograms() -> None:
         _FLEET_QUEUE_WAIT.clear()
     with _COMPILE_LOCK:
         _COMPILE_LAT.clear()
+    with _AOT_LOAD_LOCK:
+        _AOT_LOAD_LAT.clear()
     with _STAGE_GRAPH_LOCK:
         _STAGE_GRAPH_LAT.clear()
     for c in FLEET_COUNTERS.values():
         c.clear()
     PRECISION_COUNTER.clear()
     LORA_SWITCH_COUNTER.clear()
+    AOT_COUNTER.clear()
     for c in WORKER_COUNTERS.values():
         c.clear()
     WATCHDOG_COUNTER.clear()
@@ -284,6 +291,34 @@ def observe_compile(kind: str, seconds: float) -> None:
                 labels=f'kind="{_label(kind)}"')
             _COMPILE_LAT[kind] = h
     h.observe(seconds)
+
+
+# -- AOT executable artifacts (serving/aot.py) -------------------------------
+
+_AOT_LOAD_LOCK = threading.Lock()
+#: per-stage-kind artifact-deserialize latency, created on first load.
+#: A SIBLING of sdtpu_compile_seconds, never the same family: MFU/ledger
+#: analysis must not mistake a 200ms deserialize for a real compile.
+_AOT_LOAD_LAT: Dict[str, Histogram] = {}  # guarded-by: _AOT_LOAD_LOCK
+
+
+def observe_aot_load(kind: str, seconds: float) -> None:
+    """One artifact deserialize's latency by stage kind (the cheap
+    hydration that replaces a fresh compile on an AOT hit)."""
+    with _AOT_LOAD_LOCK:
+        h = _AOT_LOAD_LAT.get(kind)
+        if h is None:
+            h = Histogram(
+                "sdtpu_aot_load_seconds",
+                "AOT artifact deserialize latency by stage kind.",
+                labels=f'kind="{_label(kind)}"')
+            _AOT_LOAD_LAT[kind] = h
+    h.observe(seconds)
+
+
+def observe_cold_start(seconds: float) -> None:
+    """One fresh engine's time-to-first-image (bench arms, pool spawns)."""
+    HISTOGRAMS["cold_start"].observe(seconds)
 
 
 # -- stage-graph executor (parallel/stage_graph.py) --------------------------
@@ -400,6 +435,21 @@ def count_lora_switch(mode: str, n: float = 1.0) -> None:
     """One adapter-set switch: ``mode`` is ``merged`` (host merge path)
     or ``traced`` (recompile-free traced path)."""
     LORA_SWITCH_COUNTER.inc(n, mode=mode)
+
+
+#: AOT artifact-store events by outcome: ``hit`` (executable
+#: deserialized), ``miss`` (no cell — fresh compile), ``saved`` (fresh
+#: compile persisted back), ``fallback`` (cell present but
+#: fingerprint-mismatched or corrupt — compiled instead, journaled as
+#: ``aot_fallback``). Fed by serving/aot.py through :func:`aot_count`.
+AOT_COUNTER = LabeledCounter(
+    "sdtpu_aot_total",
+    "AOT executable artifact events (SDTPU_AOT) by outcome.",
+    ("outcome",))
+
+
+def aot_count(outcome: str, n: float = 1.0) -> None:
+    AOT_COUNTER.inc(n, outcome=outcome)
 
 # -- scheduler tier (scheduler/worker.py health + obs/watchdog.py) -----------
 
@@ -813,6 +863,7 @@ def render() -> str:
 
     lines.extend(PRECISION_COUNTER.render())
     lines.extend(LORA_SWITCH_COUNTER.render())
+    lines.extend(AOT_COUNTER.render())
     for c in FLEET_COUNTERS.values():
         lines.extend(c.render())
     for c in WORKER_COUNTERS.values():
@@ -848,6 +899,10 @@ def render() -> str:
     with _COMPILE_LOCK:
         compile_hists = [_COMPILE_LAT[k] for k in sorted(_COMPILE_LAT)]
     for i, h in enumerate(compile_hists):
+        lines.extend(h.render(header=(i == 0)))
+    with _AOT_LOAD_LOCK:
+        aot_hists = [_AOT_LOAD_LAT[k] for k in sorted(_AOT_LOAD_LAT)]
+    for i, h in enumerate(aot_hists):
         lines.extend(h.render(header=(i == 0)))
     with _STAGE_GRAPH_LOCK:
         stage_hists = [_STAGE_GRAPH_LAT[k]

@@ -58,10 +58,16 @@ from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
     build_infotext,
     fix_seed,
 )
+from stable_diffusion_webui_distributed_tpu.obs import (
+    perf as obs_perf,
+    spans as obs_spans,
+)
 from stable_diffusion_webui_distributed_tpu.runtime import dtypes, rng, trace
 from stable_diffusion_webui_distributed_tpu.runtime import interrupt as interrupt_mod
 from stable_diffusion_webui_distributed_tpu.samplers import kdiffusion as kd
 from stable_diffusion_webui_distributed_tpu.samplers import schedules as sched
+from stable_diffusion_webui_distributed_tpu.serving import aot as aot_mod
+from stable_diffusion_webui_distributed_tpu.serving.metrics import METRICS
 
 
 class Engine:
@@ -246,14 +252,23 @@ class Engine:
 
     # -- compiled stage factories ------------------------------------------
 
-    def _cached(self, key: Tuple, build: Callable[[], Callable]) -> Callable:
-        from stable_diffusion_webui_distributed_tpu.serving.metrics import (
-            METRICS,
-        )
-
-        from stable_diffusion_webui_distributed_tpu.obs import (
-            perf as obs_perf, spans as obs_spans,
-        )
+    def _cached(self, key: Tuple, build: Callable[[], Callable],
+                static_argnums: Tuple[int, ...] = ()) -> Callable:
+        if aot_mod.enabled():
+            # AOT path (SDTPU_AOT): the cell is an AotFunction that
+            # deserializes a persisted executable per call signature
+            # before it ever compiles; compile/aot-load accounting moves
+            # to first-call-per-signature (serving/aot.py), where it can
+            # tell a 200ms artifact hydration from a real XLA compile.
+            with self._cache_lock:
+                fn = self._cache.get(key)
+                if fn is None:
+                    fn = aot_mod.AotFunction(
+                        key, build, static_argnums=static_argnums)
+                    self._cache[key] = fn
+                else:
+                    METRICS.record_cache_hit(key[0])
+            return fn
 
         with self._cache_lock:
             fn = self._cache.get(key)
@@ -387,7 +402,7 @@ class Engine:
             return jax.jit(encode, static_argnums=(4,))
 
         key = ("encode",) if not lora_sig else ("encode", lora_sig)
-        return self._cached(key, build)
+        return self._cached(key, build, static_argnums=(4,))
 
     def _make_denoise_fn(self, unet_tree, ctx_u, ctx_c, cfg_scale,
                          added_u, added_c, controls=(), total_steps=1,

@@ -357,6 +357,40 @@ def _logger():
 #   ControlNet mesh slice; stage-ahead residuals evaluate there and hop
 #   back to the UNet mesh as stage inputs. 0 keeps residuals on the
 #   engine mesh; values that would swallow every device fall back to 0.
+#
+# AOT artifact / warm-pool knobs (serving/aot.py, fleet/pool.py;
+# README "AOT artifacts & warm pools"):
+#
+# - ``SDTPU_AOT`` (flag, default off): AOT executable artifacts. On,
+#   every ``Engine._cached`` cell becomes a load-before-build
+#   dispatcher: the first call per concrete signature deserializes the
+#   stage's compiled executable from the artifact store instead of
+#   tracing + compiling, and a fresh compile (store miss) serializes
+#   its result back. Cells are keyed by the existing compile key + call
+#   signature + a jax/jaxlib/platform/device/topology fingerprint; a
+#   fingerprint mismatch or damaged artifact falls back to a fresh
+#   compile (journaled ``aot_fallback``) — never a wrong executable,
+#   never a crash. Off (the default) ``Engine._cached`` takes its
+#   pre-existing path byte-identically (golden-pinned in
+#   tests/test_aot.py).
+# - ``SDTPU_AOT_DIR`` (path, default ``~/.cache/sdtpu-aot``): artifact
+#   store root — a JSON manifest plus content-addressed ``*.aotx``
+#   files (inspect/verify with ``tools/aot_report.py``). Re-read per
+#   store access so tests and bench phases can repoint it.
+# - ``SDTPU_POOL`` (flag, default off): the warm engine pool
+#   (fleet/pool.py). On, a dispatcher constructed with ``pool=`` checks
+#   each execution out to the least-loaded ready resident; autoscale
+#   decisions attached via ``WarmPool.attach_autoscale`` spawn/retire
+#   residents for real and upgrade their ``/internal/autoscale`` audit
+#   entries from ``no_executor`` to ``executed``/``failed``. Off, the
+#   dispatcher runs every request on its own engine, unchanged.
+# - ``SDTPU_POOL_SIZE`` (int, default 2): the pool's target ready
+#   resident count — ``heal()`` spawns back up to it after a chaos
+#   kill or a crash.
+# - ``SDTPU_POOL_COOLDOWN_S`` (float seconds, default 0): minimum wall
+#   time between autoscale-driven spawn/retire executions; a decision
+#   landing inside the window records ``failed``/``cooldown`` in the
+#   audit ring instead of thrashing capacity.
 
 
 def read_env(name: str, default: str = "") -> str:

@@ -478,11 +478,22 @@ class ApiServer:
 
         obs = obs_spans.TRACER.summary()
         obs["flightrec_entries"] = len(flightrec.RECORDER)
+        # warm pool (SDTPU_POOL, fleet/pool.py): resident table when one
+        # is installed, a bare {"enabled": False} otherwise — so the
+        # block is always present and schema-stable
+        from stable_diffusion_webui_distributed_tpu.fleet import (
+            pool as fleet_pool,
+        )
+
+        active_pool = fleet_pool.get_pool()
+        pool_block = active_pool.summary() if active_pool is not None \
+            else {"enabled": fleet_pool.enabled()}
         return {
             "model": self.options.get("sd_model_checkpoint", ""),
             "workers": workers,
             "settings": settings,
             "serving": serving,
+            "pool": pool_block,
             "obs": obs,
             "progress": {
                 "job": p.job,

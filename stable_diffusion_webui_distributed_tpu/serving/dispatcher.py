@@ -132,8 +132,16 @@ class ServingDispatcher:
 
     def __init__(self, engine, bucketer: Optional[ShapeBucketer] = None,
                  window: Optional[float] = None, config=None,
-                 calibration=None) -> None:
+                 calibration=None, pool=None) -> None:
         self.engine = engine
+        # warm pool (SDTPU_POOL, fleet/pool.py): when attached, each
+        # leader/solo execution checks out the least-loaded healthy
+        # resident and runs on ITS engine; grouping/bucketing decisions
+        # keep reading self.engine (residents are factory-homogeneous).
+        # None (default): every self._engine() read resolves to
+        # self.engine and the dispatch path is unchanged.
+        self.pool = pool
+        self._exec_engine = threading.local()
         self.bucketer = bucketer or (
             ShapeBucketer.from_config(config) if config is not None
             else ShapeBucketer())
@@ -415,6 +423,36 @@ class ServingDispatcher:
                 payload.steps = decision.steps
         return pol.name
 
+    def _engine(self):
+        """The engine this thread should execute on: the pool resident
+        checked out for the current leader/solo execution, else the
+        primary. Pre-execution decisions (grouping, coalescability) read
+        ``self.engine`` directly — residents are factory-homogeneous, so
+        those answers are the same on every engine."""
+        return getattr(self._exec_engine, "engine", None) or self.engine
+
+    @contextlib.contextmanager
+    def _checkout_engine(self):
+        """Borrow a pool resident for one execution (SDTPU_POOL with a
+        pool attached; otherwise the primary engine and zero overhead).
+        The resident rides thread-local state so the nested device/
+        execute/finalize path — all on the leader's thread — resolves to
+        it through :meth:`_engine`."""
+        from stable_diffusion_webui_distributed_tpu.fleet import (
+            pool as fleet_pool,
+        )
+
+        if self.pool is None or not fleet_pool.enabled():
+            yield self.engine
+            return
+        res = self.pool.acquire()
+        self._exec_engine.engine = res.engine
+        try:
+            yield res.engine
+        finally:
+            self._exec_engine.engine = None
+            self.pool.release(res)
+
     @contextlib.contextmanager
     def _device(self, tickets: List[Ticket], images: int):
         """The engine-execution critical section.  Fleet off: the plain
@@ -438,7 +476,7 @@ class ServingDispatcher:
             pol, tenant=str(getattr(lead.payload, "tenant", "") or "default"),
             cost=max(1, images), request_id=lead.request_id)
         gate.acquire(entry)
-        engine = self.engine
+        engine = self._engine()
         prev = engine.preempt_hook
         hooked = False
         try:
@@ -631,6 +669,13 @@ class ServingDispatcher:
             return
         if self.window > 0:
             time.sleep(self.window)
+        with self._checkout_engine():
+            self._run_grouped_leader(g, key)
+
+    def _run_grouped_leader(self, g: _Group, key) -> None:
+        """The leader's execution: device section + (stage-graph mode)
+        the post-release finalize — both on this thread, both on the
+        engine :meth:`_checkout_engine` resolved."""
         with self._device(g.tickets, g.images):
             # close AFTER taking the engine: followers kept joining while
             # a previous batch held the device (continuous batching)
@@ -787,9 +832,14 @@ class ServingDispatcher:
             pass
 
     def _run_solo(self, ticket: Ticket) -> None:
+        with self._checkout_engine():
+            self._run_solo_inner(ticket)
+
+    def _run_solo_inner(self, ticket: Ticket) -> None:
+        engine = self._engine()
         with self._device([ticket], ticket.run.total_images):
             try:
-                self.engine.state.begin_request()
+                engine.state.begin_request()
                 if ticket.cancelled.is_set():
                     # cancelled before dispatch: record neither a queue
                     # wait nor a dispatch (queue-depth accounting fix)
@@ -828,7 +878,7 @@ class ServingDispatcher:
                 try:
                     with obs_spans.span("dispatch.device", requests=1,
                                         precision=prec, **lora_cell):
-                        result = self.engine.generate_range(
+                        result = engine.generate_range(
                             ticket.run, 0, None, ticket.job)
                 finally:
                     obs_watchdog.disarm(wd)
@@ -844,20 +894,20 @@ class ServingDispatcher:
                                 or ticket.run.batch_size)
                     full, rem = divmod(n_img, group)
                     n_run = n_img
-                    if rem and (full > 0 or self.engine._has_batch_bucket(
+                    if rem and (full > 0 or engine._has_batch_bucket(
                             ticket.run.sampler_name, ticket.run.steps,
                             ticket.run.width, ticket.run.height, group)):
                         n_run = (full + 1) * group
                     masked_px = 0
-                    wh = self.engine._ragged_plan(ticket.run)
+                    wh = engine._ragged_plan(ticket.run)
                     if wh is not None:
-                        f = self.engine.family.vae_scale_factor
+                        f = engine.family.vae_scale_factor
                         lat_h = ticket.run.height // f
                         tr = min(lat_h, -(-wh[1] // f))
                         masked_px = (lat_h - tr) * f \
                             * ticket.run.width * n_run
                     try:
-                        tok_t, tok_p = self.engine.request_token_stats(
+                        tok_t, tok_p = engine.request_token_stats(
                             ticket.run)
                     except Exception:  # noqa: BLE001 — telemetry passive
                         tok_t = tok_p = 0
@@ -989,7 +1039,7 @@ class ServingDispatcher:
             kdiffusion as kd,
         )
 
-        engine = self.engine
+        engine = self._engine()
         live = [t for t in g.tickets if not t.cancelled.is_set()]
         for t in g.tickets:
             if t not in live:
@@ -1154,7 +1204,7 @@ class ServingDispatcher:
         returns as soon as the chunk executables are dispatched — the
         ledger's device_s then measures dispatch host time, with the
         stage-overlap columns carrying the pipelining story."""
-        engine = self.engine
+        engine = self._engine()
         live, counts, rp = built["live"], built["counts"], built["rp"]
         width, height = built["width"], built["height"]
         ctx_u, ctx_c = built["ctx"]
@@ -1208,7 +1258,7 @@ class ServingDispatcher:
         """Decode stage: dispatch the VAE on the denoised latents. The
         returned entries hold device arrays — nothing blocks here; the
         merge stage's np fetch is the materialization point."""
-        return self.engine._queue_decoded(
+        return self._engine()._queue_decoded(
             latents, 0, built["b_raw"], built["width"], built["height"])
 
     def _group_merge(self, g: _Group, built: Dict, entries) -> None:
@@ -1220,7 +1270,7 @@ class ServingDispatcher:
             GenerationResult,
         )
 
-        engine = self.engine
+        engine = self._engine()
         live, counts = built["live"], built["counts"]
         b_raw, b_run = built["b_raw"], built["b_run"]
         ragged_mode = built["ragged_mode"]
@@ -1279,7 +1329,7 @@ class ServingDispatcher:
         orig = ticket.payload
         bw, bh = ticket.run.width, ticket.run.height
         crop = self.bucketer.crop_ragged \
-            if self.engine._ragged_plan(ticket.run) is not None \
+            if self._engine()._ragged_plan(ticket.run) is not None \
             else self.bucketer.crop
         for i, b64 in enumerate(result.images):
             arr = b64png_to_array(b64)
@@ -1295,6 +1345,6 @@ class ServingDispatcher:
                 else orig.prompt
             result.infotexts[i] = build_infotext(
                 orig, int(result.seeds[i]), int(result.subseeds[i]),
-                self.engine.model_name, orig.width, orig.height,
+                self._engine().model_name, orig.width, orig.height,
                 prompt_override=prompt_i) + suffix
         return result

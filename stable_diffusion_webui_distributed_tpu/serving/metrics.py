@@ -35,6 +35,10 @@ class DispatchMetrics:
             self.compiles: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
             #: stage-kind -> cache hits (stage already built)
             self.cache_hits: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+            #: stage-kind -> executables hydrated from AOT artifacts
+            #: (serving/aot.py; a load is NOT a compile — the cold-start
+            #: bench asserts compiles stay 0 while these climb)
+            self.aot_loads: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
             self.requests = 0  # guarded-by: _lock
             #: request shape already equal to its bucket
             self.bucket_hits = 0  # guarded-by: _lock
@@ -73,6 +77,10 @@ class DispatchMetrics:
     def record_cache_hit(self, kind: str) -> None:
         with self._lock:
             self.cache_hits[str(kind)] += 1
+
+    def record_aot_load(self, kind: str) -> None:
+        with self._lock:
+            self.aot_loads[str(kind)] += 1
 
     # -- dispatcher-side --------------------------------------------------
 
@@ -120,6 +128,10 @@ class DispatchMetrics:
         with self._lock:
             return self.compiles.get(kind, 0)
 
+    def aot_load_count(self, kind: str = "chunk") -> int:
+        with self._lock:
+            return self.aot_loads.get(kind, 0)
+
     def unet_flops_snapshot(self) -> float:
         """Current dispatched-FLOPs total; the perf ledger takes a delta
         around each device dispatch to attribute FLOPs per group."""
@@ -160,6 +172,7 @@ class DispatchMetrics:
             return {
                 "compiles": dict(self.compiles),
                 "cache_hits": dict(self.cache_hits),
+                "aot_loads": dict(self.aot_loads),
                 "requests": self.requests,
                 "bucket_hits": self.bucket_hits,
                 "bucket_misses": self.bucket_misses,

@@ -24,6 +24,14 @@ none) adds traced-LoRA ladder cells — e.g. ``r16s1,r32s2`` pre-builds
 the executables every adapter bucketed into those cells will share
 (models/lora.py ladder; under SDTPU_LORA_TRACED adapter CONTENT is a
 jit argument, so one all-zero stand-in set per cell covers all of them).
+
+Under ``SDTPU_AOT`` (serving/aot.py) the same sweep becomes a
+HYDRATION pass: every cell already present in the artifact manifest is
+deserialized instead of compiled (seconds, not minutes), only the
+missing cells pay a fresh compile, and each fresh compile back-fills
+the manifest — so the report's ``aot`` block shows loads climbing and
+``stage_builds`` shrinking toward zero as the store converges on the
+serving ladder.
 """
 
 from __future__ import annotations
@@ -115,7 +123,9 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
 
     precisions = _warmup_precisions()
     lora_cells = _warmup_lora_cells()
-    before = dict(METRICS.summary()["compiles"])
+    summary0 = METRICS.summary()
+    before = dict(summary0["compiles"])
+    aot_before = dict(summary0["aot_loads"])
     t0 = time.monotonic()
     warmed = []
     try:
@@ -139,10 +149,17 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
     finally:
         engine._warmup_lora = None
         engine._traced_lora = None
-    after = METRICS.summary()["compiles"]
+    summary1 = METRICS.summary()
+    after = summary1["compiles"]
     built = {k: after.get(k, 0) - before.get(k, 0)
              for k in after if after.get(k, 0) != before.get(k, 0)}
-    return {
+    aot_after = summary1["aot_loads"]
+    hydrated = {k: aot_after.get(k, 0) - aot_before.get(k, 0)
+                for k in aot_after
+                if aot_after.get(k, 0) != aot_before.get(k, 0)}
+    n_loads = sum(hydrated.values())
+    n_fresh = sum(built.values())
+    report = {
         "skipped": False,
         "buckets": warmed,
         "steps": steps,
@@ -153,3 +170,16 @@ def warmup_engine(engine, bucketer: Optional[ShapeBucketer] = None,
         "xla_cache_dir": active_cache,
         "wall_s": round(time.monotonic() - t0, 2),
     }
+    from stable_diffusion_webui_distributed_tpu.serving import aot as aot_mod
+
+    if aot_mod.enabled():
+        # hydration accounting: which cells came off disk vs paid a
+        # fresh compile (fresh ones back-filled the manifest above)
+        report["aot"] = {
+            "enabled": True,
+            "dir": aot_mod.default_dir(),
+            "hydrated": hydrated,
+            "hit_rate": (n_loads / (n_loads + n_fresh)
+                         if (n_loads + n_fresh) else None),
+        }
+    return report

@@ -56,6 +56,7 @@ __all__ = [
     "notifier_drain_harness",
     "run_harness",
     "stage_graph_harness",
+    "warm_pool_harness",
 ]
 
 
@@ -368,6 +369,76 @@ def stage_graph_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
     return check
 
 
+# -- WarmPool: checkout vs chaos-kill vs heal vs retire ----------------------
+
+def warm_pool_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
+    """Two borrowers check residents in and out of one WarmPool while a
+    chaos thread kills resident-1 then heals back to target size and a
+    fourth thread retires one resident (the autoscale down path).
+    Whatever the interleaving: every checkout gets an engine and is
+    balanced by a release, a retired resident never lingers once
+    drained, inflight counts return to zero, and the pool never drains
+    below one ready resident (retire_one refuses the last; kill is
+    followed by a heal)."""
+    from ..fleet import pool as fleet_pool
+
+    spawned = [0]
+
+    def factory(name: str) -> object:
+        spawned[0] += 1
+        return object()  # the protocol under test is bookkeeping-only
+
+    pool = fleet_pool.WarmPool(factory, size=2)
+    violations: List[str] = []
+
+    def borrower(tag: str) -> Callable[[], None]:
+        def body() -> None:
+            for _ in range(2):
+                res = pool.acquire()
+                if res.engine is None:
+                    violations.append(f"{tag} checked out a bare resident")
+                if res.inflight < 1:
+                    violations.append(
+                        f"{tag} acquired {res.name} with inflight "
+                        f"{res.inflight}")
+                pool.release(res)
+        return body
+
+    def chaos() -> None:
+        pool.kill("resident-1")
+        pool.heal()
+
+    def retirer() -> None:
+        pool.retire_one()
+
+    ex.spawn(borrower("borrower-a"), "borrower-a")
+    ex.spawn(borrower("borrower-b"), "borrower-b")
+    ex.spawn(chaos, "chaos-kill-heal")
+    ex.spawn(retirer, "retire")
+
+    def check() -> List[str]:
+        out = list(violations)
+        with pool._lock:
+            residents = list(pool._residents.values())
+        ready = 0
+        for r in residents:
+            if r.inflight != 0:
+                out.append(f"{r.name} left inflight={r.inflight}")
+            if r.state == "retired":
+                out.append(f"retired {r.name} leaked (drained but still "
+                           f"in the table)")
+            if r.state == "ready":
+                ready += 1
+        if ready < 1:
+            out.append("pool drained below one ready resident")
+        if spawned[0] != pool.summary()["spawns_total"]:
+            out.append(f"factory ran {spawned[0]} times but pool counted "
+                       f"{pool.summary()['spawns_total']} spawns")
+        return out
+
+    return check
+
+
 HARNESSES: Dict[str, Callable[["sched.Explorer"],
                               Optional[Callable[[], List[str]]]]] = {
     "fleet_gate": fleet_gate_harness,
@@ -375,6 +446,7 @@ HARNESSES: Dict[str, Callable[["sched.Explorer"],
     "notifier_drain": notifier_drain_harness,
     "daemon_restart": daemon_restart_harness,
     "stage_graph": stage_graph_harness,
+    "warm_pool": warm_pool_harness,
 }
 
 

@@ -355,7 +355,9 @@ class TestEndpoints:
         # panel consumers (and this repo's own tools) notice breakage
         out = call(server, "/internal/status")
         assert set(out) == {"model", "workers", "settings", "serving",
-                            "obs", "progress", "timings", "logs"}
+                            "pool", "obs", "progress", "timings", "logs"}
+        # no pool installed: the block is a bare gate echo
+        assert out["pool"] == {"enabled": False}
         assert set(out["progress"]) == {"job", "sampling_step",
                                         "sampling_steps", "fraction",
                                         "interrupted"}
@@ -433,6 +435,8 @@ class TestEndpoints:
             (d,) = out["decisions"]
             assert d["direction"] == "up" and d["slice_name"] == "s0"
             assert d["decided_at"] > 0      # wall clock for correlation
+            # no executor attached: the outcome field says so explicitly
+            assert d["execution"] == {"outcome": "no_executor"}
         finally:
             slices.set_autoscale(None)
 

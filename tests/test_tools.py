@@ -978,3 +978,73 @@ class TestFedReport:
         # a document that is neither summary nor snapshot
         (tmp_path / "other.json").write_text(json.dumps({"device": "cpu"}))
         assert fed_report.main([str(tmp_path / "other.json")]) == 2
+
+
+class TestAotReport:
+    """tools/aot_report.py: manifest rendering + divergence gate over
+    the AOT artifact store (serving/aot.py)."""
+
+    def _store(self, tmp_path):
+        from stable_diffusion_webui_distributed_tpu.serving import (
+            aot as aot_mod,
+        )
+
+        store = aot_mod.AotStore(str(tmp_path))
+        store.save("('chunk', 'k1')", "d0=f32[1]", "chunk", b"exe-one")
+        store.save("('encode', 'k2')", "d0=i32[77]", "encode", b"exe-two")
+        return store
+
+    def test_report_renders_cells_and_totals(self, tmp_path):
+        import aot_report
+
+        self._store(tmp_path)
+        report = aot_report.build_report(str(tmp_path))
+        assert report["ok"] and report["cell_count"] == 2
+        assert report["by_kind"]["chunk"]["cells"] == 1
+        assert report["total_bytes"] == len(b"exe-one") + len(b"exe-two")
+        assert all(c["fingerprint_match"] for c in report["cells"])
+        assert report["divergent"] == [] and report["orphans"] == []
+
+    def test_exit_codes_gate_divergence(self, tmp_path, capsys):
+        import aot_report
+
+        store = self._store(tmp_path)
+        assert aot_report.main(["--dir", str(tmp_path)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["cell_count"] == 2
+
+        # damage one artifact: content hash diverges -> rc 1
+        (cell,) = [c for c in store.manifest()["cells"].values()
+                   if c["kind"] == "chunk"]
+        (tmp_path / cell["file"]).write_bytes(b"bit-flipped")
+        assert aot_report.main(["--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+
+        # an unclaimed artifact on disk is divergence too
+        (tmp_path / cell["file"]).write_bytes(b"exe-one")
+        (tmp_path / "feedface.aotx").write_bytes(b"orphan")
+        assert aot_report.main(["--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+
+        assert aot_report.main(["--dir",
+                                str(tmp_path / "missing-root")]) == 2
+
+    def test_output_file_and_fingerprint_mismatch_note(self, tmp_path,
+                                                       capsys):
+        import aot_report
+        from stable_diffusion_webui_distributed_tpu.serving import (
+            aot as aot_mod,
+        )
+
+        alien = aot_mod.AotStore(
+            str(tmp_path), fingerprint={"jax": "elsewhere"})
+        alien.save("('chunk', 'k1')", "d0=f32[1]", "chunk", b"exe")
+        out_path = tmp_path / "aot.json"
+        assert aot_report.main(["--dir", str(tmp_path),
+                                "-o", str(out_path)]) == 0
+        capsys.readouterr()
+        report = json.loads(out_path.read_text())
+        # coherent store, but the cell was built on another runtime:
+        # the report flags it so an operator sees hydration will miss
+        assert report["ok"]
+        assert report["cells"][0]["fingerprint_match"] is False

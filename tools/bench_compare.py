@@ -105,6 +105,15 @@ THRESHOLDS = {
     # executables instead of replacing them with cnres/cnstep pairs
     "stage_overlap_ratio": ("down", "rel", 0.05),
     "stage_graph_chunk_compiles": ("up", "abs", 0.0),
+    # aot rows (bench.py run_aot): cold_start_seconds is the warm arm's
+    # time-to-first-image — it creeping UP means artifact hydration
+    # stopped replacing compiles; aot_hit_rate dropping means cells fell
+    # out of the manifest (fingerprint churn, serialization break); any
+    # fresh chunk compile on the warm arm or double-merged image in the
+    # pool-heal phase is a contract break at any count
+    "cold_start_seconds": ("up", "rel", 0.20),
+    "aot_hit_rate": ("down", "abs", 0.05),
+    "warm_fresh_chunk_compiles": ("up", "abs", 0.0),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back
