@@ -2691,6 +2691,293 @@ def run_federation(tiny):
     return out
 
 
+def run_obsplane(tiny):
+    """--obsplane: push-vs-poll control plane validation. Two stub
+    workers are fronted by in-process API servers; phase 1 drives the
+    *poll* prober at its natural cadence and samples per-worker
+    staleness on a fast sidecar clock, phase 2 runs the *push* plane's
+    subscriber daemons (long-poll /internal/deltas) and samples the
+    same way — push staleness p95 must not exceed the poll baseline.
+    Mid-push a worker is chaos-killed and its API server shut down:
+    the stale alert must fire and land on the page-severity webhook
+    only, a synthetic warn probe must land on the warn webhook only
+    (the severity routing matrix), the delta streams must report zero
+    event loss, and the fleet-merged timeline must be causally clean
+    with the victim's lane present. Writes BENCH_obsplane.json + an
+    ``obsplane`` ledger row; tools/bench_compare.py zero-gates
+    push_event_loss and notify_misrouted and trend-gates
+    push_staleness_p95_s. CPU-safe."""
+    import http.server
+
+    from stable_diffusion_webui_distributed_tpu.obs import (
+        alerts as obs_alerts, federation as obs_federation,
+        fleetlog as obs_fleetlog, journal as obs_journal,
+        notify as obs_notify, prometheus as obs_prom,
+        push as obs_push, tsdb as obs_tsdb,
+    )
+    from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+        GenerationPayload,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel, env_int,
+    )
+    from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+        GenerationState,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend, StubBehavior, WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import World
+    from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+    from stable_diffusion_webui_distributed_tpu.sim import (
+        chaos as sim_chaos,
+    )
+
+    seed = env_int("SDTPU_SIM_SEED", 0)
+
+    def hook_server(bucket):
+        class _Hook(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    bucket.append(json.loads(self.rfile.read(n)))
+                except ValueError:
+                    bucket.append({"malformed": True})
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *args):  # keep bench stderr clean
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}/hook"
+
+    page_hits, warn_hits = [], []
+    page_srv, page_url = hook_server(page_hits)
+    warn_srv, warn_url = hook_server(warn_hits)
+
+    poll_cadence_s = 0.25    # a realistic scrape interval
+    sample_s = 0.02          # the staleness sidecar sampling clock
+    phase_s = 2.0
+
+    def sample_staleness(workers_fn, seconds):
+        out = []
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            obs_tsdb.tick()
+            for st in workers_fn().values():
+                out.append(float(st["staleness_s"]))
+            time.sleep(sample_s)
+        return out
+
+    try:
+        with _EnvPatch(SDTPU_SIM="1", SDTPU_JOURNAL="1",
+                       SDTPU_TSDB="1", SDTPU_ALERTS="1",
+                       SDTPU_TSDB_INTERVAL_S="0.05",
+                       SDTPU_ALERT_TIMESCALE="0.01",
+                       SDTPU_OBS_HTTP_TIMEOUT_S="2.0",
+                       SDTPU_PUSH_WAIT_S="0.05",
+                       SDTPU_NOTIFY_ROUTES=(f"page={page_url},"
+                                            f"warn={warn_url}")):
+            obs_prom.clear_histograms()
+            obs_tsdb.reset()
+            obs_alerts.reset()
+            obs_federation.reset()
+            obs_notify.reset()
+            obs_push.reset()
+            obs_fleetlog.reset()
+            obs_journal.JOURNAL.clear()
+
+            w = World(ConfigModel())
+            w.add_worker(WorkerNode(
+                "alpha",
+                StubBackend(StubBehavior(seconds_per_image=0.001)),
+                avg_ipm=2400.0))
+            w.add_worker(WorkerNode(
+                "victim",
+                StubBackend(StubBehavior(seconds_per_image=0.001)),
+                avg_ipm=2400.0))
+            servers = {}
+            for node in w.workers:
+                srv = ApiServer(w, state=GenerationState(),
+                                host="127.0.0.1", port=0).start()
+                node.backend.address = "127.0.0.1"
+                node.backend.port = srv.port
+                servers[node.label] = srv
+
+            # a little real traffic so both planes have counters to ship
+            w.execute(GenerationPayload(
+                prompt="obsplane steady", steps=8, width=512, height=512,
+                batch_size=4, seed=99, request_id="obsplane-000"))
+
+            # phase 1 — the poll baseline: the prober scrapes both
+            # workers over real HTTP on its cadence; staleness ramps to
+            # the cadence between scrapes, so its p95 ~= the cadence.
+            poll_samples = []
+            with _EnvPatch(SDTPU_FEDERATION="1"):
+                obs_federation.set_source(w)
+                t_end = time.monotonic() + phase_s
+                while time.monotonic() < t_end:
+                    obs_federation.tick()
+                    poll_samples.extend(sample_staleness(
+                        lambda: obs_federation.summary()["workers"],
+                        poll_cadence_s))
+                obs_federation.reset()
+
+            # phase 2 — push: subscriber daemons long-poll the delta
+            # endpoints; the anchor refreshes continuously, so the same
+            # sidecar sampler must see a lower p95.
+            push_samples = []
+            with _EnvPatch(SDTPU_PUSH="1"):
+                obs_push.set_source(w)
+                if not obs_push.start_daemons():
+                    raise RuntimeError("push daemons refused to start")
+                push_samples = sample_staleness(
+                    lambda: obs_push.summary()["workers"], phase_s)
+                steady_push = obs_push.summary()
+
+                # the chaos: kill the victim mid-request (requeued onto
+                # alpha), then its API server dies — the subscriber's
+                # long-polls fail, staleness crosses the deadline, and
+                # the page-severity stale alert must route to url1 only.
+                mark = len(obs_alerts.ENGINE.history())
+                plan = sim_chaos.ChaosPlan(
+                    [sim_chaos.Fault(kind="kill", worker="victim",
+                                     at_request=1)],
+                    seed=seed)
+                sim_chaos.arm(plan)
+                try:
+                    result = w.execute(GenerationPayload(
+                        prompt="obsplane kill", steps=8, width=512,
+                        height=512, batch_size=4, seed=99,
+                        request_id="obsplane-kill-001"))
+                finally:
+                    sim_chaos.disarm()
+                servers["victim"].stop()
+                time.sleep(max(0.3, obs_federation.stale_after_s()))
+                sample_staleness(
+                    lambda: obs_push.summary()["workers"], 1.0)
+                fired_kill = _alert_firings(
+                    obs_alerts.ENGINE.history(), mark)
+                # the warn lane of the routing matrix: a synthetic
+                # warn-severity transition must land on url2 only
+                obs_notify.notify_transition(
+                    "obsplane_warn_probe", "firing", 1.0,
+                    "severity routing probe", severity="warn")
+                flushed = obs_notify.flush(10.0)
+                push_summary = obs_push.summary()
+                timeline = obs_fleetlog.timeline()
+                by_channel = obs_notify.NOTIFIER.counts_by_channel()
+                obs_push.stop_daemons()
+
+            servers["alpha"].stop()
+            obs_journal.JOURNAL.clear()
+            obs_notify.reset()
+            obs_push.reset()
+            obs_fleetlog.reset()
+            obs_tsdb.reset()
+            obs_alerts.reset()
+    finally:
+        for srv in (page_srv, warn_srv):
+            srv.shutdown()
+            srv.server_close()
+
+    poll_p95 = _percentile(poll_samples, 0.95)
+    push_p95 = _percentile(push_samples, 0.95)
+    event_loss = push_summary["event_loss"]
+    # severity routing matrix: every page-hook body must carry a
+    # page-severity rule, every warn-hook body a warn one
+    page_rules = {"worker_metrics_stale", "fleet_error_rate",
+                  "watchdog_stall", "slo_burn_fast"}
+    misrouted = sum(1 for b in page_hits
+                    if b.get("rule") not in page_rules)
+    misrouted += sum(1 for b in warn_hits
+                     if b.get("rule") in page_rules)
+
+    if not flushed:
+        raise RuntimeError("notify queue did not drain within 10s")
+    if "worker_metrics_stale" not in fired_kill:
+        raise RuntimeError(
+            f"killed worker raised no worker_metrics_stale alert "
+            f"(kill-phase firings: {fired_kill})")
+    if not any(b.get("rule") == "worker_metrics_stale"
+               for b in page_hits):
+        raise RuntimeError(
+            f"stale page never reached the page webhook "
+            f"(page={page_hits}, warn={warn_hits})")
+    if not any(b.get("rule") == "obsplane_warn_probe"
+               for b in warn_hits):
+        raise RuntimeError(
+            f"warn probe never reached the warn webhook "
+            f"(warn={warn_hits})")
+    if misrouted:
+        raise RuntimeError(
+            f"severity routing crossed channels: {misrouted} misrouted "
+            f"(page={page_hits}, warn={warn_hits})")
+    if event_loss:
+        raise RuntimeError(
+            f"delta streams lost {event_loss} entries "
+            f"(workers: {push_summary['workers']})")
+    if push_p95 is not None and poll_p95 is not None \
+            and push_p95 > poll_p95:
+        raise RuntimeError(
+            f"push staleness p95 {push_p95:.3f}s worse than the poll "
+            f"baseline {poll_p95:.3f}s")
+    if timeline["violations"]:
+        raise RuntimeError(
+            f"fleet timeline has {timeline['violations']} causal-order "
+            f"violation(s)")
+    if not any(e["node"] == "victim" for e in timeline["events"]):
+        raise RuntimeError("victim's lane missing from the timeline")
+
+    out = {
+        "seed": seed,
+        "poll": {"staleness_p95_s": poll_p95,
+                 "samples": len(poll_samples),
+                 "cadence_s": poll_cadence_s},
+        "push": {"staleness_p95_s": push_p95,
+                 "samples": len(push_samples),
+                 "steady_summary": steady_push,
+                 "kill_summary": push_summary,
+                 "fired": fired_kill,
+                 "recovered_images": len(result.images)},
+        "routing": {"page_received": page_hits,
+                    "warn_received": warn_hits,
+                    "by_channel": by_channel,
+                    "misrouted": misrouted},
+        "timeline": {"count": timeline["count"],
+                     "violations": timeline["violations"],
+                     "nodes": timeline["nodes"]},
+        "tiny": bool(tiny),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_obsplane.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"bench: obsplane validation written to {path} "
+          f"(inspect the timeline with tools/fed_report.py --timeline)",
+          file=sys.stderr)
+
+    recorded_at = time.time()
+    row = _ledger_row("obsplane", {
+        "push_event_loss": event_loss,
+        "push_duplicates": push_summary["duplicates"],
+        "notify_misrouted": misrouted,
+        "push_staleness_p95_s": push_p95,
+        "poll_staleness_p95_s": poll_p95,
+    }, "stub", tiny, recorded_at)
+    lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LEDGER.jsonl")
+    with open(lpath, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"bench: obsplane ledger row appended to {lpath}",
+          file=sys.stderr)
+    return out
+
+
 def _ledger_row(kind, metrics, device, tiny, recorded_at):
     """One append-only BENCH_LEDGER.jsonl row. ``schema`` versions the row
     shape; ``metrics`` holds only platform-independent structural numbers
@@ -2884,6 +3171,14 @@ def main() -> None:
                          "webhook delivery to a local capture server; "
                          "writes BENCH_federation.json + a ledger row "
                          "(CPU-safe)")
+    ap.add_argument("--obsplane", action="store_true",
+                    help="push-vs-poll control plane validation: two "
+                         "API-fronted stub workers, poll-baseline then "
+                         "push-daemon staleness p95, chaos kill with "
+                         "severity-routed paging over two capture "
+                         "webhooks, zero delta-stream loss and a "
+                         "causally clean fleet timeline; writes "
+                         "BENCH_obsplane.json + a ledger row (CPU-safe)")
     ap.add_argument("--aot", action="store_true",
                     help="AOT-artifact cold-start bench: cold vs warm "
                          "engine over one SDTPU_AOT artifact store "
@@ -2943,6 +3238,8 @@ def main() -> None:
             print(json.dumps(run_alerts(tiny)))
         elif args.federation:
             print(json.dumps(run_federation(tiny)))
+        elif args.obsplane:
+            print(json.dumps(run_obsplane(tiny)))
         elif args.cache:
             print(json.dumps(run_cache(tiny)))
         elif args.lora:

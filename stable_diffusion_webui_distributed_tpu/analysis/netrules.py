@@ -1,10 +1,11 @@
-"""OB005: outbound-network calls in obs/ outside the sanctioned trio.
+"""OB005: outbound-network calls in obs/ outside the sanctioned set.
 
 The observability plane is read-mostly and passive by design — metrics,
-traces, journal, TSDB. Exactly three modules are allowed to speak to the
+traces, journal, TSDB. Exactly four modules are allowed to speak to the
 network: ``obs/stitch.py`` (remote trace fetch), ``obs/federation.py``
-(the fleet metrics prober), and ``obs/notify.py`` (webhook delivery).
-Each of those routes every call through the single
+(the fleet metrics prober), ``obs/notify.py`` (webhook delivery), and
+``obs/push.py`` (the delta-stream subscriber). Each of those routes
+every call through the single
 ``SDTPU_OBS_HTTP_TIMEOUT_S`` timeout knob and carries per-node fault
 isolation; an HTTP call sneaking into any *other* obs/ module bypasses
 both (an unbounded ``urlopen`` inside, say, the alert engine can hang
@@ -29,7 +30,8 @@ MARKER_PREFIX = "sdtpu-lint:"
 MARKER = "netcall"
 
 #: The obs/ modules allowed to make outbound network calls.
-SANCTIONED = ("obs/federation.py", "obs/notify.py", "obs/stitch.py")
+SANCTIONED = ("obs/federation.py", "obs/notify.py", "obs/push.py",
+              "obs/stitch.py")
 
 #: requests/Session HTTP verb method names.
 VERBS = frozenset({"get", "post", "put", "patch", "delete", "head",

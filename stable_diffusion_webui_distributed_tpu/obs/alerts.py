@@ -72,6 +72,12 @@ def timescale() -> float:
     return max(1e-6, env_float("SDTPU_ALERT_TIMESCALE", 1.0))
 
 
+#: The closed severity vocabulary. Routing (SDTPU_NOTIFY_ROUTES) keys on
+#: these literals, so a typo'd severity would silently never page —
+#: construction rejects it and OB004 flags the literal at lint time.
+SEVERITIES = frozenset({"page", "warn", "info"})
+
+
 @dataclasses.dataclass(frozen=True)
 class AlertRule:
     """One closed-registry alert rule.
@@ -83,7 +89,10 @@ class AlertRule:
     ``use_rate``), ``increase`` (windowed counter increase >=
     ``threshold``). ``for_count`` consecutive true evaluations gate
     pending -> firing. ``scale_up`` marks the rule as an autoscaler
-    scale-up signal."""
+    scale-up signal. ``severity`` routes the rule's notifications
+    (obs/notify.py SDTPU_NOTIFY_ROUTES): a closed set — ``page`` wakes
+    a human, ``warn`` is actionable during business hours, ``info`` is
+    context only — enforced here and at the AST level by OB004."""
 
     name: str
     kind: str                        # "burn_rate" | "anomaly" | "increase"
@@ -98,10 +107,15 @@ class AlertRule:
     warmup: int = 8
     min_value: float = 0.0
     scale_up: bool = False
+    severity: str = "warn"           # "page" | "warn" | "info"
 
     def __post_init__(self) -> None:
         if self.kind not in ("burn_rate", "anomaly", "increase"):
             raise ValueError(f"unknown alert-rule kind {self.kind!r}")
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown alert severity {self.severity!r} "
+                f"(expected one of {sorted(SEVERITIES)})")
 
 
 _REGISTRY_LOCK = threading.Lock()
@@ -134,42 +148,44 @@ register_rule(AlertRule(
     description="Fast SLO budget burn: 5m AND 1h windows both >= 14.4x "
                 "(exhausts a 30d budget in ~2 days).",
     windows_s=(300.0, 3600.0), threshold=FAST_BURN, for_count=1,
-    scale_up=True))
+    scale_up=True, severity="page"))
 register_rule(AlertRule(
     name="slo_burn_slow", kind="burn_rate", series="slo_burn.",
     description="Slow SLO budget burn: 1h AND 6h windows both >= 6x.",
     windows_s=(3600.0, 21600.0), threshold=SLOW_BURN, for_count=1,
-    scale_up=True))
+    scale_up=True, severity="warn"))
 register_rule(AlertRule(
     name="queue_wait_anomaly", kind="anomaly", series="queue_wait_p95_s",
     description="Queue-wait p95 running away from its EWMA baseline "
                 "(z-score with sustain requirement).",
     for_count=3, z=6.0, alpha=0.3, warmup=8, min_value=0.25,
-    scale_up=True))
+    scale_up=True, severity="warn"))
 register_rule(AlertRule(
     name="compile_rate_anomaly", kind="anomaly", series="compiles_total",
     description="Compile-storm detector: windowed stage-compile rate "
                 "z-scoring far above its EWMA baseline.",
     windows_s=(300.0, 3600.0), use_rate=True, for_count=2, z=6.0,
-    warmup=8, min_value=2.0))
+    warmup=8, min_value=2.0, severity="info"))
 register_rule(AlertRule(
     name="error_rate_anomaly", kind="anomaly",
     series="worker_failures_total",
     description="Worker-failure rate above its EWMA baseline (a healthy "
                 "fleet's failure counter is flat).",
     windows_s=(300.0, 3600.0), use_rate=True, for_count=1, z=6.0,
-    warmup=4, min_value=1e-6))
+    warmup=4, min_value=1e-6, severity="warn"))
 register_rule(AlertRule(
     name="worker_flap", kind="increase",
     series="worker_unavailable_total",
     description="Worker health flap: any UNAVAILABLE demotion inside "
                 "the fast window.",
-    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1))
+    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1,
+    severity="warn"))
 register_rule(AlertRule(
     name="watchdog_stall", kind="increase",
     series="watchdog_stalls_total",
     description="Hang-watchdog stall detections inside the fast window.",
-    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1))
+    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1,
+    severity="page"))
 register_rule(AlertRule(
     name="worker_metrics_stale", kind="increase",
     series="fleet/worker_stale_count",
@@ -177,13 +193,14 @@ register_rule(AlertRule(
                 "(no successful poll inside the freshness deadline) — "
                 "the worker is dead or partitioned. Dormant without "
                 "SDTPU_FEDERATION (series never recorded).",
-    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1))
+    windows_s=(300.0, 3600.0), threshold=1.0, for_count=1,
+    severity="page"))
 register_rule(AlertRule(
     name="fleet_error_rate", kind="anomaly", series="fleet/error_rate",
     description="Fleet-scope: federated mean worker error rate jumping "
                 "off its EWMA baseline (an unreachable worker counts as "
                 "1.0). Dormant without SDTPU_FEDERATION.",
-    for_count=1, z=6.0, warmup=4, min_value=0.1))
+    for_count=1, z=6.0, warmup=4, min_value=0.1, severity="page"))
 
 
 class AlertEngine:
@@ -365,7 +382,7 @@ class AlertEngine:
                 obs_journal.emit(event, f"alert-{rule.name}",
                                  rule=rule.name, kind=rule.kind,
                                  series=rule.series, value=value,
-                                 detail=detail)
+                                 severity=rule.severity, detail=detail)
         except Exception:  # noqa: BLE001 — telemetry stays passive
             pass
         try:
@@ -383,7 +400,8 @@ class AlertEngine:
                 notify as obs_notify,
             )
 
-            obs_notify.notify_transition(rule.name, event, value, detail)
+            obs_notify.notify_transition(rule.name, event, value, detail,
+                                         severity=rule.severity)
         except Exception:  # noqa: BLE001
             pass
         if firing:
@@ -489,7 +507,8 @@ def summary() -> Dict[str, Any]:
         "timescale": timescale(),
         "registered": {name: {"kind": r.kind, "series": r.series,
                               "description": r.description,
-                              "scale_up": r.scale_up}
+                              "scale_up": r.scale_up,
+                              "severity": r.severity}
                        for name, r in registered_rules().items()},
     }
     doc.update(ENGINE.state())

@@ -103,6 +103,31 @@ def _pollable(worker: Any) -> bool:
         and bool(getattr(backend, "port", None))
 
 
+def fetch_documents(backend: Any, clock=time.monotonic) -> Tuple[
+        Optional[str], Optional[Dict[str, Any]], float, float]:
+    """(metrics_text, tsdb_doc, t0, t1): one worker's scrape through one
+    bracketed fetch window. ``fed_fetch`` is the in-process seam the
+    bench/tests use; the HTTP path carries the obs-plane timeout on
+    every call so a hung worker cannot stall the caller. Shared by the
+    poll prober and the push plane's per-node poll fallback
+    (obs/push.py)."""
+    t0 = clock()
+    fetcher = getattr(backend, "fed_fetch", None)
+    if callable(fetcher):
+        metrics_text, tsdb_doc = fetcher()
+    else:
+        timeout = stitch.http_timeout_s()
+        scheme = "https" if getattr(backend, "tls", False) else "http"
+        base = f"{scheme}://{backend.address}:{backend.port}"
+        with urllib.request.urlopen(f"{base}/internal/metrics",
+                                    timeout=timeout) as resp:
+            metrics_text = resp.read().decode("utf-8", "replace")
+        with urllib.request.urlopen(f"{base}/internal/tsdb",
+                                    timeout=timeout) as resp:
+            tsdb_doc = json.loads(resp.read().decode("utf-8", "replace"))
+    return metrics_text, tsdb_doc, t0, clock()
+
+
 class FederationProber:
     """Per-worker poll state machine + TSDB series writer.
 
@@ -144,25 +169,8 @@ class FederationProber:
     def _fetch(self, backend: Any) -> Tuple[Optional[str],
                                             Optional[Dict[str, Any]],
                                             float, float]:
-        """(metrics_text, tsdb_doc, t0, t1): both documents through one
-        bracketed fetch window. ``fed_fetch`` is the in-process seam the
-        bench/tests use; the HTTP path carries the obs-plane timeout on
-        every call so a hung worker cannot stall the tick."""
-        t0 = self._clock()
-        fetcher = getattr(backend, "fed_fetch", None)
-        if callable(fetcher):
-            metrics_text, tsdb_doc = fetcher()
-        else:
-            timeout = stitch.http_timeout_s()
-            scheme = "https" if getattr(backend, "tls", False) else "http"
-            base = f"{scheme}://{backend.address}:{backend.port}"
-            with urllib.request.urlopen(f"{base}/internal/metrics",
-                                        timeout=timeout) as resp:
-                metrics_text = resp.read().decode("utf-8", "replace")
-            with urllib.request.urlopen(f"{base}/internal/tsdb",
-                                        timeout=timeout) as resp:
-                tsdb_doc = json.loads(resp.read().decode("utf-8", "replace"))
-        return metrics_text, tsdb_doc, t0, self._clock()
+        """One worker's scrape bracket; see :func:`fetch_documents`."""
+        return fetch_documents(backend, clock=self._clock)
 
     @staticmethod
     def _digest(metrics_text: Optional[str],

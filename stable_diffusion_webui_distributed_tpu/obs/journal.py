@@ -84,7 +84,11 @@ EVENTS = frozenset({
     # delivery / federation plane (obs/notify.py, obs/federation.py)
     "notify_sent",
     "notify_failed",
+    "notify_dropped",
     "federation_poll_failed",
+    # push control plane (obs/push.py delta streaming)
+    "push_buffer_evicted",
+    "push_fallback",
     # AOT artifact / warm-pool plane (serving/aot.py, fleet/pool.py)
     "aot_fallback",
     "pool_spawned",

@@ -1,14 +1,25 @@
-"""Alert notification delivery (SDTPU_NOTIFY_URL): webhook paging.
+"""Alert notification delivery (SDTPU_NOTIFY_URL / SDTPU_NOTIFY_ROUTES).
 
 The alert engine (obs/alerts.py) journals ``alert_firing`` /
 ``alert_resolved`` transitions and exports them as metrics, but nothing
 leaves the process — an operator learns about a 3am burn-rate page by
 polling ``/internal/alerts``. This module is the delivery channel: every
-firing/resolved transition is enqueued onto a bounded in-memory queue
-and drained by a daemon thread that POSTs one JSON document per
-transition to the configured webhook URL.
+firing/resolved transition is routed by its *severity* to a channel,
+enqueued onto that channel's bounded in-memory queue, and drained by a
+daemon thread that POSTs one JSON document per transition to the
+channel's webhook URL.
 
-Delivery discipline:
+Routing: ``SDTPU_NOTIFY_ROUTES`` maps severities (and tenant-scoped
+overrides) to URLs — ``page=<url1>,warn=<url2>`` sends pages to url1
+and warnings to url2; a ``tenantA:page=<url3>`` entry overrides the
+page route for transitions carrying ``tenant="tenantA"``. Lookup order
+is ``tenant:severity`` → ``severity`` → the ``SDTPU_NOTIFY_URL``
+default channel. A transition whose severity has no route and no
+default URL is not queued (same as the gate being off). With only
+``SDTPU_NOTIFY_URL`` set there is exactly one channel ("default") and
+behavior is identical to the single-URL notifier.
+
+Delivery discipline (per channel):
 
 - **off-thread, never under a lock** — the queue hand-off is the only
   locked region; the HTTP POST, its retries, and the backoff sleeps all
@@ -18,19 +29,23 @@ Delivery discipline:
   a transition that exhausts its attempts is counted and journaled as
   failed, never re-queued (the queue must drain even with the webhook
   down).
-- **dedup** — an identical (rule, event) transition enqueued within
-  ``SDTPU_NOTIFY_DEDUP_S`` seconds of the previous one is dropped
-  (outcome ``deduped``), so a flapping rule cannot page-storm.
-- **bounded** — past ``_MAX_QUEUE`` undelivered transitions the newest
-  is dropped (outcome ``dropped``); paging lag must not grow memory.
+- **dedup** — an identical (channel, rule, event) transition enqueued
+  within ``SDTPU_NOTIFY_DEDUP_S`` seconds of the previous one is
+  dropped (outcome ``deduped``), so a flapping rule cannot page-storm.
+- **bounded** — past ``_MAX_QUEUE`` undelivered transitions per channel
+  the newest is dropped (outcome ``dropped``, journaled as
+  ``notify_dropped`` and surfaced in :meth:`Notifier.summary` — paging
+  loss must be visible, not just a counter); lag must not grow memory.
 
-Every outcome bumps ``sdtpu_notify_total{outcome}`` and delivery
-results journal through the closed vocabulary (``notify_sent`` /
-``notify_failed``) when the journal is on. The POST timeout comes from
-the obs-plane-wide ``SDTPU_OBS_HTTP_TIMEOUT_S`` knob (obs/stitch.py).
+Every outcome bumps ``sdtpu_notify_total{channel,outcome}`` and
+delivery results journal through the closed vocabulary
+(``notify_sent`` / ``notify_failed`` / ``notify_dropped``) when the
+journal is on. The POST timeout comes from the obs-plane-wide
+``SDTPU_OBS_HTTP_TIMEOUT_S`` knob (obs/stitch.py).
 
-Gated off by default: an empty ``SDTPU_NOTIFY_URL`` (the default) means
-:func:`notify_transition` returns before touching the queue and no
+Gated off by default: with ``SDTPU_NOTIFY_URL`` and
+``SDTPU_NOTIFY_ROUTES`` both empty (the default)
+:func:`notify_transition` returns before touching any queue and no
 thread ever starts — the serving path is byte-identical to the
 unnotified build (hash-pinned in tests/test_federation.py).
 """
@@ -42,14 +57,14 @@ import threading
 import time
 import urllib.request
 from collections import deque
-from typing import Any, Deque, Dict, Optional
+from typing import Any, Deque, Dict, Optional, Tuple
 
 from ..runtime.config import env_float, env_str
 from ..runtime.daemon import StoppableDaemon
 from . import stitch
 
-#: Undelivered-transition queue depth; the newest transition past it is
-#: dropped (paging lag must not grow memory without bound).
+#: Undelivered-transition queue depth per channel; the newest transition
+#: past it is dropped (paging lag must not grow memory without bound).
 _MAX_QUEUE = 256
 
 #: Delivery attempts per transition before it counts as failed.
@@ -64,33 +79,74 @@ _DRAIN_PERIOD_S = 0.2
 
 DEFAULT_DEDUP_S = 60.0
 
+#: Channel name of the single-URL (SDTPU_NOTIFY_URL) route.
+DEFAULT_CHANNEL = "default"
+
 
 def enabled() -> bool:
-    """Notify gate — a non-empty webhook URL arms delivery."""
-    return bool(url())
+    """Notify gate — any configured route arms delivery."""
+    return bool(url()) or bool(routes())
 
 
 def url() -> str:
-    """Webhook endpoint (SDTPU_NOTIFY_URL); '' = delivery off."""
+    """Default-channel webhook endpoint (SDTPU_NOTIFY_URL); '' = none."""
     return env_str("SDTPU_NOTIFY_URL", "")
 
 
+def routes() -> Dict[str, str]:
+    """Severity-routing table (SDTPU_NOTIFY_ROUTES): comma-separated
+    ``key=url`` entries where ``key`` is a severity (``page``/``warn``/
+    ``info``) or a tenant-scoped override (``tenant:severity``).
+    Malformed entries are skipped; URLs must not contain commas."""
+    out: Dict[str, str] = {}
+    for part in env_str("SDTPU_NOTIFY_ROUTES", "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        key, target = part.split("=", 1)
+        key, target = key.strip(), target.strip()
+        if key and target:
+            out[key] = target
+    return out
+
+
+def channel_for(severity: str,
+                tenant: Optional[str] = None) -> Optional[Tuple[str, str]]:
+    """Resolve a transition's (channel name, URL): the tenant-scoped
+    route wins, then the severity route, then the SDTPU_NOTIFY_URL
+    default channel; None when nothing is configured for it."""
+    table = routes()
+    sev = str(severity)
+    if tenant:
+        key = f"{tenant}:{sev}"
+        if key in table:
+            return key, table[key]
+    if sev in table:
+        return sev, table[sev]
+    base = url()
+    if base:
+        return DEFAULT_CHANNEL, base
+    return None
+
+
 def dedup_s() -> float:
-    """Dedup window: identical (rule, event) transitions inside it are
-    dropped instead of delivered twice (SDTPU_NOTIFY_DEDUP_S)."""
+    """Dedup window: identical (channel, rule, event) transitions inside
+    it are dropped instead of delivered twice (SDTPU_NOTIFY_DEDUP_S)."""
     return max(0.0, env_float("SDTPU_NOTIFY_DEDUP_S", DEFAULT_DEDUP_S))
 
 
 class Notifier:
-    """Bounded queue + daemon drain thread for webhook delivery."""
+    """Per-channel bounded queues + one daemon drain thread."""
 
     def __init__(self, clock=time.monotonic) -> None:
         self._clock = clock
         self._lock = threading.Lock()
-        self._queue: Deque[Dict[str, Any]] = deque()   # guarded-by: _lock
-        # (rule, event) -> enqueue time of the last accepted transition
+        # channel -> FIFO of undelivered items         guarded-by: _lock
+        self._queues: Dict[str, Deque[Dict[str, Any]]] = {}
+        # (channel, rule, event) -> enqueue time of the last accepted
         self._last_sent: Dict[Any, float] = {}         # guarded-by: _lock
-        self._counts: Dict[str, int] = {}              # guarded-by: _lock
+        # channel -> outcome -> count                  guarded-by: _lock
+        self._counts: Dict[str, Dict[str, int]] = {}   # guarded-by: _lock
         self._pending = 0                              # guarded-by: _lock
         self._daemon = StoppableDaemon("sdtpu-notify-drain",
                                        self._drain_once, _DRAIN_PERIOD_S)
@@ -98,32 +154,50 @@ class Notifier:
     # -- enqueue (alert-engine side; cheap, lock only for the hand-off) ----
 
     def notify_transition(self, rule: str, event: str, value: Any,
-                          detail: str, *, force: bool = False) -> bool:
-        """Queue one firing/resolved transition for delivery; returns
-        True when it was accepted (not deduped/dropped/gated off).
-        ``force=True`` bypasses the env gate — the schedule-explorer
-        harness exercises the queue/drain protocol without a URL."""
-        if not force and not enabled():
-            return False
+                          detail: str, *, severity: str = "warn",
+                          tenant: Optional[str] = None,
+                          force: bool = False) -> bool:
+        """Route + queue one firing/resolved transition for delivery;
+        returns True when it was accepted (not deduped/dropped/gated
+        off). ``force=True`` bypasses the env gate — the
+        schedule-explorer harness exercises the queue/drain protocol
+        without a URL."""
+        route = channel_for(severity, tenant)
+        if route is None:
+            if not force:
+                return False
+            # forced (harness-seam) transitions with no configured route
+            # land on a channel named by their severity, so the
+            # multi-channel queue/drain protocol is exercisable without
+            # any env routes (EV001 — sim/harnesses.py)
+            route = (str(severity) or DEFAULT_CHANNEL, "")
+        channel = route[0]
         now = self._clock()
         item = {"rule": str(rule), "event": str(event), "value": value,
-                "detail": str(detail)}
-        key = (item["rule"], item["event"])
+                "detail": str(detail), "severity": str(severity),
+                "channel": channel}
+        if tenant:
+            item["tenant"] = str(tenant)
+        key = (channel, item["rule"], item["event"])
         rejected = None
         with self._lock:
+            q = self._queues.setdefault(channel, deque())
             last = self._last_sent.get(key)
             if last is not None and now - last < dedup_s():
                 rejected = "deduped"
-            elif len(self._queue) >= _MAX_QUEUE:
+            elif len(q) >= _MAX_QUEUE:
                 rejected = "dropped"
             else:
                 self._last_sent[key] = now
-                self._queue.append(item)
+                q.append(item)
                 self._pending += 1
             if rejected is not None:
-                self._counts[rejected] = self._counts.get(rejected, 0) + 1
+                per = self._counts.setdefault(channel, {})
+                per[rejected] = per.get(rejected, 0) + 1
         if rejected is not None:
-            _count_outcome(rejected)
+            _count_outcome(rejected, channel)
+            if rejected == "dropped":
+                _journal_dropped(item)
             return False
         self._daemon.start()  # idempotent; restart-safe after stop()
         self._daemon.wake()
@@ -131,26 +205,42 @@ class Notifier:
 
     # -- drain daemon (all blocking work lives here, no locks held) --------
 
+    def _next_item(self) -> Optional[Dict[str, Any]]:
+        """Pop the head of the first non-empty channel queue, rotating
+        that channel to the back so a busy page channel cannot starve
+        the warn/info channels."""
+        with self._lock:
+            for name in list(self._queues):
+                q = self._queues[name]
+                if q:
+                    self._queues[name] = self._queues.pop(name)
+                    return q.popleft()
+        return None
+
     def _drain_once(self) -> None:
         """One daemon tick: drain everything queued right now."""
         while not self._daemon.stopped():
-            with self._lock:
-                if not self._queue:
-                    return
-                item = self._queue.popleft()
+            item = self._next_item()
+            if item is None:
+                return
             delivered, attempts = self._deliver(item)
             outcome = "sent" if delivered else "failed"
+            channel = item.get("channel", DEFAULT_CHANNEL)
             with self._lock:
                 self._pending -= 1
-                self._counts[outcome] = self._counts.get(outcome, 0) + 1
-            _count_outcome(outcome)
+                per = self._counts.setdefault(channel, {})
+                per[outcome] = per.get(outcome, 0) + 1
+            _count_outcome(outcome, channel)
             _journal_outcome(item, delivered, attempts)
 
     def _deliver(self, item: Dict[str, Any]) -> "tuple[bool, int]":
         """POST one transition with retry + exponential backoff; returns
         (delivered, attempts). Runs on the drain thread only — never
-        call with any lock held (LK004)."""
-        target = url()
+        call with any lock held (LK004). The URL is re-resolved from the
+        routing table at delivery time so env flips apply mid-queue."""
+        channel = item.get("channel", DEFAULT_CHANNEL)
+        target = routes().get(channel) or (
+            url() if channel == DEFAULT_CHANNEL else "")
         if not target:
             return False, 0
         body = dict(item)
@@ -191,27 +281,46 @@ class Notifier:
         self._daemon.stop(timeout_s=2.0)
 
     def counts(self) -> Dict[str, int]:
+        """Outcome counts aggregated across channels (the single-channel
+        notifier's historical shape)."""
         with self._lock:
-            return dict(self._counts)
+            out: Dict[str, int] = {}
+            for per in self._counts.values():
+                for outcome, n in per.items():
+                    out[outcome] = out.get(outcome, 0) + n
+            return out
+
+    def counts_by_channel(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {ch: dict(per) for ch, per in self._counts.items()}
 
     def summary(self) -> Dict[str, Any]:
         with self._lock:
-            queued = len(self._queue)
+            per_queue = {ch: len(q) for ch, q in self._queues.items()}
             pending = self._pending
-            counts = dict(self._counts)
+            by_channel = {ch: dict(per) for ch, per in self._counts.items()}
+        counts: Dict[str, int] = {}
+        for per in by_channel.values():
+            for outcome, n in per.items():
+                counts[outcome] = counts.get(outcome, 0) + n
+        channels = {}
+        for ch in sorted(set(per_queue) | set(by_channel)):
+            channels[ch] = {"queued": per_queue.get(ch, 0),
+                            "outcomes": by_channel.get(ch, {})}
         alive = self._daemon.alive()
         return {"enabled": enabled(), "dedup_s": dedup_s(),
-                "queued": queued, "pending": pending,
-                "outcomes": counts, "draining": alive}
+                "queued": sum(per_queue.values()), "pending": pending,
+                "outcomes": counts, "dropped": counts.get("dropped", 0),
+                "draining": alive, "channels": channels}
 
 
-def _count_outcome(outcome: str) -> None:
+def _count_outcome(outcome: str, channel: str = DEFAULT_CHANNEL) -> None:
     try:
         from stable_diffusion_webui_distributed_tpu.obs import (
             prometheus as obs_prom,
         )
 
-        obs_prom.notify_count(outcome)
+        obs_prom.notify_count(outcome, channel=channel)
     except Exception:  # noqa: BLE001 — telemetry stays passive
         pass
 
@@ -230,7 +339,27 @@ def _journal_outcome(item: Dict[str, Any], delivered: bool,
                 "notify_sent" if delivered else "notify_failed",
                 f"notify-{item.get('rule', '')}",
                 rule=item.get("rule"), alert_event=item.get("event"),
-                attempts=attempts)
+                severity=item.get("severity"),
+                channel=item.get("channel"), attempts=attempts)
+    except Exception:  # noqa: BLE001 — telemetry stays passive
+        pass
+
+
+def _journal_dropped(item: Dict[str, Any]) -> None:
+    """Journal one queue-overflow drop (no URL, same token discipline):
+    a page that never left the process must be visible in the decision
+    trail, not just a counter."""
+    try:
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            journal as obs_journal,
+        )
+
+        if obs_journal.enabled():
+            obs_journal.emit(
+                "notify_dropped", f"notify-{item.get('rule', '')}",
+                rule=item.get("rule"), alert_event=item.get("event"),
+                severity=item.get("severity"),
+                channel=item.get("channel"))
     except Exception:  # noqa: BLE001 — telemetry stays passive
         pass
 
@@ -240,11 +369,13 @@ def _journal_outcome(item: Dict[str, Any], delivered: bool,
 NOTIFIER = Notifier()
 
 
-def notify_transition(rule: str, event: str, value: Any,
-                      detail: str) -> bool:
+def notify_transition(rule: str, event: str, value: Any, detail: str, *,
+                      severity: str = "warn",
+                      tenant: Optional[str] = None) -> bool:
     """Module-level convenience for :meth:`Notifier.notify_transition`;
-    no-op (False) with SDTPU_NOTIFY_URL unset."""
-    return NOTIFIER.notify_transition(rule, event, value, detail)
+    no-op (False) with no route configured for the severity."""
+    return NOTIFIER.notify_transition(rule, event, value, detail,
+                                      severity=severity, tenant=tenant)
 
 
 def flush(timeout_s: float = 5.0) -> bool:

@@ -526,15 +526,18 @@ def alert_states() -> Dict[str, float]:
 
 
 #: Webhook delivery outcomes (sent / failed / deduped / dropped) from
-#: obs/notify.py. Zero families with SDTPU_NOTIFY_URL unset.
+#: obs/notify.py, by channel (severity route; "default" for the single
+#: SDTPU_NOTIFY_URL channel). Zero families with no route configured.
 NOTIFY_COUNTER = LabeledCounter(
     "sdtpu_notify_total",
-    "Alert notification delivery outcomes (SDTPU_NOTIFY_URL) by outcome.",
-    ("outcome",))
+    "Alert notification delivery outcomes (SDTPU_NOTIFY_URL / "
+    "SDTPU_NOTIFY_ROUTES) by channel and outcome.",
+    ("channel", "outcome"))
 
 
-def notify_count(outcome: str, n: float = 1.0) -> None:
-    NOTIFY_COUNTER.inc(n, outcome=outcome)
+def notify_count(outcome: str, n: float = 1.0,
+                 channel: str = "default") -> None:
+    NOTIFY_COUNTER.inc(n, channel=channel, outcome=outcome)
 
 
 # -- scenario engine (sim/: chaos injection + SLO scoring) -------------------

@@ -323,8 +323,40 @@ def _logger():
 #   ``notify_failed``. Unset (the default) the queue is never touched
 #   and no thread starts.
 # - ``SDTPU_NOTIFY_DEDUP_S`` (float seconds, default 60): identical
-#   (rule, event) transitions inside this window are dropped (outcome
-#   ``deduped``) so a flapping rule cannot page-storm.
+#   (channel, rule, event) transitions inside this window are dropped
+#   (outcome ``deduped``) so a flapping rule cannot page-storm.
+# - ``SDTPU_NOTIFY_ROUTES`` (default unset): severity-routed delivery —
+#   comma-separated ``key=url`` entries where ``key`` is a severity
+#   (``page``/``warn``/``info``) or a tenant-scoped override
+#   (``tenant:severity``). Resolution precedence: tenant:severity ->
+#   severity -> the ``SDTPU_NOTIFY_URL`` default channel -> drop.
+#   Each channel gets its own bounded queue and per-channel outcome
+#   counts (``sdtpu_notify_total{channel,outcome}``); malformed
+#   entries are skipped. ``bench.py --obsplane`` validates the routing
+#   matrix (page and warn never cross channels).
+# - ``SDTPU_PUSH`` (flag, default off): the push control plane
+#   (obs/push.py) — workers buffer their journal events, federated
+#   TSDB samples and counter totals behind cursor-indexed ``GET
+#   /internal/deltas`` long-polls; the master runs one DeltaSubscriber
+#   daemon per worker that resumes from its cursor after a disconnect
+#   (no loss, no duplicates) and writes the *same*
+#   ``worker:<label>/...`` + ``fleet/...`` series the poll prober
+#   fills, so alert rules and the autoscaler are plane-agnostic.
+#   Streamed journal events merge into the fleet timeline
+#   (obs/fleetlog.py, ``GET /internal/fleet/timeline``) with RTT-
+#   midpoint clock offsets. A worker answering 404 demotes its
+#   subscriber to the poll path (``push_fallback`` journaled) — push
+#   is an upgrade, never a requirement. Off (the default)
+#   ``/internal/deltas`` answers 404, no source registers, no daemon
+#   starts, and the serving path is byte-identical to the poll-only
+#   build (pinned to the same golden in tests/test_push.py).
+# - ``SDTPU_PUSH_CURSOR_BUF`` (int, default 1024, floor 16): worker-
+#   side retained-entry depth; past it the oldest entries are evicted
+#   (counted, journaled as ``push_buffer_evicted``, and reported as
+#   ``lost`` to any consumer whose cursor predates the window).
+# - ``SDTPU_PUSH_WAIT_S`` (float seconds, default 0.25, floor 0): how
+#   long one ``/internal/deltas`` request may hold the connection
+#   waiting for fresh entries before answering empty.
 # - ``SDTPU_OBS_HTTP_TIMEOUT_S`` (float seconds, floor 0.05): the one
 #   obs-plane outbound HTTP timeout — trace stitching, federation
 #   polls, webhook delivery, and the HTTP backend's control-plane

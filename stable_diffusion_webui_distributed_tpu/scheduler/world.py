@@ -143,6 +143,16 @@ class World:
                 obs_federation.set_source(self)
         except Exception:  # noqa: BLE001 — telemetry stays passive
             pass
+        # with SDTPU_PUSH on, the push control plane subscribes to this
+        # World's workers' delta streams (obs/push.py); gate off = no
+        # registration
+        try:
+            from ..obs import push as obs_push
+
+            if obs_push.enabled():
+                obs_push.set_source(self)
+        except Exception:  # noqa: BLE001 — telemetry stays passive
+            pass
 
     # -- registry -----------------------------------------------------------
 

@@ -641,6 +641,45 @@ class ApiServer:
 
         return federation.summary()
 
+    def handle_deltas(self, query: Dict[str, str]) -> Dict[str, Any]:
+        """Push control plane worker feed (obs/push.py; SDTPU_PUSH=1):
+        ``?cursor=N`` long-polls for journal events / TSDB samples /
+        counter deltas after N. Answers 404 with the gate off — a
+        push-preferring master reads that as "poll this node"."""
+        from stable_diffusion_webui_distributed_tpu.obs import push
+
+        if not push.enabled():
+            raise ApiError(404, "push plane disabled (SDTPU_PUSH=0)")
+        try:
+            cursor = int(query.get("cursor", "0"))
+        except ValueError:
+            raise ApiError(422, "cursor must be an integer")
+        try:
+            hold = float(query.get("wait_s", str(push.wait_s())))
+        except ValueError:
+            raise ApiError(422, "wait_s must be a number")
+        return push.serve_deltas(cursor, hold_s=min(5.0, max(0.0, hold)))
+
+    def handle_push(self) -> Dict[str, Any]:
+        """Push-plane status (obs/push.py): per-worker subscriber mode
+        (push vs poll fallback), cursors, loss/duplicate accounting, and
+        the worker-side buffer stats. Always served; ``enabled`` is
+        False until SDTPU_PUSH=1. (/internal/fleet's key set is frozen
+        by tests, so push status lives on its own endpoint.)"""
+        from stable_diffusion_webui_distributed_tpu.obs import push
+
+        return push.summary()
+
+    def handle_fleet_timeline(self, query: Dict[str, str]
+                              ) -> Dict[str, Any]:
+        """Fleet-merged journal timeline (obs/fleetlog.py): the local
+        journal + every push-streamed worker journal on one
+        clock-corrected, causally-ordered axis. ``?request_id=``
+        narrows to one request's cross-node story."""
+        from stable_diffusion_webui_distributed_tpu.obs import fleetlog
+
+        return fleetlog.timeline(query.get("request_id") or None)
+
     def handle_executables(self) -> Dict[str, Any]:
         """Live compiled-executable census against the serving budget of
         <=2 step-cache x <=3 precision variants per shape bucket; the
@@ -900,6 +939,9 @@ class ApiServer:
             ("GET", "/internal/tsdb"): self.handle_tsdb,
             ("GET", "/internal/alerts"): self.handle_alerts,
             ("GET", "/internal/fleet"): self.handle_fleet,
+            ("GET", "/internal/fleet/timeline"): self.handle_fleet_timeline,
+            ("GET", "/internal/deltas"): self.handle_deltas,
+            ("GET", "/internal/push"): self.handle_push,
             ("GET", "/internal/executables"): self.handle_executables,
             ("GET", "/internal/autoscale"): self.handle_autoscale,
             ("GET", "/internal/profile"): self.handle_profile_get,

@@ -27,8 +27,10 @@ Ground rules (these are load-bearing — see sim/sched.py):
 
 The harnesses cover the lock protocols the static tier reasons about:
 condition-variable handoff (FleetGate), two-lock leader/follower
-coalescing with cancellation (dispatcher), producer/drain-daemon
-shutdown (notifier), daemon stop/restart (StoppableDaemon), and the
+coalescing with cancellation (dispatcher), multi-channel
+producer/drain-daemon shutdown (notifier), daemon stop/restart
+(StoppableDaemon), the push-plane delta subscriber's cursor-resume
+fetch/apply cycle racing reconnect and stop (DeltaSubscriber), and the
 stage-graph runner's submit/drain FIFO with per-stage completion
 callbacks racing cancel and preempt (GraphRunner).
 """
@@ -51,6 +53,7 @@ from . import sched
 __all__ = [
     "HARNESSES",
     "daemon_restart_harness",
+    "delta_subscriber_harness",
     "dispatcher_coalesce_harness",
     "fleet_gate_harness",
     "notifier_drain_harness",
@@ -203,11 +206,14 @@ def dispatcher_coalesce_harness(ex: "sched.Explorer") \
 # -- Notifier: producer enqueue vs drain daemon vs stop ----------------------
 
 def notifier_drain_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
-    """Two producers enqueue transitions (starting/waking the drain
-    daemon) while a stopper shuts the notifier down as soon as both have
-    finished. Delivery is stubbed. The queue accounting must balance:
-    ``pending`` mirrors the queue, and every accepted item is sent,
-    failed, or still pending — never dropped on the floor."""
+    """Two producers enqueue transitions onto two *different* severity
+    channels (forced no-route transitions land on a channel named by
+    their severity) while a stopper shuts the notifier down as soon as
+    both have finished. Delivery is stubbed. The per-channel queue
+    accounting must balance: ``pending`` mirrors the union of the
+    channel queues, every accepted item is sent, failed, or still
+    pending on its own channel — never dropped on the floor — and no
+    item crosses channels."""
     from ..obs import notify as notify_mod
 
     n = notify_mod.Notifier()
@@ -215,13 +221,16 @@ def notifier_drain_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
     accepted = [0]
     produced = threading.Event()  # post-install: cooperative wait
     remaining = [2]
+    severities = ("page", "warn")
 
     def producer(idx: int) -> Callable[[], None]:
         def body() -> None:
             for j in range(2):
                 # distinct rules: the dedup window must not eat any
                 if n.notify_transition(f"rule-{idx}-{j}", "firing", j,
-                                       "harness", force=True):
+                                       "harness",
+                                       severity=severities[idx],
+                                       force=True):
                     with n._lock:
                         accepted[0] += 1
             remaining[0] -= 1
@@ -241,11 +250,16 @@ def notifier_drain_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
         out: List[str] = []
         with n._lock:
             pending = n._pending
-            queued = len(n._queue)
-            sent = n._counts.get("sent", 0)
-            failed = n._counts.get("failed", 0)
-            deduped = n._counts.get("deduped", 0)
-            dropped = n._counts.get("dropped", 0)
+            queued = sum(len(q) for q in n._queues.values())
+            channels = set(n._queues) | set(n._counts)
+            totals: Dict[str, int] = {}
+            for per in n._counts.values():
+                for outcome, count in per.items():
+                    totals[outcome] = totals.get(outcome, 0) + count
+        sent = totals.get("sent", 0)
+        failed = totals.get("failed", 0)
+        deduped = totals.get("deduped", 0)
+        dropped = totals.get("dropped", 0)
         if pending != queued:
             out.append(f"pending {pending} != queued {queued}")
         if sent + failed + pending != accepted[0]:
@@ -255,6 +269,78 @@ def notifier_drain_harness(ex: "sched.Explorer") -> Callable[[], List[str]]:
         if deduped or dropped:
             out.append(f"unexpected rejects: deduped={deduped} "
                        f"dropped={dropped}")
+        if not channels <= set(severities):
+            out.append(f"items crossed channels: {sorted(channels)}")
+        return out
+
+    return check
+
+
+# -- DeltaSubscriber: delta stream reconnect vs stop -------------------------
+
+def delta_subscriber_harness(ex: "sched.Explorer") \
+        -> Callable[[], List[str]]:
+    """Two threads each run a start()/poll_once()/stop() cycle against
+    one DeltaSubscriber (the push-plane daemon lifecycle under a
+    reset() racing a start) while a producer publishes entries into the
+    worker-side buffer and the in-process fetch seam injects one
+    disconnect. Whatever the interleaving: cursor resume keeps the
+    stream lossless (``applied == cursor`` — every cursor up to the
+    high-water mark applied exactly once, redeliveries deduped, nothing
+    reported lost) and the final stop wins (no daemon thread
+    survives)."""
+    from ..obs import push as push_mod
+    from ..obs import tsdb as tsdb_mod
+
+    buf = push_mod.DeltaBuffer(capacity=64)
+    calls = [0]
+
+    class _Backend:
+        """In-process fetch seam; call #2 raises (a mid-stream
+        disconnect the subscriber must resume across)."""
+
+        @staticmethod
+        def push_fetch(cursor: int):
+            calls[0] += 1
+            if calls[0] == 2:
+                raise OSError("simulated disconnect")
+            return buf.collect(cursor, hold_s=0.0)
+
+    store = tsdb_mod.SeriesStore()
+    sub = push_mod.DeltaSubscriber("w0", _Backend(), store=store)
+    produced = threading.Event()  # post-install: cooperative wait
+
+    def producer() -> None:
+        for i in range(4):
+            buf.publish("sample", {"name": "queue_wait_p95_s",
+                                   "t": float(i), "v": float(i)})
+        produced.set()
+
+    def cycle() -> None:
+        sub.start()
+        produced.wait()
+        sub.poll_once()
+        sub.stop(timeout_s=0.1)
+
+    ex.spawn(producer, "producer")
+    ex.spawn(cycle, "cycle-a")
+    ex.spawn(cycle, "cycle-b")
+
+    def check() -> List[str]:
+        out: List[str] = []
+        with sub._lock:
+            applied = sub._applied
+            lost = sub._lost
+            cursor = sub.cursor
+        if lost:
+            out.append(f"subscriber reported {lost} lost entries")
+        if applied != cursor:
+            out.append(f"applied {applied} != cursor {cursor} "
+                       "(an entry double-applied or skipped)")
+        if sub.alive():
+            out.append("subscriber daemon survived both stop() calls")
+        if not sub._daemon.stopped():
+            out.append("halt flag clear after both stop() calls")
         return out
 
     return check
@@ -445,6 +531,7 @@ HARNESSES: Dict[str, Callable[["sched.Explorer"],
     "dispatcher_coalesce": dispatcher_coalesce_harness,
     "notifier_drain": notifier_drain_harness,
     "daemon_restart": daemon_restart_harness,
+    "delta_subscriber": delta_subscriber_harness,
     "stage_graph": stage_graph_harness,
     "warm_pool": warm_pool_harness,
 }

@@ -25,3 +25,17 @@ THROWAWAY = AlertRule(name="scratch", kind="anomaly", series="x",
 
 # OK: deliberate plugin-site registration, marker-exempt
 register_rule(THROWAWAY)  # sdtpu-lint: alert
+
+# BAD (line 30): severity literal outside the closed page/warn/info set
+ROGUE_SEVERITY = AlertRule(
+    name="sev", kind="anomaly", series="y",
+    description="mistyped severity", severity="critical")
+
+# OK: a valid severity literal on a throwaway rule
+PAGED = AlertRule(name="sev_ok", kind="anomaly", series="y",
+                  description="valid severity", severity="page")
+
+# OK: deliberate out-of-set severity, marker-exempt plugin site
+WEIRD = AlertRule(  # sdtpu-lint: alert
+    name="sev_exempt", kind="anomaly", series="y",
+    description="plugin severity", severity="fatal")

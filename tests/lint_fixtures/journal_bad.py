@@ -47,3 +47,9 @@ obs_journal.emit("alert_resolved", "alert-slo", rule="slo_burn_fast")
 obs_journal.emit("notify_sent", "notify-fleet_error_rate", attempts=1)
 obs_journal.emit("notify_failed", "notify-fleet_error_rate", attempts=3)
 obs_journal.emit("federation_poll_failed", "federation-w0", worker="w0")
+
+# Push-control-plane vocabulary pin (obs/push.py + obs/notify.py
+# overflow): flagged standalone, accepted beside the real registry.
+obs_journal.emit("notify_dropped", "notify-slo_burn_fast", channel="page")
+obs_journal.emit("push_buffer_evicted", "push-buffer", evicted=3)
+obs_journal.emit("push_fallback", "push-w0", worker="w0")

@@ -217,6 +217,9 @@ class TestFixtures:
             ("OB003", 47),  # notify pin: unregistered without the registry
             ("OB003", 48),  # notify pin: unregistered without the registry
             ("OB003", 49),  # federation pin: same
+            ("OB003", 53),  # notify_dropped pin: same
+            ("OB003", 54),  # push_buffer_evicted pin: same
+            ("OB003", 55),  # push_fallback pin: same
         }
         # dynamic event names, the marker-exempt literal, and plain
         # non-emit strings stay clean
@@ -251,17 +254,21 @@ class TestFixtures:
         assert {f for f in found if f[0] == "OB004"} == {
             ("OB004", 12),  # direct registration outside the registry
             ("OB004", 19),  # indirect spelling inside a function
+            ("OB004", 30),  # severity literal outside page/warn/info
         }
-        # bare AlertRule construction and the '# sdtpu-lint: alert'
-        # marker (deliberate plugin site) stay clean
+        # bare AlertRule construction, a valid severity literal, and the
+        # '# sdtpu-lint: alert' marker (deliberate plugin site — both the
+        # registration and the out-of-set severity shapes) stay clean
 
     def test_alert_rule_exempts_registry_module(self):
         # the same calls inside obs/alerts.py are the registry's own
-        # closed rule set: zero OB004 findings
+        # closed rule set: the registration shapes go quiet. The severity
+        # closed-set check is NOT registry-exempt (the registry's own
+        # literals route notifications too), so only line 30 fires.
         rel = "stable_diffusion_webui_distributed_tpu/obs/alerts.py"
         mod = load_module(os.path.join(FIXTURES, "alert_bad.py"), rel)
         found = _rule_lines(analyze_modules([mod]))
-        assert not {f for f in found if f[0] == "OB004"}
+        assert {f for f in found if f[0] == "OB004"} == {("OB004", 30)}
 
     def test_net_family(self):
         # OB005: outbound HTTP inside obs/ is confined to

@@ -1,0 +1,1057 @@
+"""Push control plane tests (obs/push.py, obs/fleetlog.py).
+
+Covers the streaming-delta plane end to end:
+
+- DeltaBuffer cursor semantics — assignment, bounded eviction with
+  ``lost`` accounting, the journaled ``push_buffer_evicted`` trail,
+  and the long-poll fast path;
+- DeltaSubscriber resilience — disconnect mid-stream then cursor
+  resume with zero loss and zero duplicates, redelivered-batch dedup,
+  slow-consumer loss surfaced in status, and the 404 demotion to the
+  poll prober's own fetch+digest (``push_fallback`` journaled);
+- fleet journal merge (obs/fleetlog.py) — seq dedup on redelivery,
+  per-node monotonic ``t_fleet`` clamping, causal-order violation
+  detection, request-id filtering, and bounded per-node buffers;
+- severity-routed notify — ``channel_for`` precedence, the two-hook
+  delivery matrix (page lands on url1 only, warn on url2 only),
+  tenant-scoped overrides, and the ``notify_dropped`` overflow trail;
+- the HTTP surface — ``/internal/deltas`` (404 when gated off, 422 on
+  a bad cursor), ``/internal/push``, ``/internal/fleet/timeline``,
+  and a real-HTTP subscriber round-trip including the fallback;
+- the tools — ``fed_report --timeline`` exit codes and rendering,
+  ``replay --fleet`` cross-node journey reconstruction;
+- the gate-off golden: with SDTPU_PUSH unset the serving path pins to
+  the *same* hash as the poll-only build ("serving/federation-off-
+  default") and no push/fleetlog state leaks;
+- the acceptance e2e: two real in-process HTTP workers, chaos-kill
+  one, and a single GET /internal/fleet/timeline response tells the
+  whole story — the victim's last events, the fault injection, the
+  stale alert firing with its severity, and the requeue landing on
+  the healthy worker — with zero causal violations and zero event
+  loss.
+"""
+
+import json
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+from stable_diffusion_webui_distributed_tpu.obs import alerts as obs_alerts
+from stable_diffusion_webui_distributed_tpu.obs import (
+    federation as obs_fed,
+)
+from stable_diffusion_webui_distributed_tpu.obs import (
+    fleetlog as obs_fleetlog,
+)
+from stable_diffusion_webui_distributed_tpu.obs import journal as obs_journal
+from stable_diffusion_webui_distributed_tpu.obs import notify as obs_notify
+from stable_diffusion_webui_distributed_tpu.obs import (
+    prometheus as _obs_prom,
+)
+from stable_diffusion_webui_distributed_tpu.obs import push as obs_push
+from stable_diffusion_webui_distributed_tpu.obs import tsdb as obs_tsdb
+from stable_diffusion_webui_distributed_tpu.models.configs import TINY
+from stable_diffusion_webui_distributed_tpu.pipeline.engine import Engine
+from stable_diffusion_webui_distributed_tpu.pipeline.payload import (
+    GenerationPayload,
+)
+from stable_diffusion_webui_distributed_tpu.runtime.interrupt import (
+    GenerationState,
+)
+from stable_diffusion_webui_distributed_tpu.serving.bucketer import (
+    ShapeBucketer,
+)
+from stable_diffusion_webui_distributed_tpu.serving.dispatcher import (
+    ServingDispatcher,
+)
+
+from test_federation import (
+    _METRICS_A,
+    _TSDB_A,
+    FakeBackend,
+    FakeClock,
+    FakeWorker,
+    scripted_clock,
+)
+from test_goldens import _check
+from test_pipeline import init_params
+
+
+@pytest.fixture(autouse=True)
+def _worker_counters_isolated():
+    # The worker counters are process-global and accumulate across test
+    # modules; a nonzero initial total legitimately ships as a delta
+    # entry (that's production behavior), which would shift every
+    # cursor number this module pins. Start each test from zero.
+    for c in _obs_prom.WORKER_COUNTERS.values():
+        c.clear()
+
+
+@pytest.fixture()
+def push_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_PUSH", "1")
+    yield
+    obs_push.reset()
+    obs_fleetlog.reset()
+
+
+@pytest.fixture()
+def journal_on(monkeypatch):
+    monkeypatch.setenv("SDTPU_JOURNAL", "1")
+    obs_journal.JOURNAL.clear()
+    yield
+    obs_journal.JOURNAL.clear()
+
+
+class SeamBackend:
+    """In-process push_fetch seam over a DeltaBuffer; call numbers in
+    ``fail_on`` raise (a disconnect mid-stream)."""
+
+    def __init__(self, buf, fail_on=()):
+        self.buf = buf
+        self.calls = 0
+        self.fail_on = set(fail_on)
+
+    def push_fetch(self, cursor):
+        self.calls += 1
+        if self.calls in self.fail_on:
+            raise ConnectionError("mid-stream disconnect")
+        return self.buf.collect(cursor, hold_s=0.0)
+
+
+class CannedBackend:
+    """push_fetch returning the same canned document every time."""
+
+    def __init__(self, doc):
+        self.doc = doc
+        self.calls = 0
+
+    def push_fetch(self, cursor):
+        self.calls += 1
+        return json.loads(json.dumps(self.doc))
+
+
+# -- knobs --------------------------------------------------------------------
+
+class TestKnobs:
+    def test_gate_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH", raising=False)
+        assert obs_push.enabled() is False
+        monkeypatch.setenv("SDTPU_PUSH", "1")
+        assert obs_push.enabled() is True
+
+    def test_cursor_buf_default_and_floor(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH_CURSOR_BUF", raising=False)
+        assert obs_push.cursor_buf() == 1024
+        monkeypatch.setenv("SDTPU_PUSH_CURSOR_BUF", "2")
+        assert obs_push.cursor_buf() == 16
+
+    def test_wait_default_and_floor(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH_WAIT_S", raising=False)
+        assert obs_push.wait_s() == obs_push.DEFAULT_WAIT_S
+        monkeypatch.setenv("SDTPU_PUSH_WAIT_S", "-3")
+        assert obs_push.wait_s() == 0.0
+
+
+# -- worker-side buffer -------------------------------------------------------
+
+class TestDeltaBuffer:
+    def test_cursors_are_assigned_monotonically(self):
+        buf = obs_push.DeltaBuffer(capacity=16)
+        for i in range(3):
+            assert buf.publish("sample", {"name": "s", "t": i,
+                                          "v": 1.0}) == 0
+        doc = buf.collect(0, hold_s=0.0)
+        assert [e["cursor"] for e in doc["entries"]] == [1, 2, 3]
+        assert doc["next_cursor"] == 3
+        assert doc["lost"] == 0
+        # resume after the last cursor sees nothing
+        assert buf.collect(3, hold_s=0.0)["entries"] == []
+
+    def test_eviction_counts_and_reports_lost(self):
+        buf = obs_push.DeltaBuffer(capacity=4)
+        for i in range(10):
+            buf.publish("sample", {"name": "s", "t": i, "v": 1.0})
+        assert buf.stats() == {"retained": 4, "next_cursor": 10,
+                               "evicted_total": 6}
+        doc = buf.collect(0, hold_s=0.0)
+        # entries 1..6 evicted: a cursor-0 consumer lost exactly those
+        assert doc["lost"] == 6
+        assert [e["cursor"] for e in doc["entries"]] == [7, 8, 9, 10]
+        # a consumer inside the retained window lost nothing
+        assert buf.collect(7, hold_s=0.0)["lost"] == 0
+
+    def test_ingest_pulls_journal_events_once(self, journal_on):
+        buf = obs_push.DeltaBuffer(capacity=64)
+        obs_journal.emit("push_fallback", "rid-a", worker="a")
+        obs_journal.emit("push_fallback", "rid-b", worker="b")
+        assert buf.ingest() == 2
+        assert buf.ingest() == 0  # position advanced; no re-ship
+        doc = buf.collect(0, hold_s=0.0)
+        kinds = {e["kind"] for e in doc["entries"]}
+        assert kinds == {"journal"}
+        workers = [e["event"]["attrs"]["worker"] for e in doc["entries"]]
+        assert workers == ["a", "b"]
+
+    def test_ingest_eviction_is_journaled(self, journal_on):
+        buf = obs_push.DeltaBuffer(capacity=4)
+        for i in range(10):
+            obs_journal.emit("push_fallback", f"rid-{i}", worker="w")
+        assert buf.ingest() == 10
+        assert buf.stats()["evicted_total"] == 6
+        events = obs_journal.JOURNAL.events_for("push-buffer")
+        assert any(e["event"] == "push_buffer_evicted"
+                   and e["attrs"]["evicted"] == 6 for e in events)
+
+    def test_long_poll_returns_immediately_with_entries(self):
+        buf = obs_push.DeltaBuffer(capacity=16)
+        buf.publish("sample", {"name": "s", "t": 0.0, "v": 1.0})
+        t0 = time.monotonic()
+        doc = buf.collect(0, hold_s=5.0)
+        assert time.monotonic() - t0 < 1.0
+        assert len(doc["entries"]) == 1
+
+    def test_clear_resets_cursor_space(self):
+        buf = obs_push.DeltaBuffer(capacity=16)
+        buf.publish("sample", {"name": "s", "t": 0.0, "v": 1.0})
+        buf.clear()
+        assert buf.stats() == {"retained": 0, "next_cursor": 0,
+                               "evicted_total": 0}
+
+
+# -- master-side subscriber ---------------------------------------------------
+
+class TestDeltaSubscriber:
+    def test_disconnect_then_resume_zero_loss_zero_dup(self):
+        buf = obs_push.DeltaBuffer(capacity=1024)
+        backend = SeamBackend(buf, fail_on={2})
+        store = obs_tsdb.SeriesStore(points=64)
+        sub = obs_push.DeltaSubscriber("w", backend, store=store,
+                                       clock=FakeClock(10.0))
+        for i in range(3):
+            buf.publish("sample", {"name": "queue_wait_p95_s",
+                                   "t": float(i), "v": 0.1 * i})
+        assert sub.poll_once(now=10.0) == 3
+        assert sub.cursor == 3
+        for i in range(2):
+            buf.publish("sample", {"name": "queue_wait_p95_s",
+                                   "t": 10.0 + i, "v": 0.5})
+        # the disconnect: nothing applied, failure bookkept, staleness
+        # series still records (the alert input keeps flowing)
+        assert sub.poll_once(now=11.0) == 0
+        st = sub.status()
+        assert st["failures"] == 1
+        assert st["mode"] == "push"
+        assert store.latest("worker:w/staleness_s") is not None
+        # the resume: exactly the two new entries, nothing twice
+        assert sub.poll_once(now=12.0) == 2
+        st = sub.status()
+        assert st["applied"] == 5
+        assert st["duplicates"] == 0
+        assert st["lost"] == 0
+        assert st["cursor"] == 5
+        assert st["last_error"] is None
+
+    def test_redelivered_batch_is_deduped(self):
+        entries = [{"cursor": i, "kind": "sample",
+                    "name": "queue_wait_p95_s", "t": float(i), "v": 1.0}
+                   for i in (1, 2, 3)]
+        doc = {"enabled": True, "next_cursor": 3, "evicted_total": 0,
+               "lost": 0, "clock_us": 0.0, "entries": entries}
+        sub = obs_push.DeltaSubscriber(
+            "w", CannedBackend(doc), store=obs_tsdb.SeriesStore(points=64),
+            clock=FakeClock(5.0))
+        assert sub.poll_once(now=5.0) == 3
+        assert sub.poll_once(now=6.0) == 0  # the whole batch again
+        st = sub.status()
+        assert st["applied"] == 3
+        assert st["duplicates"] == 3
+        assert st["cursor"] == 3
+
+    def test_slow_consumer_loss_is_accounted(self):
+        buf = obs_push.DeltaBuffer(capacity=4)
+        for i in range(10):
+            buf.publish("sample", {"name": "queue_wait_p95_s",
+                                   "t": float(i), "v": 1.0})
+        sub = obs_push.DeltaSubscriber(
+            "w", SeamBackend(buf), store=obs_tsdb.SeriesStore(points=64),
+            clock=FakeClock(5.0))
+        assert sub.poll_once(now=5.0) == 4
+        st = sub.status()
+        assert st["lost"] == 6
+        assert st["cursor"] == 10
+
+    def test_counter_entries_become_error_rate(self):
+        entries = [
+            {"cursor": 1, "kind": "counter", "name": "requests_total",
+             "total": 4.0},
+            {"cursor": 2, "kind": "counter", "name": "failures_total",
+             "total": 1.0},
+        ]
+        doc = {"enabled": True, "next_cursor": 2, "evicted_total": 0,
+               "lost": 0, "clock_us": 0.0, "entries": entries}
+        store = obs_tsdb.SeriesStore(points=64)
+        sub = obs_push.DeltaSubscriber("w", CannedBackend(doc),
+                                       store=store, clock=FakeClock(5.0))
+        sub.poll_once(now=5.0)
+        assert store.latest("worker:w/requests_total")[1] == 4.0
+        assert store.latest("worker:w/failures_total")[1] == 1.0
+        assert store.latest("worker:w/error_rate")[1] == \
+            pytest.approx(0.25)
+        # the p95 defaults rather than going absent (prober parity)
+        assert store.latest("worker:w/queue_wait_p95_s")[1] == 0.0
+
+    def test_remote_samples_never_land_in_the_future(self):
+        # remote clock way ahead: offset correction would place the
+        # sample past the master's now — it must clamp to now
+        entries = [{"cursor": 1, "kind": "sample",
+                    "name": "queue_wait_p95_s", "t": 500.0, "v": 2.0}]
+        doc = {"enabled": True, "next_cursor": 1, "evicted_total": 0,
+               "lost": 0, "clock_us": 100.0 * 1e6, "entries": entries}
+        store = obs_tsdb.SeriesStore(points=64)
+        sub = obs_push.DeltaSubscriber(
+            "w", CannedBackend(doc), store=store,
+            clock=scripted_clock([100.0, 100.0], 100.0))
+        sub.poll_once(now=100.0)
+        t, v = store.latest("worker:w/queue_wait_p95_s")
+        assert v == 2.0
+        assert t <= 100.0
+
+    def test_staleness_anchors_to_the_rtt_midpoint(self):
+        buf = obs_push.DeltaBuffer(capacity=16)
+        buf.publish("sample", {"name": "queue_wait_p95_s", "t": 0.0,
+                               "v": 1.0})
+        store = obs_tsdb.SeriesStore(points=64)
+        sub = obs_push.DeltaSubscriber(
+            "w", SeamBackend(buf), store=store,
+            clock=scripted_clock([100.0, 102.0], 102.0))
+        sub.poll_once(now=102.0)
+        assert store.latest("worker:w/staleness_s")[1] == \
+            pytest.approx(1.0)
+        assert store.latest("worker:w/poll_rtt_s")[1] == pytest.approx(2.0)
+
+    def test_404_demotes_to_poll_fallback(self, journal_on):
+        class LegacyBackend(FakeBackend):
+            """Predates /internal/deltas: 404 on push, answers polls."""
+
+            def __init__(self):
+                super().__init__(_METRICS_A, _TSDB_A)
+                self.push_calls = 0
+
+            def push_fetch(self, cursor):
+                self.push_calls += 1
+                raise obs_push._HTTPStatusError(404, "HTTP 404: not found")
+
+        backend = LegacyBackend()
+        store = obs_tsdb.SeriesStore(points=64)
+        sub = obs_push.DeltaSubscriber("w", backend, store=store,
+                                       clock=FakeClock(10.0))
+        assert sub.poll_once(now=10.0) > 0  # the fallback scrape landed
+        st = sub.status()
+        assert st["mode"] == "poll"
+        assert st["fallbacks"] == 1
+        # the prober's own digest filled the same series
+        assert store.latest("worker:w/requests_total")[1] == 4.0
+        assert store.latest("worker:w/error_rate")[1] == \
+            pytest.approx(0.25)
+        assert store.latest("worker:w/queue_wait_p95_s")[1] == 0.5
+        events = obs_journal.JOURNAL.events_for("push-w")
+        assert any(e["event"] == "push_fallback"
+                   and e["attrs"]["worker"] == "w" for e in events)
+        # once demoted it never re-knocks on the push endpoint
+        sub.poll_once(now=11.0)
+        assert backend.push_calls == 1
+
+    def test_journal_entries_stream_into_the_fleetlog(self, journal_on):
+        obs_fleetlog.reset()
+        ev = {"seq": 1, "event": "push_fallback", "request_id": "r1",
+              "t_mono": 50.0, "parent": None, "attrs": {"worker": "w"}}
+        doc = {"enabled": True, "next_cursor": 1, "evicted_total": 0,
+               "lost": 0, "clock_us": 100.0 * 1e6,
+               "entries": [{"cursor": 1, "kind": "journal", "event": ev}]}
+        sub = obs_push.DeltaSubscriber(
+            "w", CannedBackend(doc), store=obs_tsdb.SeriesStore(points=64),
+            clock=scripted_clock([100.0, 100.0], 100.0))
+        try:
+            sub.poll_once(now=100.0)
+            rows = [r for r in obs_fleetlog.LOG.merged()
+                    if r["node"] == "w"]
+            assert len(rows) == 1
+            # offset = midpoint(100) - remote clock(100) = 0: the
+            # remote t_mono lands unchanged on the fleet axis
+            assert rows[0]["t_fleet"] == pytest.approx(50.0)
+            assert rows[0]["request_id"] == "r1"
+        finally:
+            obs_fleetlog.reset()
+
+
+# -- the manager --------------------------------------------------------------
+
+class TestPushManager:
+    def test_gate_off_tick_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH", raising=False)
+        mgr = obs_push.PushManager(store=obs_tsdb.SeriesStore(points=64))
+        mgr.set_source([FakeWorker("a", FakeBackend(_METRICS_A))])
+        assert mgr.tick() == 0
+        assert mgr.start() is False
+        assert mgr.summary()["workers"] == {}
+
+    def test_tick_streams_and_aggregates(self, push_on, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        monkeypatch.setattr(obs_prom, "fleet_queue_wait_p95", lambda: 0.0)
+        buf = obs_push.DeltaBuffer(capacity=64)
+        buf.publish("counter", {"name": "requests_total", "total": 10.0})
+        buf.publish("counter", {"name": "failures_total", "total": 1.0})
+        store = obs_tsdb.SeriesStore(points=64)
+        mgr = obs_push.PushManager(store=store, clock=FakeClock(10.0))
+        mgr.set_source([FakeWorker("a", SeamBackend(buf))])
+        assert mgr.tick(now=10.0) == 2
+        assert store.latest("worker:a/error_rate")[1] == pytest.approx(0.1)
+        assert store.latest("fleet/error_rate")[1] == pytest.approx(0.1)
+        assert store.latest("fleet/worker_stale_count")[1] == 0.0
+        assert store.latest("fleet/poll_failures_total")[1] == 0.0
+        doc = mgr.summary()
+        assert doc["workers"]["a"]["mode"] == "push"
+        assert doc["event_loss"] == 0
+        assert doc["duplicates"] == 0
+
+    def test_unreached_worker_counts_fully_errored(self, push_on,
+                                                   monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+
+        monkeypatch.setattr(obs_prom, "fleet_queue_wait_p95", lambda: 0.0)
+
+        class DeadBackend:
+            def push_fetch(self, cursor):
+                raise ConnectionError("gone")
+
+        store = obs_tsdb.SeriesStore(points=64)
+        mgr = obs_push.PushManager(store=store, clock=FakeClock(10.0))
+        mgr.set_source([FakeWorker("dead", DeadBackend())])
+        mgr.tick(now=10.0)
+        assert store.latest("fleet/error_rate")[1] == 1.0
+        assert store.latest("fleet/poll_failures_total")[1] == 1.0
+
+    def test_subscribers_follow_the_source(self, push_on):
+        buf = obs_push.DeltaBuffer(capacity=16)
+        mgr = obs_push.PushManager(store=obs_tsdb.SeriesStore(points=64),
+                                   clock=FakeClock(0.0))
+        workers = [FakeWorker("a", SeamBackend(buf))]
+        mgr.set_source(workers)
+        mgr.tick(now=0.0)
+        assert set(mgr.summary()["workers"]) == {"a"}
+        mgr.set_source([])
+        mgr.tick(now=1.0)
+        assert mgr.summary()["workers"] == {}
+
+
+# -- fleet journal merge ------------------------------------------------------
+
+def _ev(seq, event="push_fallback", rid="r", t=0.0, parent=None,
+        attrs=None):
+    return {"seq": seq, "event": event, "request_id": rid, "t_mono": t,
+            "parent": parent, "attrs": attrs or {}}
+
+
+class TestFleetLog:
+    def test_redelivery_dedupes_by_seq(self):
+        log = obs_fleetlog.FleetLog()
+        batch = [_ev(1, t=1.0), _ev(2, t=2.0)]
+        assert log.ingest("w", batch) == 2
+        assert log.ingest("w", batch) == 0  # cursor-resumed redelivery
+        assert log.stats()["deduped"] == 2
+        assert log.nodes()["w"]["count"] == 2
+
+    def test_t_fleet_clamps_monotonic_per_node(self):
+        log = obs_fleetlog.FleetLog()
+        log.ingest("w", [_ev(1, t=10.0)], offset_s=0.0)
+        # a later, smaller offset estimate would re-order the node
+        # against itself — the clamp holds seq order on the fleet axis
+        log.ingest("w", [_ev(2, t=11.0)], offset_s=-5.0)
+        rows = [r for r in log.merged() if r["node"] == "w"]
+        assert [r["seq"] for r in rows] == [1, 2]
+        assert rows[1]["t_fleet"] >= rows[0]["t_fleet"]
+        assert obs_fleetlog.causal_violations(rows) == []
+
+    def test_per_node_buffers_are_bounded(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_JOURNAL_MAX", "16")
+        log = obs_fleetlog.FleetLog()
+        log.ingest("w", [_ev(i, t=float(i)) for i in range(1, 21)])
+        assert log.nodes()["w"]["count"] == 16
+        assert log.stats()["evicted"] == 4
+        # the oldest went first
+        assert min(r["seq"] for r in log.merged()) == 5
+
+    def test_causal_violation_detection(self):
+        # hand-built inversion: seq 2's parent (seq 1, same node) is
+        # placed after it on the merged axis
+        events = [
+            {"node": "w", "seq": 2, "event": "completed",
+             "request_id": "r", "t_fleet": 1.0, "parent": 1},
+            {"node": "w", "seq": 1, "event": "submitted",
+             "request_id": "r", "t_fleet": 2.0, "parent": None},
+        ]
+        rows = obs_fleetlog.causal_violations(events)
+        assert len(rows) == 1
+        assert rows[0]["seq"] == 2
+        assert rows[0]["parent"] == 1
+        assert rows[0]["child_index"] == 0
+        assert rows[0]["parent_index"] == 1
+
+    def test_missing_parent_is_not_a_violation(self):
+        events = [{"node": "w", "seq": 9, "event": "completed",
+                   "request_id": "r", "t_fleet": 1.0, "parent": 3}]
+        assert obs_fleetlog.causal_violations(events) == []
+
+    def test_timeline_merges_local_and_streamed(self, journal_on):
+        obs_fleetlog.reset()
+        try:
+            obs_journal.emit("push_fallback", "r1", worker="local-side")
+            obs_fleetlog.ingest("w", [_ev(1, rid="r1", t=1.0),
+                                      _ev(2, rid="r2", t=2.0)])
+            doc = obs_fleetlog.timeline()
+            assert set(doc) == {"enabled", "nodes", "count", "violations",
+                                "violation_rows", "events"}
+            assert doc["enabled"] is True
+            nodes = {e["node"] for e in doc["events"]}
+            assert nodes == {"local", "w"}
+            # the request-id filter returns the one cross-node story
+            filtered = obs_fleetlog.timeline(request_id="r1")
+            assert {e["node"] for e in filtered["events"]} == \
+                {"local", "w"}
+            assert all(e["request_id"] == "r1"
+                       for e in filtered["events"])
+        finally:
+            obs_fleetlog.reset()
+
+
+# -- severity-routed notify ---------------------------------------------------
+
+def _hook_server():
+    """One local webhook capture server; returns (url, received, close)."""
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(json.loads(self.rfile.read(n) or b"{}"))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *args):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def close():
+        srv.shutdown()
+        srv.server_close()
+
+    return f"http://127.0.0.1:{srv.server_address[1]}/hook", received, close
+
+
+class TestSeverityRouting:
+    def test_channel_for_precedence(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_NOTIFY_ROUTES",
+                           "page=http://p,warn=http://w,"
+                           "acme:page=http://tenant")
+        monkeypatch.setenv("SDTPU_NOTIFY_URL", "http://default")
+        assert obs_notify.channel_for("page") == ("page", "http://p")
+        assert obs_notify.channel_for("page", tenant="acme") == \
+            ("acme:page", "http://tenant")
+        assert obs_notify.channel_for("page", tenant="other") == \
+            ("page", "http://p")
+        # unrouted severity falls to the default channel...
+        assert obs_notify.channel_for("info") == \
+            ("default", "http://default")
+        monkeypatch.delenv("SDTPU_NOTIFY_URL", raising=False)
+        # ...and to None with no default configured
+        assert obs_notify.channel_for("info") is None
+
+    def test_malformed_route_entries_are_skipped(self, monkeypatch):
+        monkeypatch.setenv("SDTPU_NOTIFY_ROUTES",
+                           "page=http://p,, =x,broken,warn= ,=http://y")
+        assert obs_notify.routes() == {"page": "http://p"}
+
+    def test_delivery_matrix_page_and_warn_never_cross(self, monkeypatch):
+        url1, page_hits, close1 = _hook_server()
+        url2, warn_hits, close2 = _hook_server()
+        monkeypatch.setenv("SDTPU_NOTIFY_ROUTES",
+                           f"page={url1},warn={url2}")
+        monkeypatch.delenv("SDTPU_NOTIFY_URL", raising=False)
+        monkeypatch.setenv("SDTPU_NOTIFY_DEDUP_S", "60")
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("r-page", "firing", 1.0, "d",
+                                       severity="page") is True
+            assert n.notify_transition("r-warn", "firing", 1.0, "d",
+                                       severity="warn") is True
+            # info has no route and no default: rejected at the door
+            assert n.notify_transition("r-info", "firing", 1.0, "d",
+                                       severity="info") is False
+            assert n.flush(5.0) is True
+            assert [b["rule"] for b in page_hits] == ["r-page"]
+            assert [b["rule"] for b in warn_hits] == ["r-warn"]
+            per = n.counts_by_channel()
+            assert per["page"] == {"sent": 1}
+            assert per["warn"] == {"sent": 1}
+            assert "info" not in per
+        finally:
+            n.stop()
+            close1()
+            close2()
+
+    def test_tenant_override_wins_the_route(self, monkeypatch):
+        url1, fleet_hits, close1 = _hook_server()
+        url2, tenant_hits, close2 = _hook_server()
+        monkeypatch.setenv("SDTPU_NOTIFY_ROUTES",
+                           f"page={url1},acme:page={url2}")
+        monkeypatch.delenv("SDTPU_NOTIFY_URL", raising=False)
+        n = obs_notify.Notifier()
+        try:
+            assert n.notify_transition("r", "firing", 1.0, "d",
+                                       severity="page",
+                                       tenant="acme") is True
+            assert n.flush(5.0) is True
+            assert [b["rule"] for b in tenant_hits] == ["r"]
+            assert fleet_hits == []
+            assert n.counts_by_channel()["acme:page"] == {"sent": 1}
+        finally:
+            n.stop()
+            close1()
+            close2()
+
+    def test_overflow_drops_newest_and_journals(self, monkeypatch,
+                                                journal_on):
+        url, _hits, close = _hook_server()
+        monkeypatch.setenv("SDTPU_NOTIFY_ROUTES", f"page={url}")
+        monkeypatch.delenv("SDTPU_NOTIFY_URL", raising=False)
+        monkeypatch.setenv("SDTPU_NOTIFY_DEDUP_S", "0")
+        n = obs_notify.Notifier()
+        # stall the drain: the queue must actually fill
+        monkeypatch.setattr(n._daemon, "start", lambda: None)
+        try:
+            for i in range(obs_notify._MAX_QUEUE + 1):
+                n.notify_transition(f"r{i}", "firing", 1.0, "d",
+                                    severity="page")
+            doc = n.summary()
+            assert doc["dropped"] == 1
+            assert doc["queued"] == obs_notify._MAX_QUEUE
+            dropped = [e for e in obs_journal.JOURNAL.snapshot()["events"]
+                       if e["event"] == "notify_dropped"]
+            assert len(dropped) == 1
+            assert dropped[0]["attrs"]["channel"] == "page"
+        finally:
+            n.stop()
+            close()
+
+
+# -- the HTTP surface ---------------------------------------------------------
+
+def _api_server():
+    from stable_diffusion_webui_distributed_tpu.runtime.config import (
+        ConfigModel,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.worker import (
+        StubBackend,
+        WorkerNode,
+    )
+    from stable_diffusion_webui_distributed_tpu.scheduler.world import (
+        World,
+    )
+    from stable_diffusion_webui_distributed_tpu.server.api import ApiServer
+
+    w = World(ConfigModel())
+    w.add_worker(WorkerNode("m", StubBackend(), master=True, avg_ipm=10.0))
+    return ApiServer(w, state=GenerationState(),
+                     host="127.0.0.1", port=0).start()
+
+
+def _get_json(port, path):
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class TestHTTPSurface:
+    def test_deltas_404_when_gated_off(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH", raising=False)
+        srv = _api_server()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get_json(srv.port, "/internal/deltas?cursor=0")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_deltas_serves_entries_and_validates(self, push_on,
+                                                 monkeypatch):
+        monkeypatch.setenv("SDTPU_PUSH_WAIT_S", "0")
+        obs_push.BUFFER.clear()
+        obs_push.BUFFER.publish("sample", {"name": "queue_wait_p95_s",
+                                           "t": 1.0, "v": 0.5})
+        srv = _api_server()
+        try:
+            doc = _get_json(srv.port, "/internal/deltas?cursor=0")
+            assert doc["enabled"] is True
+            assert doc["next_cursor"] >= 1
+            assert any(e["kind"] == "sample" for e in doc["entries"])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get_json(srv.port, "/internal/deltas?cursor=bogus")
+            assert ei.value.code == 422
+        finally:
+            srv.stop()
+
+    def test_push_status_always_served(self, monkeypatch):
+        monkeypatch.delenv("SDTPU_PUSH", raising=False)
+        srv = _api_server()
+        try:
+            doc = _get_json(srv.port, "/internal/push")
+            assert doc["enabled"] is False
+            assert doc["workers"] == {}
+            assert set(doc["buffer"]) == {"retained", "next_cursor",
+                                          "evicted_total"}
+        finally:
+            srv.stop()
+
+    def test_fleet_timeline_endpoint(self, journal_on):
+        obs_fleetlog.reset()
+        obs_fleetlog.ingest("w", [_ev(1, rid="r1", t=1.0),
+                                  _ev(2, rid="r2", t=2.0)])
+        srv = _api_server()
+        try:
+            doc = _get_json(srv.port, "/internal/fleet/timeline")
+            assert doc["count"] >= 2
+            assert "w" in doc["nodes"]
+            filtered = _get_json(
+                srv.port, "/internal/fleet/timeline?request_id=r1")
+            assert all(e["request_id"] == "r1"
+                       for e in filtered["events"])
+        finally:
+            srv.stop()
+            obs_fleetlog.reset()
+
+    def test_http_subscriber_roundtrip_and_fallback(self, push_on,
+                                                    monkeypatch):
+        monkeypatch.setenv("SDTPU_PUSH_WAIT_S", "0")
+        obs_push.BUFFER.clear()
+        obs_push.BUFFER.publish("counter", {"name": "requests_total",
+                                            "total": 7.0})
+        srv = _api_server()
+        store = obs_tsdb.SeriesStore(points=64)
+        try:
+            backend = types.SimpleNamespace(
+                address="127.0.0.1", port=srv.port, tls=False)
+            sub = obs_push.DeltaSubscriber("m", backend, store=store)
+            assert sub.poll_once() >= 1
+            assert sub.status()["mode"] == "push"
+            assert store.latest("worker:m/requests_total")[1] == 7.0
+            # flip the worker's gate off mid-flight: the next knock is
+            # a 404 and the subscriber polls the same node instead
+            monkeypatch.delenv("SDTPU_PUSH", raising=False)
+            assert sub.poll_once() >= 1
+            assert sub.status()["mode"] == "poll"
+            assert sub.status()["fallbacks"] == 1
+            monkeypatch.setenv("SDTPU_PUSH", "1")
+        finally:
+            srv.stop()
+
+
+# -- tools: fed_report --timeline, replay --fleet -----------------------------
+
+def _timeline_doc(violation=False):
+    events = [
+        {"node": "local", "seq": 1, "event": "submitted",
+         "request_id": "r1", "t_mono": 1.0, "t_fleet": 1.0,
+         "parent": None, "attrs": {}},
+        {"node": "victim", "seq": 1, "event": "job_failed",
+         "request_id": "r1", "t_mono": 0.5, "t_fleet": 2.0,
+         "parent": None, "attrs": {"worker": "victim"}},
+        {"node": "local", "seq": 2, "event": "alert_firing",
+         "request_id": "alert-worker_metrics_stale", "t_mono": 3.0,
+         "t_fleet": 3.0, "parent": None,
+         "attrs": {"rule": "worker_metrics_stale", "severity": "page"}},
+        {"node": "local", "seq": 3, "event": "requeued",
+         "request_id": "r1", "t_mono": 4.0, "t_fleet": 4.0,
+         "parent": 1, "attrs": {"from_worker": "victim",
+                                "to": ["alpha"], "recovered": 4,
+                                "dropped": 0}},
+        {"node": "alpha", "seq": 1, "event": "completed",
+         "request_id": "r1", "t_mono": 2.0, "t_fleet": 5.0,
+         "parent": None, "attrs": {}},
+    ]
+    if violation:
+        # child placed before its same-node parent on the fleet axis
+        events.insert(0, {"node": "victim", "seq": 2,
+                          "event": "completed", "request_id": "r1",
+                          "t_mono": 0.1, "t_fleet": 0.1, "parent": 1,
+                          "attrs": {}})
+    return {"enabled": True, "nodes": {}, "count": len(events),
+            "violations": 0, "violation_rows": [], "events": events}
+
+
+class TestFedReportTimeline:
+    def test_build_and_render(self):
+        import fed_report
+
+        summary = fed_report.build_timeline(_timeline_doc())
+        assert summary["kind"] == "timeline"
+        assert summary["nodes"] == ["alpha", "local", "victim"]
+        assert summary["violations"] == []
+        text = fed_report.render_timeline(summary, color=False)
+        assert "alert_firing" in text
+        assert "[page]" in text
+        assert "▲" in text
+        colored = fed_report.render_timeline(summary, color=True)
+        assert fed_report.SEV_COLORS["page"] in colored
+
+    def test_violations_recomputed_not_trusted(self):
+        import fed_report
+
+        doc = _timeline_doc(violation=True)
+        doc["violations"] = 0  # the tool must not trust the document
+        summary = fed_report.build_timeline(doc)
+        assert len(summary["violations"]) == 1
+        assert summary["violations"][0]["node"] == "victim"
+
+    def test_exit_codes(self, tmp_path, capsys):
+        import fed_report
+
+        clean = tmp_path / "clean.json"
+        clean.write_text(json.dumps(_timeline_doc()))
+        assert fed_report.main([str(clean), "--timeline",
+                                "--no-color"]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(_timeline_doc(violation=True)))
+        assert fed_report.main([str(bad), "--timeline", "--json"]) == 1
+        out = capsys.readouterr()
+        assert "causal-order violation" in out.err
+        not_timeline = tmp_path / "fleet.json"
+        not_timeline.write_text(json.dumps({"workers": {}}))
+        assert fed_report.main([str(not_timeline), "--timeline"]) == 2
+
+
+class TestReplayFleet:
+    def test_fleet_journey_reassembles_the_hops(self):
+        import replay
+
+        journey = replay.fleet_journey(_timeline_doc(), "r1")
+        assert journey["events"] == 4  # the alert rides another rid
+        assert journey["nodes"] == ["alpha", "local", "victim"]
+        assert journey["hops"] == ["local", "victim", "local", "alpha"]
+        assert len(journey["requeues"]) == 1
+        assert journey["requeues"][0]["to"] == ["alpha"]
+        assert journey["outcome"]["event"] == "completed"
+        assert journey["outcome"]["node"] == "alpha"
+
+    def test_main_fleet_mode(self, tmp_path, capsys):
+        import replay
+
+        path = tmp_path / "timeline.json"
+        path.write_text(json.dumps(_timeline_doc()))
+        assert replay.main(["--source", str(path), "--fleet",
+                            "--request-id", "r1"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["hops"][0] == "local"
+        assert replay.main(["--source", str(path), "--fleet",
+                            "--request-id", "nope"]) == 2
+
+
+# -- the gate-off serving path is byte-identical -----------------------------
+
+class TestDefaultPathPinned:
+    def test_push_off_serving_path_matches_the_poll_only_pin(
+            self, monkeypatch):
+        for var in ("SDTPU_TSDB", "SDTPU_ALERTS", "SDTPU_FEDERATION",
+                    "SDTPU_NOTIFY_URL", "SDTPU_NOTIFY_ROUTES",
+                    "SDTPU_TSDB_DIR", "SDTPU_PUSH",
+                    "SDTPU_PUSH_CURSOR_BUF", "SDTPU_PUSH_WAIT_S",
+                    "SDTPU_JOURNAL"):
+            monkeypatch.delenv(var, raising=False)
+        obs_tsdb.reset()
+        obs_alerts.reset()
+        obs_fed.reset()
+        obs_notify.reset()
+        obs_push.reset()
+        obs_fleetlog.reset()
+        engine = Engine(TINY, init_params(TINY), chunk_size=4,
+                        state=GenerationState())
+        disp = ServingDispatcher(
+            engine, bucketer=ShapeBucketer(shapes=[(32, 32)], batches=[1]),
+            window=0.0)
+        r = disp.submit(GenerationPayload(
+            prompt="a golden scenario cow", width=32, height=32,
+            steps=4, seed=4321, sampler_name="Euler a"))
+        # the SAME golden as the poll-only build: push off is not just
+        # deterministic, it is byte-identical to pre-push serving
+        _check("serving/federation-off-default", r)
+        doc = obs_push.summary()
+        assert doc["workers"] == {}
+        assert doc["ticks"] == 0
+        assert doc["buffer"] == {"retained": 0, "next_cursor": 0,
+                                 "evicted_total": 0}
+        timeline = obs_fleetlog.timeline()
+        assert timeline["enabled"] is False
+        assert timeline["count"] == 0
+
+
+# -- acceptance e2e: chaos kill debuggable from one timeline GET --------------
+
+class TestChaosKillTimeline:
+    def test_kill_story_in_a_single_timeline_response(self, monkeypatch):
+        from stable_diffusion_webui_distributed_tpu.obs import (
+            prometheus as obs_prom,
+        )
+        from stable_diffusion_webui_distributed_tpu.runtime.config import (
+            ConfigModel,
+        )
+        from stable_diffusion_webui_distributed_tpu.scheduler.worker \
+            import StubBackend, StubBehavior, WorkerNode
+        from stable_diffusion_webui_distributed_tpu.scheduler.world \
+            import World
+        from stable_diffusion_webui_distributed_tpu.server.api import (
+            ApiServer,
+        )
+        from stable_diffusion_webui_distributed_tpu.sim import (
+            chaos as sim_chaos,
+        )
+
+        for key, value in (("SDTPU_SIM", "1"), ("SDTPU_JOURNAL", "1"),
+                           ("SDTPU_TSDB", "1"), ("SDTPU_ALERTS", "1"),
+                           ("SDTPU_PUSH", "1"), ("SDTPU_PUSH_WAIT_S", "0"),
+                           ("SDTPU_TSDB_INTERVAL_S", "0.05"),
+                           ("SDTPU_ALERT_TIMESCALE", "0.01"),
+                           ("SDTPU_OBS_HTTP_TIMEOUT_S", "2.0")):
+            monkeypatch.setenv(key, value)
+        monkeypatch.delenv("SDTPU_FEDERATION", raising=False)
+        obs_prom.clear_histograms()
+        obs_tsdb.reset()
+        obs_alerts.reset()
+        obs_fed.reset()
+        obs_notify.reset()
+        obs_push.reset()
+        obs_fleetlog.reset()
+        obs_journal.JOURNAL.clear()
+
+        w = World(ConfigModel())
+        nodes = {
+            "alpha": WorkerNode("alpha", StubBackend(
+                StubBehavior(seconds_per_image=0.001)), avg_ipm=2400.0),
+            "victim": WorkerNode("victim", StubBackend(
+                StubBehavior(seconds_per_image=0.001)), avg_ipm=2400.0),
+        }
+        servers = {}
+        obs_push.set_source(w)
+
+        def cycle(n, sleep_s=0.05):
+            for _ in range(n):
+                obs_push.tick()
+                obs_tsdb.tick()
+                time.sleep(sleep_s)
+
+        try:
+            for label, node in nodes.items():
+                w.add_worker(node)
+                srv = ApiServer(w, state=GenerationState(),
+                                host="127.0.0.1", port=0).start()
+                node.backend.address = "127.0.0.1"
+                node.backend.port = srv.port
+                servers[label] = srv
+
+            # steady state: one fan-out request, then a few push cycles
+            # so both workers' delta streams have flowed
+            w.execute(GenerationPayload(
+                prompt="push e2e steady", steps=8, width=512, height=512,
+                batch_size=4, seed=99, request_id="push-e2e-000"))
+            cycle(4)
+            doc = obs_push.summary()
+            assert set(doc["workers"]) == {"alpha", "victim"}
+            assert all(s["mode"] == "push"
+                       for s in doc["workers"].values())
+
+            # the chaos: kill the victim mid-request; the scheduler
+            # requeues its share onto the healthy worker
+            plan = sim_chaos.ChaosPlan(
+                [sim_chaos.Fault(kind="kill", worker="victim",
+                                 at_request=1)], seed=0)
+            sim_chaos.arm(plan)
+            try:
+                w.execute(GenerationPayload(
+                    prompt="push e2e kill", steps=8, width=512,
+                    height=512, batch_size=4, seed=99,
+                    request_id="push-kill-001"))
+            finally:
+                sim_chaos.disarm()
+
+            # the worker process dies outright: its API goes away and
+            # the subscriber's fetches start failing
+            servers.pop("victim").stop()
+            time.sleep(max(0.3, obs_fed.stale_after_s() + 0.1))
+            cycle(8)
+
+            # --- the acceptance gate: ONE timeline GET tells the story
+            timeline = _get_json(servers["alpha"].port,
+                                 "/internal/fleet/timeline")
+            events = timeline["events"]
+            # the victim's lane holds its last streamed events
+            assert any(e["node"] == "victim" for e in events)
+            # the injected fault is on the axis
+            assert any(e["event"] == "fault_injected" for e in events)
+            # the stale alert fired, with its severity attached
+            firings = [e for e in events if e["event"] == "alert_firing"
+                       and e["attrs"].get("rule")
+                       == "worker_metrics_stale"]
+            assert firings, "worker_metrics_stale never fired"
+            assert all(e["attrs"]["severity"] == "page" for e in firings)
+            # the requeue left the victim and landed on the healthy node
+            requeues = [e for e in events if e["event"] == "requeued"]
+            assert any(e["attrs"].get("from_worker") == "victim"
+                       and e["attrs"].get("to") == ["alpha"]
+                       for e in requeues)
+            # and the merge is causally clean
+            assert timeline["violations"] == 0
+
+            # the filtered view reassembles the killed request's story
+            filtered = _get_json(
+                servers["alpha"].port,
+                "/internal/fleet/timeline?request_id=push-kill-001")
+            names = {e["event"] for e in filtered["events"]}
+            assert "job_failed" in names
+            assert "requeued" in names
+            assert "completed" in names
+
+            # stream accounting: nothing lost, the victim is marked
+            # stale, the healthy worker kept streaming
+            doc = obs_push.summary()
+            assert doc["event_loss"] == 0
+            assert doc["workers"]["victim"]["stale"] is True
+            assert doc["workers"]["victim"]["failures"] > 0
+            assert doc["workers"]["alpha"]["stale"] is False
+            assert doc["workers"]["alpha"]["last_error"] is None
+        finally:
+            for srv in servers.values():
+                srv.stop()
+            obs_notify.flush(5.0)
+            obs_push.reset()
+            obs_fleetlog.reset()
+            obs_tsdb.reset()
+            obs_alerts.reset()
+            obs_fed.reset()
+            obs_notify.reset()
+            obs_journal.JOURNAL.clear()
+            obs_prom.clear_histograms()

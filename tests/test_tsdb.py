@@ -341,7 +341,8 @@ class TestAlertEngine:
         assert set(doc["registered"]) == set(obs_alerts.registered_rules())
         for meta in doc["registered"].values():
             assert set(meta) == {"kind", "series", "description",
-                                 "scale_up"}
+                                 "scale_up", "severity"}
+            assert meta["severity"] in obs_alerts.SEVERITIES
 
     def test_reregistering_a_rule_name_raises(self):
         with pytest.raises(ValueError, match="already registered"):

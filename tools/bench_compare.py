@@ -114,6 +114,15 @@ THRESHOLDS = {
     "cold_start_seconds": ("up", "rel", 0.20),
     "aot_hit_rate": ("down", "abs", 0.05),
     "warm_fresh_chunk_compiles": ("up", "abs", 0.0),
+    # push control plane rows (bench.py run_obsplane): cursor-resume
+    # delta streaming is lossless by contract — ANY lost entry is a
+    # protocol break; a misrouted notification (page landing on the warn
+    # channel or vice versa) is a paging bug at any count; and push
+    # staleness regressing past the poll baseline removes the plane's
+    # whole reason to exist (the in-run check also hard-fails on it)
+    "push_event_loss": ("up", "abs", 0.0),
+    "notify_misrouted": ("up", "abs", 0.0),
+    "push_staleness_p95_s": ("up", "rel", 0.25),
 }
 
 #: bench.py artifacts keep the headline number under "value"; map it back

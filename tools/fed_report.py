@@ -12,7 +12,18 @@ Consumes either kind of federation artifact (obs/federation.py):
     python tools/fed_report.py /var/lib/sdtpu/tsdb_snapshot.json
     python tools/fed_report.py fleet.json --json     # machine-readable
 
-Exit codes: 0 every worker fresh; 1 any stale worker; 2 artifact
+``--timeline`` switches to the fleet-merged journal timeline
+(a saved ``GET /internal/fleet/timeline`` document, obs/fleetlog.py):
+one lane per node, events in fleet-clock order, alert markers colored
+by severity (page=red, warn=yellow, info=cyan). The causal check
+re-runs locally — a child event ordered before its same-node parent is
+a broken merge or clock offset, and the tool exits 1 on any.
+
+    curl '<master>/internal/fleet/timeline' > timeline.json
+    python tools/fed_report.py timeline.json --timeline
+
+Exit codes: 0 every worker fresh (or timeline causally clean); 1 any
+stale worker (or any causal-order violation); 2 artifact
 missing/unparseable or carrying no federation data.
 """
 
@@ -128,6 +139,93 @@ def build_summary(doc, stale_after_s=3.0):
     }
 
 
+# -- fleet timeline mode -----------------------------------------------------
+
+#: ANSI color per alert severity (obs/alerts.py closed set).
+SEV_COLORS = {"page": "\033[31m", "warn": "\033[33m", "info": "\033[36m"}
+_RESET = "\033[0m"
+
+#: Events drawn as alert markers (severity-colored) instead of dots.
+_ALERT_EVENTS = ("alert_firing", "alert_resolved")
+
+
+def timeline_violations(events):
+    """Causal-order check over a merged timeline: an event whose
+    same-node ``parent`` seq appears *later* in the list means the
+    merge (or a clock offset) placed an effect before its cause.
+    Recomputed here rather than trusted from the document — catching a
+    bad merge is this tool's job."""
+    pos = {}
+    for i, ev in enumerate(events):
+        pos[(ev.get("node"), ev.get("seq"))] = i
+    out = []
+    for i, ev in enumerate(events):
+        parent = ev.get("parent")
+        if parent is None:
+            continue
+        j = pos.get((ev.get("node"), parent))
+        if j is not None and j > i:
+            out.append({"node": ev.get("node"), "seq": ev.get("seq"),
+                        "event": ev.get("event"),
+                        "request_id": ev.get("request_id"),
+                        "parent": parent})
+    return out
+
+
+def build_timeline(doc):
+    """Digest a /internal/fleet/timeline document into lanes + the
+    locally recomputed violation list (None kind when the document is
+    not a timeline)."""
+    events = doc.get("events")
+    if not isinstance(events, list):
+        return {"kind": None, "nodes": [], "events": [],
+                "violations": []}
+    events = [e for e in events if isinstance(e, dict)]
+    nodes = sorted({str(e.get("node", "?")) for e in events}
+                   | set((doc.get("nodes") or {}).keys()))
+    return {"kind": "timeline", "nodes": nodes, "events": events,
+            "violations": timeline_violations(events)}
+
+
+def render_timeline(summary, color=True):
+    """One lane per node; each line is one event at its fleet-clock
+    offset, its marker in its node's lane. Alert transitions get a
+    severity-colored marker."""
+    nodes = summary["nodes"]
+    events = summary["events"]
+    lane = {n: i for i, n in enumerate(nodes)}
+    width = max([12] + [len(n) for n in nodes])
+    head = " " * 11 + "".join(f"{n:<{width + 2}}" for n in nodes)
+    lines = [f"fleet timeline — {len(events)} event(s), "
+             f"{len(nodes)} node lane(s)", "", head]
+    t0 = events[0].get("t_fleet", 0.0) if events else 0.0
+    for ev in events:
+        attrs = ev.get("attrs") or {}
+        sev = attrs.get("severity")
+        marker, note = "●", ""
+        if ev.get("event") in _ALERT_EVENTS:
+            marker = "▲" if ev.get("event") == "alert_firing" else "△"
+            note = f" [{sev}]" if sev else ""
+            if color and sev in SEV_COLORS:
+                marker = f"{SEV_COLORS[sev]}{marker}{_RESET}"
+        cells = ["·"] * len(nodes)
+        idx = lane.get(str(ev.get("node", "?")), 0)
+        cells[idx] = marker
+        # every cell is one visible glyph; pad manually so ANSI color
+        # escapes don't skew the lane alignment
+        row = "".join(c + " " * (width + 1) for c in cells)
+        t = ev.get("t_fleet")
+        dt = (t - t0) if isinstance(t, (int, float)) else 0.0
+        rid = ev.get("request_id") or ""
+        lines.append(f"+{dt:>8.3f}s  {row}{ev.get('event')}"
+                     f"{note}  {rid}")
+    for v in summary["violations"]:
+        lines.append(f"CAUSAL VIOLATION: {v['node']}#{v['seq']} "
+                     f"({v['event']}, rid={v['request_id']}) ordered "
+                     f"before its parent #{v['parent']}")
+    return "\n".join(lines)
+
+
 def render(summary):
     rows = summary["workers"]
     lines = [f"federation report ({summary['kind']}) — {len(rows)} "
@@ -163,6 +261,12 @@ def main(argv=None) -> int:
                          "(fleet summaries carry their own)")
     ap.add_argument("--json", action="store_true",
                     help="emit the digested summary as JSON")
+    ap.add_argument("--timeline", action="store_true",
+                    help="render a saved GET /internal/fleet/timeline "
+                         "document as per-node lanes; exit 1 on any "
+                         "causal-order violation")
+    ap.add_argument("--no-color", action="store_true",
+                    help="plain markers (timeline mode)")
     args = ap.parse_args(argv)
 
     try:
@@ -172,6 +276,25 @@ def main(argv=None) -> int:
     except benchjson.BenchJsonError as e:
         print(e, file=sys.stderr)
         return 2
+
+    if args.timeline:
+        summary = build_timeline(doc)
+        if summary["kind"] is None:
+            print("fed_report: document has no 'events' list — not a "
+                  "fleet timeline artifact", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({"nodes": summary["nodes"],
+                              "count": len(summary["events"]),
+                              "violations": summary["violations"]},
+                             indent=2))
+        else:
+            print(render_timeline(summary, color=not args.no_color))
+        if summary["violations"]:
+            print(f"fed_report: FAIL — {len(summary['violations'])} "
+                  "causal-order violation(s)", file=sys.stderr)
+            return 1
+        return 0
 
     summary = build_summary(doc, stale_after_s=args.stale_after)
     if summary["kind"] is None:

@@ -21,10 +21,15 @@ Usage:
   # --source accepts a saved snapshot file, a JSONL sink file
   # (SDTPU_JOURNAL_SINK spill), or a live /internal/journal URL;
   # --post re-executes against a server and byte-compares.
+  # fleet mode: --source is a merged fleet timeline
+  # (GET /internal/fleet/timeline, obs/fleetlog.py) and the output is
+  # the request's full cross-node journey — master dispatch, the
+  # worker's own journal slice, the failure, the requeue hop
+  python tools/replay.py --source timeline.json --fleet --request-id RID
 
 Library surface (used by tests and tooling): :func:`load_snapshot`,
 :func:`events_for`, :func:`reconstruct`, :func:`compare`,
-:func:`request_ids`, :func:`replay_window`.
+:func:`request_ids`, :func:`replay_window`, :func:`fleet_journey`.
 """
 
 from __future__ import annotations
@@ -235,6 +240,49 @@ def replay_window(snapshot: Dict[str, Any], executor,
     }
 
 
+def fleet_journey(timeline: Dict[str, Any],
+                  request_id: str) -> Dict[str, Any]:
+    """One request's cross-node journey from a merged fleet timeline
+    (``GET /internal/fleet/timeline`` — events carry ``node`` and the
+    clock-corrected ``t_fleet``). The W3C traceparent thread gives the
+    master and every worker it touched the same request id, so the
+    filter alone reassembles the master→worker→requeue story; ``hops``
+    is the node sequence in fleet-clock order."""
+    rid = str(request_id)
+    events = [e for e in (timeline.get("events") or [])
+              if isinstance(e, dict) and e.get("request_id") == rid]
+    events.sort(key=lambda e: (e.get("t_fleet", 0.0),
+                               str(e.get("node", "")),
+                               e.get("seq", 0)))
+    hops: List[str] = []
+    requeues: List[Dict[str, Any]] = []
+    outcome: Dict[str, Any] = {}
+    for e in events:
+        node = str(e.get("node", "?"))
+        if not hops or hops[-1] != node:
+            hops.append(node)
+        name = e.get("event", "")
+        attrs = e.get("attrs") or {}
+        if name == "requeued":
+            requeues.append({"node": node, **attrs})
+        elif name in ("completed", "failed", "throttled",
+                      "job_completed", "job_failed"):
+            outcome = {"event": name, "node": node, **attrs}
+    return {
+        "request_id": rid,
+        "events": len(events),
+        "nodes": sorted({str(e.get("node", "?")) for e in events}),
+        "hops": hops,
+        "requeues": requeues,
+        "outcome": outcome,
+        "journey": [{"node": e.get("node"),
+                     "event": e.get("event"),
+                     "t_fleet": e.get("t_fleet"),
+                     "seq": e.get("seq"),
+                     "attrs": e.get("attrs") or {}} for e in events],
+    }
+
+
 def _post_executor(base_url: str):
     """Executor that re-POSTs the payload to a live server's txt2img."""
     def run(payload: Dict[str, Any]):
@@ -270,11 +318,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--post", default="",
                     help="server base URL to re-execute against "
                          "(omit to only reconstruct)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="--source is a merged fleet timeline "
+                         "(/internal/fleet/timeline); reconstruct the "
+                         "request's cross-node journey instead of "
+                         "re-executing")
     args = ap.parse_args(argv)
     if bool(args.request_id) == bool(args.all):
         ap.error("exactly one of --request-id / --all is required")
 
     snapshot = load_snapshot(args.source)
+    if args.fleet:
+        if not args.request_id:
+            ap.error("--fleet requires --request-id")
+        journey = fleet_journey(snapshot, args.request_id)
+        print(json.dumps(journey, indent=2, sort_keys=True, default=str))
+        return 0 if journey["events"] else 2
     if args.all:
         if args.post:
             report = replay_window(snapshot, _post_executor(args.post),
